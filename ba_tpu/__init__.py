@@ -1,0 +1,26 @@
+"""ba_tpu — a TPU-native Byzantine-agreement framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+mathiasplans/byzantine-agreement (reference: /root/reference/ba.py): the
+Byzantine Generals problem with leader election, order broadcast, majority
+voting, 3f+1 quorum decisions, live fault injection, and elastic membership —
+rebuilt as massively-batched tensor programs over (instances x nodes x nodes)
+arrays instead of thread-per-general RPC.
+
+Layout (mirrors SURVEY.md section 1's layer map, TPU-first):
+
+- ``ba_tpu.core``     — pure-functional protocol math: OM(1), recursive
+  OM(m)/EIG, SM(m) signed messages, quorum thresholds, election. The
+  reference's L3 protocol logic (ba.py:126-319) as jittable tensor ops.
+- ``ba_tpu.ops``      — Pallas TPU kernels for the hot reductions.
+- ``ba_tpu.crypto``   — batched Ed25519 / SHA-512 (JAX int32-limb kernels with
+  a native C++ CPU oracle for differential testing).
+- ``ba_tpu.parallel`` — device-mesh sharding: instance-axis data parallelism
+  and node-axis "sequence parallelism" with XLA collectives; the TPU
+  equivalent of the reference's RPyC/TCP backend (ba.py:79-102).
+- ``ba_tpu.runtime``  — the thin stateful host shell: membership registry,
+  election-for-life, failure detection, and the REPL with byte-identical
+  output (reference L2/L4, ba.py:66-122,354-445).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,44 @@
+"""Pure-functional consensus core (jittable, batched).
+
+Everything here is a pure function of (PRNG key, state tensors) -> tensors:
+no Python-level control flow on traced values, static shapes throughout, so
+XLA can fuse the whole agreement round into a handful of TPU kernels.
+"""
+
+from ba_tpu.core.types import (
+    RETREAT,
+    ATTACK,
+    UNDEFINED,
+    COMMAND_NAMES,
+    command_from_name,
+    command_name,
+)
+from ba_tpu.core.state import SimState, make_state
+from ba_tpu.core.quorum import (
+    quorum_threshold,
+    quorum_decision,
+    majority_counts,
+    quorum_threshold_py,
+)
+from ba_tpu.core.om import om1_round, om1_agreement
+from ba_tpu.core.eig import eig_agreement
+from ba_tpu.core.election import elect_lowest_id
+
+__all__ = [
+    "RETREAT",
+    "ATTACK",
+    "UNDEFINED",
+    "COMMAND_NAMES",
+    "command_from_name",
+    "command_name",
+    "SimState",
+    "make_state",
+    "quorum_threshold",
+    "quorum_decision",
+    "majority_counts",
+    "quorum_threshold_py",
+    "om1_round",
+    "om1_agreement",
+    "eig_agreement",
+    "elect_lowest_id",
+]

@@ -1,0 +1,152 @@
+"""Recursive OM(m) as a dense EIG (Exponential Information Gathering) tree.
+
+The reference implements only the m=1 special case (one push round + one pull
+round, ba.py:258-285 + ba.py:159-195).  This module generalises it to OM(m)
+the TPU way: the message tree — node i's copy of "j_k said (j_{k-1} said ...
+(leader said v))" for every relay path — is a dense tensor
+
+    V_l[b, i, p]   with p in [n]^l flattened,  shape [B, n, n**l]
+
+so the sending phase is l broadcasts (each the all-to-all relay round, no
+RPC loop) and the resolve phase is l masked strict-majority reductions.
+Python loops run over the *static* depth m, so under jit the whole tree
+unrolls into straight-line XLA ops with static shapes.
+
+Semantics are the natural OM(m) extension of the reference's rules:
+
+- Faulty relays lie with an independent coin per (receiver, path) message
+  (generalising ba.py:44-49); a general always keeps its own copies honest
+  (generalising ba.py:163-167 / SURVEY.md Q3).
+- The resolve majority at path p is over relays j that are alive, not the
+  leader, and not already in p; ties (and all-UNDEFINED children) resolve to
+  UNDEFINED, generalising ba.py:188-195.
+- The leader's own majority is its true order (ba.py:284-285, Q1).
+
+m=1 reproduces OM(1) exactly (test_eig.py checks equality against om.py).
+Memory is O(B * n * n**m) int8 — fine for the survey's OM(3), n=10 bench
+config; for n=1024-scale clusters use the SM(m) signed-message protocol
+(``ba_tpu.core.sm``), which is O(B * n^2) per hop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from ba_tpu.core.om import round1_broadcast
+from ba_tpu.core.quorum import majority_counts, quorum_decision
+from ba_tpu.core.state import SimState
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+
+
+def _coin(key: jax.Array, shape) -> jnp.ndarray:
+    return jr.randint(key, shape, 0, 2, dtype=COMMAND_DTYPE)
+
+
+def _in_path_mask(n: int, level: int) -> np.ndarray:
+    """Static [n**level, n] bool: is relay j one of path p's digits?"""
+    P = n**level
+    mask = np.zeros((P, n), dtype=bool)
+    p = np.arange(P)
+    for k in range(level):
+        digit = (p // (n**k)) % n
+        mask[p, digit] = True
+    return mask
+
+
+def eig_send(key: jax.Array, state: SimState, m: int) -> list[jnp.ndarray]:
+    """Sending phase: build levels V_0..V_m of every general's EIG tree.
+
+    V_0[b, i] is what the leader told i (round-1 broadcast with per-recipient
+    equivocation coins, ba.py:258-282).  Each subsequent level is one relay
+    round: V_{l+1}[b, i, p*n + j] = what j told i about path p — j's honest
+    copy V_l[b, j, p], or a fresh coin if j is faulty (self-messages stay
+    honest).
+    """
+    B, n = state.faulty.shape
+    keys = jr.split(key, m + 1)
+    levels = [round1_broadcast(keys[0], state)]
+    eye = jnp.eye(n, dtype=bool)
+    for level in range(m):
+        prev = levels[-1].reshape(B, n, n**level)
+        P = n**level
+        coins = _coin(keys[level + 1], (B, n, P, n))
+        # relayed[b, i, p, j] = V_l[b, j, p], broadcast over receivers i.
+        relayed = jnp.transpose(prev, (0, 2, 1))[:, None, :, :]
+        relayed = jnp.broadcast_to(relayed, (B, n, P, n))
+        lying = state.faulty[:, None, None, :] & ~eye[None, :, None, :]
+        nxt = jnp.where(lying, coins, relayed)
+        levels.append(nxt.reshape(B, n, P * n))
+    return levels
+
+
+def eig_resolve(state: SimState, levels: list[jnp.ndarray]) -> jnp.ndarray:
+    """Resolve phase: fold the tree bottom-up with masked strict majorities.
+
+    Returns per-general majorities [B, n] int8.  At each internal path p the
+    children p.j are tallied over relays j with j alive, j != leader,
+    j not in p (the reference's vote-weight rule ba.py:169-186 generalised);
+    strict majority, tie -> UNDEFINED (ba.py:188-195).
+    """
+    B, n = state.faulty.shape
+    m = len(levels) - 1
+    is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0  # [B, n]
+    resolved = levels[m].reshape(B, n, n**m)
+    for level in range(m - 1, -1, -1):
+        P = n**level
+        children = resolved.reshape(B, n, P, n)
+        in_path = jnp.asarray(_in_path_mask(n, level))  # [P, n] static
+        valid = (
+            state.alive[:, None, None, :]
+            & ~is_leader[:, None, None, :]
+            & ~in_path[None, None, :, :]
+        )
+        n_attack = jnp.sum((children == ATTACK) & valid, axis=-1)
+        n_retreat = jnp.sum((children == RETREAT) & valid, axis=-1)
+        resolved = jnp.where(
+            n_attack > n_retreat,
+            jnp.asarray(ATTACK, COMMAND_DTYPE),
+            jnp.where(
+                n_retreat > n_attack,
+                jnp.asarray(RETREAT, COMMAND_DTYPE),
+                jnp.asarray(UNDEFINED, COMMAND_DTYPE),
+            ),
+        )
+    majorities = resolved.reshape(B, n)
+    majorities = jnp.where(is_leader, state.order[:, None], majorities)
+    return majorities
+
+
+def eig_round(key: jax.Array, state: SimState, m: int) -> jnp.ndarray:
+    """Full OM(m) exchange -> per-general majorities [B, n] int8.
+
+    m=0 degenerates to "trust the leader" (everyone's majority is what they
+    received); m=1 is the reference's protocol.
+    """
+    if m == 0:
+        # round1_broadcast already pins the leader slot to the true order.
+        return round1_broadcast(key, state)
+    levels = eig_send(key, state, m)
+    return eig_resolve(state, levels)
+
+
+def eig_agreement(key: jax.Array, state: SimState, m: int):
+    """OM(m) agreement + global quorum, the generalised ``actual-order``.
+
+    Same output dict as ``om1_agreement`` (ba.py:376-399's hot path).
+    """
+    majorities = eig_round(key, state, m)
+    n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
+    decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
+    return {
+        "majorities": majorities,
+        "decision": decision,
+        "needed": needed,
+        "total": total,
+        "n_attack": n_attack,
+        "n_retreat": n_retreat,
+        "n_undefined": n_undefined,
+    }

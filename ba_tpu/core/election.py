@@ -1,0 +1,25 @@
+"""Leader election: bully-by-lowest-id as a masked argmin.
+
+The reference elects by polling every reachable peer's id and claiming
+leadership iff none is lower (ba.py:126-157) — O(n) RPCs per candidate,
+O(n^2) cluster-wide.  Concurrent elections converge because the winner
+predicate (global lowest id among the alive) is deterministic; "election is
+for life" (ba.py:124-125).  On TPU the whole thing is one reduction.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def elect_lowest_id(ids: jnp.ndarray, alive: jnp.ndarray) -> jnp.ndarray:
+    """Index of the alive node with the lowest id, per instance.
+
+    ids: [B, n] int32, alive: [B, n] bool -> [B] int32 (index into the node
+    axis).  If no node is alive the result is arbitrary (index 0), mirroring
+    the reference where a fully-killed cluster simply has no one left to
+    elect (and the REPL crashes on the next id lookup, SURVEY.md Q4).
+    """
+    big = jnp.iinfo(jnp.int32).max
+    masked = jnp.where(alive, ids, big)
+    return jnp.argmin(masked, axis=-1).astype(jnp.int32)

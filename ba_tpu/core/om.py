@@ -1,0 +1,131 @@
+"""OM(1): one-round oral-message majority vote, batched as tensor ops.
+
+The reference's hot path (SURVEY.md section 4.2) is:
+
+1. Round 1 (push): the primary sends its order to every other general; a
+   faulty primary flips an independent coin per recipient — equivocation
+   (ba.py:258-282).  The primary's own majority is set to the true command
+   without exchanging (ba.py:284-285, SURVEY.md Q1).
+2. Round 2 (pull): each lieutenant tallies its own received command plus
+   ``get_order()`` from every other non-primary general (ba.py:159-186);
+   faulty peers answer a fresh coin per query (ba.py:44-49).  Strict
+   majority -> attack/retreat, exact tie -> undefined (ba.py:188-195).
+
+Here both rounds are one fused tensor program over a [B, n, n] vote cube:
+round 1 is a masked select on the leader row, round 2 is the all-to-all
+"answers" matrix (the O(n^2) RPC mesh becomes a broadcast) and a masked
+reduction per receiver.  Faulty behaviour is injected as seeded Bernoulli
+masks — the vectorized equivalent of ``random.randint(0, 1)`` per call.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from ba_tpu.core.quorum import majority_counts, quorum_decision
+from ba_tpu.core.state import SimState
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+
+
+def _coin(key: jax.Array, shape) -> jnp.ndarray:
+    """Fair coin over {RETREAT, ATTACK}, the fault model of ba.py:44-49."""
+    return jr.randint(key, shape, 0, 2, dtype=COMMAND_DTYPE)
+
+
+def round1_broadcast(key: jax.Array, state: SimState) -> jnp.ndarray:
+    """What each general received from the leader: [B, n] int8.
+
+    Honest leader: everyone gets ``order``.  Faulty leader: an independent
+    coin per recipient (ba.py:268-273).  The leader itself always holds the
+    true order (ba.py:261).  Dead recipients' slots are computed but masked
+    out downstream — keeping the shape static for XLA.
+    """
+    B, n = state.faulty.shape
+    coins = _coin(key, (B, n))
+    leader_onehot = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0
+    leader_faulty = jnp.take_along_axis(state.faulty, state.leader[:, None], axis=1)
+    received = jnp.where(leader_faulty, coins, state.order[:, None])
+    received = jnp.where(leader_onehot, state.order[:, None], received)
+    return received
+
+
+def round2_votes(key: jax.Array, state: SimState, received: jnp.ndarray) -> jnp.ndarray:
+    """The all-to-all answer cube: answers[b, i, j] = what j tells asker i.
+
+    Replaces the reference's O(n^2) ``get_order()`` RPC mesh (ba.py:169-186)
+    with one broadcast + masked select.  Faulty responders lie with a fresh
+    coin *per asker* — different callers can get different answers, the
+    Byzantine behaviour of ba.py:44-49.  A general answers itself truthfully
+    (its own received command is its own first vote, ba.py:163-167) — note a
+    faulty general still *tallies* honestly; its lies only affect what others
+    hear from it (SURVEY.md Q3).
+    """
+    B, n = state.faulty.shape
+    coins = _coin(key, (B, n, n))
+    answers = jnp.where(state.faulty[:, None, :], coins, received[:, None, :])
+    eye = jnp.eye(n, dtype=bool)[None]
+    answers = jnp.where(eye, received[:, None, :], answers)
+    return answers
+
+
+def tally_majorities(state: SimState, received: jnp.ndarray, answers: jnp.ndarray) -> jnp.ndarray:
+    """Per-general round-2 majority: [B, n] int8 in {RETREAT, ATTACK, UNDEFINED}.
+
+    Vote weights mirror the reference exactly: asker i counts responder j iff
+    j is alive and j is not the primary (ba.py:171-172 skips the primary;
+    dead peers vanish via the silent try/except at ba.py:185-186); j == i is
+    the general's own received command.  Strict-majority with tie ->
+    UNDEFINED (ba.py:188-195).  The leader's majority is its own command
+    regardless of faultiness (ba.py:284-285, Q1).
+    """
+    B, n = state.faulty.shape
+    is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0
+    weight = state.alive[:, None, :] & ~is_leader[:, None, :]
+    n_attack = jnp.sum((answers == ATTACK) & weight, axis=-1)
+    n_retreat = jnp.sum((answers == RETREAT) & weight, axis=-1)
+    majority = jnp.where(
+        n_attack > n_retreat,
+        jnp.asarray(ATTACK, COMMAND_DTYPE),
+        jnp.where(
+            n_retreat > n_attack,
+            jnp.asarray(RETREAT, COMMAND_DTYPE),
+            jnp.asarray(UNDEFINED, COMMAND_DTYPE),
+        ),
+    )
+    majority = jnp.where(is_leader, state.order[:, None], majority)
+    return majority
+
+
+def om1_round(key: jax.Array, state: SimState) -> jnp.ndarray:
+    """Full OM(1) message exchange -> per-general majorities [B, n] int8."""
+    k1, k2 = jr.split(key)
+    received = round1_broadcast(k1, state)
+    answers = round2_votes(k2, state, received)
+    return tally_majorities(state, received, answers)
+
+
+def om1_agreement(key: jax.Array, state: SimState):
+    """One complete agreement round: the ``actual-order`` hot path.
+
+    Mirrors SURVEY.md section 4.2 end-to-end: round-1 broadcast, round-2
+    all-to-all majority, then the global majority-of-majorities gather and
+    3f+1 quorum decision (ba.py:197-255) — all in one jittable program.
+
+    Returns a dict with per-general ``majorities`` [B, n] and the quorum
+    outputs ``decision``/``needed``/``total``/``n_attack``/``n_retreat``/
+    ``n_undefined`` (all [B]).
+    """
+    majorities = om1_round(key, state)
+    n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
+    decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
+    return {
+        "majorities": majorities,
+        "decision": decision,
+        "needed": needed,
+        "total": total,
+        "n_attack": n_attack,
+        "n_retreat": n_retreat,
+        "n_undefined": n_undefined,
+    }

@@ -1,0 +1,84 @@
+"""Quorum math: the 3f+1 decision rule, batched.
+
+Reproduces ``Process.quorum`` / ``Process.get_majorities`` (ba.py:197-255)
+exactly, including its quirks:
+
+- ``k = (total - 1) // 3`` with ``needed = 2k + 1``, overridden to
+  ``total - 1`` when ``total <= 3`` and to ``1`` when ``total == 1``
+  (ba.py:227-235).
+- Retreat is checked before attack, so a tie at the quorum level prefers
+  retreat (ba.py:246-250, SURVEY.md Q7).
+- Majorities are gathered from every *alive* node including the primary
+  (killed ports are silently dropped by the try/except at ba.py:219-221,
+  SURVEY.md Q2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED, COMMAND_DTYPE
+
+
+def majority_counts(majorities: jnp.ndarray, alive: jnp.ndarray):
+    """(n_attack, n_retreat, n_undefined) over alive nodes, per instance.
+
+    The TPU analogue of the reference's gather loop over every port
+    (ba.py:197-223): the O(n) RPC pull becomes one masked reduction.
+
+    majorities: [B, n] int8, alive: [B, n] bool -> three [B] int32.
+    """
+    alive_i = alive.astype(jnp.int32)
+    n_attack = jnp.sum(jnp.where(majorities == ATTACK, alive_i, 0), axis=-1)
+    n_retreat = jnp.sum(jnp.where(majorities == RETREAT, alive_i, 0), axis=-1)
+    n_undefined = jnp.sum(jnp.where(majorities == UNDEFINED, alive_i, 0), axis=-1)
+    return n_attack, n_retreat, n_undefined
+
+
+def quorum_threshold(total: jnp.ndarray) -> jnp.ndarray:
+    """``needed`` as a function of ``total`` voters (ba.py:227-235)."""
+    k = (total - 1) // 3
+    needed = 2 * k + 1
+    needed = jnp.where(total <= 3, total - 1, needed)
+    needed = jnp.where(total == 1, 1, needed)
+    return needed
+
+
+def quorum_threshold_py(total: int) -> int:
+    """Host-side mirror of :func:`quorum_threshold` for the REPL shell."""
+    k = (total - 1) // 3
+    needed = 2 * k + 1
+    if total <= 3:
+        needed = total - 1
+    if total == 1:
+        needed = 1
+    return needed
+
+
+def quorum_decision(n_attack, n_retreat, n_undefined):
+    """Final decision per instance: RETREAT / ATTACK / UNDEFINED.
+
+    Ordering matters and mirrors ba.py:246-253: retreat wins ties because it
+    is checked first; UNDEFINED means "cannot be determined".
+
+    Returns (decision [B] int8, needed [B] int32, total [B] int32).
+    """
+    total = n_attack + n_retreat + n_undefined
+    needed = quorum_threshold(total)
+    decision = jnp.where(
+        needed <= n_retreat,
+        jnp.asarray(RETREAT, COMMAND_DTYPE),
+        jnp.where(
+            needed <= n_attack,
+            jnp.asarray(ATTACK, COMMAND_DTYPE),
+            jnp.asarray(UNDEFINED, COMMAND_DTYPE),
+        ),
+    )
+    # A fully-dead cluster (total == 0) must not "decide": the reference can
+    # never reach this state (its REPL crashes first, SURVEY.md Q4), but our
+    # alive-mask API makes it expressible, and needed = total - 1 = -1 would
+    # otherwise fabricate a retreat consensus out of zero voters.
+    decision = jnp.where(
+        total == 0, jnp.asarray(UNDEFINED, COMMAND_DTYPE), decision
+    )
+    return decision, needed, total

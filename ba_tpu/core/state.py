@@ -1,0 +1,85 @@
+"""Batched simulation state: a dataclass-of-arrays pytree.
+
+The reference keeps per-general mutable state on a ``Process`` object
+(ba.py:67-80: ``id``, ``primary``, ``faulty``, ``killed``, ``command``,
+``majority``).  Here the whole cluster — and B independent clusters at once —
+is a struct of dense arrays, so one ``vmap``-free batched program simulates
+thousands of clusters per TPU core.
+
+Axes convention: ``B`` = independent consensus instances, ``n`` = generals
+(fixed capacity; elastic membership à la ``g-add``/``g-kill`` ba.py:415-437 is
+modelled by the ``alive`` mask so shapes stay static under jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ba_tpu.core.types import COMMAND_DTYPE, RETREAT
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SimState:
+    """State of B independent Byzantine-generals clusters of capacity n.
+
+    Fields (all arrays; shapes in brackets):
+
+    - ``order``  [B] int8   — the order the commander was told to issue
+      (``actual-order <cmd>``, ba.py:376-381).
+    - ``leader`` [B] int32  — index of the current primary (the reference
+      tracks this as ``primary``/``primary_port``, ba.py:71-72).
+    - ``faulty`` [B, n] bool — live fault-injection flags (``g-state <id>
+      faulty``, ba.py:401-407).
+    - ``alive``  [B, n] bool — membership mask: False = never spawned or
+      killed (``g-kill``, ba.py:415-425).
+    - ``ids``    [B, n] int32 — general ids (ascending from 1 in the
+      reference, ba.py:344-351); kept explicit so election-by-lowest-id
+      (ba.py:126-157) is an argmin, not an assumption.
+    """
+
+    order: jax.Array
+    leader: jax.Array
+    faulty: jax.Array
+    alive: jax.Array
+    ids: jax.Array
+
+    @property
+    def batch(self) -> int:
+        return self.faulty.shape[0]
+
+    @property
+    def n(self) -> int:
+        return self.faulty.shape[1]
+
+
+def make_state(
+    batch: int,
+    n: int,
+    *,
+    order: Any = RETREAT,
+    leader: Any = 0,
+    faulty: Any = None,
+    alive: Any = None,
+) -> SimState:
+    """Build a SimState with broadcastable defaults.
+
+    Defaults mirror a fresh reference cluster: all alive, none faulty, G1
+    (index 0, the lowest id) is primary (ba.py:354-363 + ba.py:126-157).
+    """
+    order_arr = jnp.broadcast_to(jnp.asarray(order, COMMAND_DTYPE), (batch,))
+    leader_arr = jnp.broadcast_to(jnp.asarray(leader, jnp.int32), (batch,))
+    if faulty is None:
+        faulty_arr = jnp.zeros((batch, n), jnp.bool_)
+    else:
+        faulty_arr = jnp.broadcast_to(jnp.asarray(faulty, jnp.bool_), (batch, n))
+    if alive is None:
+        alive_arr = jnp.ones((batch, n), jnp.bool_)
+    else:
+        alive_arr = jnp.broadcast_to(jnp.asarray(alive, jnp.bool_), (batch, n))
+    ids = jnp.broadcast_to(jnp.arange(1, n + 1, dtype=jnp.int32), (batch, n))
+    return SimState(order_arr, leader_arr, faulty_arr, alive_arr, ids)

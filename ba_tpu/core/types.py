@@ -1,0 +1,41 @@
+"""Command encoding shared by the whole framework.
+
+The reference passes the strings "attack"/"retreat" on the wire and computes
+the string "undefined" for ties (ba.py:159-195).  On TPU we encode commands as
+int8 lanes so a full (instances x nodes x nodes) vote tensor stays tiny and
+VPU-friendly:
+
+    RETREAT   = 0
+    ATTACK    = 1
+    UNDEFINED = 2   (only ever produced by majority ties, never sent)
+
+The reference tallies any non-"attack" answer as retreat (ba.py:163-167,
+177-181), so on-the-wire values are strictly binary {0, 1}; UNDEFINED appears
+only in majority outputs, mirroring ba.py:188-195.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+COMMAND_DTYPE = jnp.int8
+
+RETREAT = 0
+ATTACK = 1
+UNDEFINED = 2
+
+COMMAND_NAMES = ("retreat", "attack", "undefined")
+
+
+def command_from_name(name: str) -> int:
+    """Map a REPL command string to its int8 code.
+
+    Mirrors the reference's tally rule (ba.py:163-167): anything that is not
+    exactly "attack" counts as retreat.
+    """
+    return ATTACK if name == "attack" else RETREAT
+
+
+def command_name(code: int) -> str:
+    """Inverse mapping, for REPL output (ba.py:389: ``majority={m}``)."""
+    return COMMAND_NAMES[int(code)]

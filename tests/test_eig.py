@@ -1,0 +1,67 @@
+"""OM(m)/EIG properties: reduction to OM(1), IC1/IC2 guarantees."""
+
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from ba_tpu.core import ATTACK, RETREAT, make_state, eig_agreement
+from ba_tpu.core.eig import eig_round
+
+
+def test_m0_trusts_leader():
+    state = make_state(4, 4, order=ATTACK)
+    maj = np.asarray(eig_round(jr.key(0), state, 0))
+    assert np.all(maj == ATTACK)
+
+
+def test_m1_matches_om1_no_faults():
+    from ba_tpu.core import om1_round
+
+    state = make_state(8, 5, order=RETREAT, leader=1)
+    a = np.asarray(eig_round(jr.key(0), state, 1))
+    b = np.asarray(om1_round(jr.key(0), state))
+    assert np.array_equal(a, b)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_m1_one_traitor_matches_om1_properties(seed):
+    # Same guarantees as OM(1): validity with 1 faulty lieutenant, n=4.
+    faulty = jnp.zeros((32, 4), bool).at[:, 3].set(True)
+    state = make_state(32, 4, order=ATTACK, faulty=faulty)
+    maj = np.asarray(eig_round(jr.key(seed), state, 1))
+    assert np.all(maj[:, :3] == ATTACK)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_om3_n10_validity(seed):
+    # BASELINE config #2: OM(3), n=10, 3 traitor lieutenants, honest leader.
+    # IC2 validity: every honest lieutenant decides the leader's order.
+    faulty = jnp.zeros((8, 10), bool).at[:, [3, 6, 9]].set(True)
+    state = make_state(8, 10, order=ATTACK, faulty=faulty)
+    out = eig_agreement(jr.key(seed), state, 3)
+    maj = np.asarray(out["majorities"])
+    honest = [0, 1, 2, 4, 5, 7, 8]
+    assert np.all(maj[:, honest] == ATTACK)
+    # Quorum: 7 honest ATTACK majorities out of 10 voters, needed = 7.
+    assert np.all(np.asarray(out["needed"]) == 7)
+    assert np.all(np.asarray(out["decision"]) == ATTACK)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_om2_faulty_leader_agreement(seed):
+    # IC1 with a faulty *leader* and one faulty lieutenant, n=7, m=2:
+    # n > 3m so all honest lieutenants must agree on some common value.
+    faulty = jnp.zeros((16, 7), bool).at[:, [0, 4]].set(True)
+    state = make_state(16, 7, order=ATTACK, faulty=faulty)
+    maj = np.asarray(eig_round(jr.key(seed), state, 2))
+    honest = [1, 2, 3, 5, 6]
+    assert np.all(maj[:, honest] == maj[:, honest][:, :1])
+
+
+def test_dead_relays_excluded():
+    alive = jnp.ones((4, 6), bool).at[:, 5].set(False)
+    state = make_state(4, 6, order=RETREAT, alive=alive)
+    out = eig_agreement(jr.key(2), state, 2)
+    assert np.all(np.asarray(out["total"]) == 5)
+    assert np.all(np.asarray(out["decision"]) == RETREAT)

@@ -1,0 +1,30 @@
+"""Election-by-lowest-id as a masked argmin (ba.py:126-157)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ba_tpu.core import elect_lowest_id
+
+
+def test_lowest_alive_wins():
+    ids = jnp.asarray([[1, 2, 3, 4], [1, 2, 3, 4]], jnp.int32)
+    alive = jnp.asarray([[True, True, True, True], [False, True, True, True]])
+    leader = np.asarray(elect_lowest_id(ids, alive))
+    assert leader.tolist() == [0, 1]
+
+
+def test_reelection_after_kills():
+    # Kill G1 then G2: leadership passes 0 -> 1 -> 2, deterministically —
+    # the convergence argument of SURVEY.md section 4.3.
+    ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    for killed, expect in [([0], 1), ([0, 1], 2), ([0, 1, 2], 3)]:
+        alive = jnp.ones((1, 4), bool).at[0, jnp.asarray(killed)].set(False)
+        assert int(elect_lowest_id(ids, alive)[0]) == expect
+
+
+def test_unordered_ids():
+    # Ids need not be sorted by index (elastic g-add keeps them ascending in
+    # the reference, ba.py:344-351, but the core must not rely on that).
+    ids = jnp.asarray([[7, 3, 9, 5]], jnp.int32)
+    alive = jnp.ones((1, 4), bool)
+    assert int(elect_lowest_id(ids, alive)[0]) == 1

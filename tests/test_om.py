@@ -1,0 +1,113 @@
+"""OM(1) properties: validity, agreement, fault model (ba.py:159-285)."""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from ba_tpu.core import ATTACK, RETREAT, UNDEFINED, make_state, om1_agreement, om1_round
+
+
+def test_no_faults_everyone_agrees():
+    state = make_state(8, 4, order=ATTACK)
+    out = om1_agreement(jr.key(0), state)
+    assert np.all(np.asarray(out["majorities"]) == ATTACK)
+    assert np.all(np.asarray(out["decision"]) == ATTACK)
+    assert np.all(np.asarray(out["needed"]) == 3)
+    assert np.all(np.asarray(out["total"]) == 4)
+
+
+def test_one_faulty_lieutenant_validity():
+    # n=4, 1 traitor lieutenant (BASELINE config #1): every honest lieutenant
+    # tallies own order + 1 honest + 1 coin -> order always wins 2-1 or 3-0.
+    faulty = jnp.zeros((64, 4), bool).at[:, 2].set(True)
+    state = make_state(64, 4, order=ATTACK, faulty=faulty)
+    for seed in range(5):
+        maj = np.asarray(om1_round(jr.key(seed), state))
+        assert np.all(maj[:, 0] == ATTACK)  # leader: own command (Q1)
+        assert np.all(maj[:, 1] == ATTACK)
+        assert np.all(maj[:, 3] == ATTACK)
+
+
+def test_faulty_leader_agreement():
+    # IC1: with only the leader faulty, all honest lieutenants compute the
+    # same majority (they all see the same round-2 answer multiset).
+    faulty = jnp.zeros((128, 4), bool).at[:, 0].set(True)
+    state = make_state(128, 4, order=ATTACK, faulty=faulty)
+    for seed in range(5):
+        maj = np.asarray(om1_round(jr.key(seed), state))
+        lieutenants = maj[:, 1:]
+        assert np.all(lieutenants == lieutenants[:, :1])
+    # Q1: the faulty leader still reports its true command as its majority.
+    assert np.all(maj[:, 0] == ATTACK)
+
+
+def test_faulty_leader_equivocates():
+    # A faulty leader's round-1 messages differ across recipients in some
+    # instances — the equivocation of ba.py:268-273.
+    from ba_tpu.core.om import round1_broadcast
+
+    faulty = jnp.zeros((256, 8), bool).at[:, 0].set(True)
+    state = make_state(256, 8, order=ATTACK, faulty=faulty)
+    received = np.asarray(round1_broadcast(jr.key(3), state))
+    lieutenants = received[:, 1:]
+    per_instance_varies = (lieutenants != lieutenants[:, :1]).any(axis=1)
+    assert per_instance_varies.any()
+    # Leader's own slot is always the true order (ba.py:261).
+    assert np.all(received[:, 0] == ATTACK)
+
+
+def test_dead_nodes_do_not_vote():
+    # Kill node 3 of 4: lieutenants tally own + 1 peer (leader skipped, dead
+    # skipped) -> still unanimous on the order.
+    alive = jnp.ones((4, 4), bool).at[:, 3].set(False)
+    state = make_state(4, 4, order=RETREAT, alive=alive)
+    out = om1_agreement(jr.key(1), state)
+    assert np.all(np.asarray(out["total"]) == 3)
+    assert np.all(np.asarray(out["needed"]) == 2)
+    assert np.all(np.asarray(out["decision"]) == RETREAT)
+
+
+def test_two_node_quorum_override():
+    # n=2: the lieutenant has only its own vote -> majority = received order;
+    # total=2 -> needed=1 (Q7: a single general can win a 2-node quorum).
+    state = make_state(1, 2, order=ATTACK)
+    out = om1_agreement(jr.key(0), state)
+    assert np.asarray(out["majorities"]).tolist() == [[ATTACK, ATTACK]]
+    assert int(out["needed"][0]) == 1
+    assert int(out["decision"][0]) == ATTACK
+
+
+def test_tie_gives_undefined_majority():
+    # n=3, faulty lieutenant: honest lieutenant tallies own order + the
+    # traitor's coin -> exact tie (UNDEFINED, ba.py:188-195) whenever the
+    # coin disagrees with the order. Over many instances both outcomes occur.
+    faulty = jnp.zeros((512, 3), bool).at[:, 2].set(True)
+    state = make_state(512, 3, order=ATTACK, faulty=faulty)
+    maj = np.asarray(om1_round(jr.key(11), state))[:, 1]
+    assert set(maj.tolist()) == {ATTACK, UNDEFINED}
+
+
+def test_all_dead_cluster_undecided():
+    # A fully-killed cluster must not fabricate a consensus (the reference
+    # crashes before this state is reachable, SURVEY.md Q4).
+    alive = jnp.zeros((1, 3), bool)
+    out = om1_agreement(jr.key(0), make_state(1, 3, order=ATTACK, alive=alive))
+    assert int(out["total"][0]) == 0
+    assert int(out["decision"][0]) == UNDEFINED
+
+
+def test_jit_compiles_once():
+    state = make_state(16, 8, order=ATTACK)
+    fn = jax.jit(om1_agreement)
+    out1 = fn(jr.key(0), state)
+    out2 = fn(jr.key(1), state)
+    assert out1["majorities"].shape == (16, 8)
+    assert out2["decision"].shape == (16,)
+
+
+def test_nonleader_leader_index():
+    # Leader need not be index 0 (post-election clusters, ba.py:126-157).
+    state = make_state(8, 5, order=ATTACK, leader=2)
+    maj = np.asarray(om1_round(jr.key(0), state))
+    assert np.all(maj == ATTACK)
