@@ -1,0 +1,4 @@
+#!/bin/sh
+# Entry point, launch-compatible with the reference's launcher contract
+# (one positional N; extra framework flags pass through).
+exec python3 -m ba_tpu.runtime.main "$@"

@@ -37,9 +37,9 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from ba_tpu.core.om import round1_broadcast
-from ba_tpu.core.quorum import majority_counts, quorum_decision
+from ba_tpu.core.quorum import majority_counts, quorum_decision, strict_majority
 from ba_tpu.core.state import SimState
-from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT
 
 
 def _coin(key: jax.Array, shape) -> jnp.ndarray:
@@ -106,15 +106,13 @@ def eig_resolve(state: SimState, levels: list[jnp.ndarray]) -> jnp.ndarray:
         )
         n_attack = jnp.sum((children == ATTACK) & valid, axis=-1)
         n_retreat = jnp.sum((children == RETREAT) & valid, axis=-1)
-        resolved = jnp.where(
-            n_attack > n_retreat,
-            jnp.asarray(ATTACK, COMMAND_DTYPE),
-            jnp.where(
-                n_retreat > n_attack,
-                jnp.asarray(RETREAT, COMMAND_DTYPE),
-                jnp.asarray(UNDEFINED, COMMAND_DTYPE),
-            ),
-        )
+        resolved = strict_majority(n_attack, n_retreat)
+        # Degenerate clusters (n < m+2): a path can run out of eligible
+        # relays entirely; then the node's own stored copy stands in for the
+        # empty majority — the OM(0) base case of the recursion — instead of
+        # a spurious tie.  Keeps OM(m) consistent with OM(1) on tiny n.
+        n_eligible = jnp.sum(valid, axis=-1)
+        resolved = jnp.where(n_eligible > 0, resolved, levels[level].reshape(B, n, P))
     majorities = resolved.reshape(B, n)
     majorities = jnp.where(is_leader, state.order[:, None], majorities)
     return majorities

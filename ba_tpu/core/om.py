@@ -24,9 +24,9 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
-from ba_tpu.core.quorum import majority_counts, quorum_decision
+from ba_tpu.core.quorum import majority_counts, quorum_decision, strict_majority
 from ba_tpu.core.state import SimState
-from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT
 
 
 def _coin(key: jax.Array, shape) -> jnp.ndarray:
@@ -85,15 +85,7 @@ def tally_majorities(state: SimState, received: jnp.ndarray, answers: jnp.ndarra
     weight = state.alive[:, None, :] & ~is_leader[:, None, :]
     n_attack = jnp.sum((answers == ATTACK) & weight, axis=-1)
     n_retreat = jnp.sum((answers == RETREAT) & weight, axis=-1)
-    majority = jnp.where(
-        n_attack > n_retreat,
-        jnp.asarray(ATTACK, COMMAND_DTYPE),
-        jnp.where(
-            n_retreat > n_attack,
-            jnp.asarray(RETREAT, COMMAND_DTYPE),
-            jnp.asarray(UNDEFINED, COMMAND_DTYPE),
-        ),
-    )
+    majority = strict_majority(n_attack, n_retreat)
     majority = jnp.where(is_leader, state.order[:, None], majority)
     return majority
 

@@ -20,6 +20,23 @@ import jax.numpy as jnp
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED, COMMAND_DTYPE
 
 
+def strict_majority(n_attack: jnp.ndarray, n_retreat: jnp.ndarray) -> jnp.ndarray:
+    """Strict-majority vote: tie -> UNDEFINED (ba.py:188-195).
+
+    The single copy of the core decision rule shared by the OM(1) tally, the
+    EIG resolve, and the node-sharded round.
+    """
+    return jnp.where(
+        n_attack > n_retreat,
+        jnp.asarray(ATTACK, COMMAND_DTYPE),
+        jnp.where(
+            n_retreat > n_attack,
+            jnp.asarray(RETREAT, COMMAND_DTYPE),
+            jnp.asarray(UNDEFINED, COMMAND_DTYPE),
+        ),
+    )
+
+
 def majority_counts(majorities: jnp.ndarray, alive: jnp.ndarray):
     """(n_attack, n_retreat, n_undefined) over alive nodes, per instance.
 

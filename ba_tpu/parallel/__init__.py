@@ -1,0 +1,25 @@
+"""Device-mesh parallelism: the TPU-native communication backend.
+
+The reference's distributed backend is RPyC point-to-point TCP with Python
+``for``-loops as broadcast/gather (SURVEY.md section 2.3, ba.py:159-223).
+Here the same roles are played by XLA collectives over ICI/DCN on a
+``jax.sharding.Mesh``:
+
+- instance axis ("data"): embarrassingly-parallel consensus instances —
+  the 10k-instance sweep of BASELINE.json config #5 (ba_tpu.parallel.sweep);
+- node axis ("node"): generals of ONE large cluster sharded across chips,
+  with ``all_gather``/``psum`` replacing the O(n^2) RPC mesh — the
+  sequence-parallelism analogue for n=1024-scale clusters
+  (ba_tpu.parallel.node_parallel).
+"""
+
+from ba_tpu.parallel.mesh import make_mesh
+from ba_tpu.parallel.sweep import sharded_sweep, make_sweep_state
+from ba_tpu.parallel.node_parallel import om1_node_sharded
+
+__all__ = [
+    "make_mesh",
+    "sharded_sweep",
+    "make_sweep_state",
+    "om1_node_sharded",
+]

@@ -1,0 +1,30 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    shape: tuple[int, ...] | None = None,
+    axis_names: tuple[str, ...] = ("data", "node"),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    Default: all devices on the "data" (instance) axis and a trivial "node"
+    axis — the right layout for fault-pattern sweeps, where instances are
+    independent and ICI bandwidth goes entirely to the batch.  Pass an
+    explicit ``shape`` (e.g. ``(2, 4)``) to give the node axis real chips
+    for large-n single-cluster runs.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n_dev = int(np.prod(shape))
+    devs = np.asarray(devices[:n_dev]).reshape(shape)
+    return Mesh(devs, axis_names)

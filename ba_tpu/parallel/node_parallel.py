@@ -1,0 +1,126 @@
+"""Node-axis parallelism: one huge cluster sharded across chips.
+
+The long-context story of this framework (SURVEY.md section 6): the scaling
+axis is n = generals, and OM(1)'s round-2 answer cube is O(B * n^2) — at
+n=1024 that is the object that must be sharded, exactly like a sequence-
+parallel attention matrix.  Layout:
+
+- receivers (the asker axis i) shard across the mesh's "node" axis;
+- the round-1 ``received`` row [B, n] is replicated via one ``all_gather``
+  (the TPU analogue of the reference's O(n^2) get_order() RPC mesh,
+  ba.py:169-186 — every chip then answers for its receivers locally);
+- quorum counts come back with a single ``psum`` over "node"
+  (the majority-of-majorities gather, ba.py:197-223).
+
+Per-chip memory is O(B * n * n/devices); ICI traffic is O(B * n) — the
+all-to-all never materialises across chips.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ba_tpu.core.quorum import quorum_decision, strict_majority
+from ba_tpu.core.state import SimState
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+
+# Compiled-program cache keyed by (mesh, n): rebuilding the shard_map
+# closure per call would re-trace and recompile every round (~2 s each on
+# the 8-device CPU mesh) — repeated rounds must hit the pjit cache.
+_COMPILED: dict = {}
+
+
+def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
+    """OM(1) agreement with generals sharded over the "node" mesh axis.
+
+    state: SimState with batch B (sharded over "data") and n divisible by
+    the node-axis size.  Returns the ``om1_agreement``-style dict with
+    ``majorities`` sharded [B, n] and replicated quorum outputs.
+    """
+    B, n = state.faulty.shape
+    n_node = mesh.shape["node"]
+    assert n % n_node == 0, f"n={n} must divide node axis {n_node}"
+
+    def shard_fn(key, order, leader, faulty, alive):
+        # Shapes in here are per-shard: order/leader [b], faulty/alive
+        # [b, n] (replicated node axis), receivers i owned: n_local.
+        node_idx = jax.lax.axis_index("node")
+        data_idx = jax.lax.axis_index("data")
+        b = order.shape[0]
+        n_local = n // n_node
+        i_global = node_idx * n_local + jnp.arange(n_local)  # [n_local]
+
+        # Round 1 (replicated): same key on every node shard -> every chip
+        # derives the identical received row, no broadcast needed beyond
+        # the scalar order. Coins keyed per data shard only.
+        k_r1 = jr.fold_in(key, data_idx)
+        coins1 = jr.randint(k_r1, (b, n), 0, 2, dtype=COMMAND_DTYPE)
+        leader_faulty = jnp.take_along_axis(faulty, leader[:, None], axis=1)
+        received = jnp.where(leader_faulty, coins1, order[:, None])
+        is_leader_j = jnp.arange(n)[None, :] == leader[:, None]  # [b, n]
+        received = jnp.where(is_leader_j, order[:, None], received)
+
+        # Round 2 (sharded): this chip answers only for its receivers.
+        # Fresh coins per (receiver, responder) pair, keyed per (data,
+        # node) shard so draws are distinct across chips.
+        k_r2 = jr.fold_in(jr.fold_in(key, node_idx + 1000), data_idx)
+        coins2 = jr.randint(k_r2, (b, n_local, n), 0, 2, dtype=COMMAND_DTYPE)
+        answers = jnp.where(faulty[:, None, :], coins2, received[:, None, :])
+        own = i_global[None, :, None] == jnp.arange(n)[None, None, :]
+        answers = jnp.where(own, received[:, None, :], answers)
+
+        weight = alive[:, None, :] & ~is_leader_j[:, None, :]
+        n_att = jnp.sum((answers == ATTACK) & weight, axis=-1)
+        n_ret = jnp.sum((answers == RETREAT) & weight, axis=-1)
+        maj = strict_majority(n_att, n_ret)
+        is_leader_local = i_global[None, :] == leader[:, None]
+        maj = jnp.where(is_leader_local, order[:, None], maj)
+
+        # Quorum: local partial counts, then one psum over the node axis —
+        # the majority-of-majorities gather (ba.py:197-223) on ICI.
+        alive_local = jnp.take(alive, i_global, axis=1)
+        att = jnp.sum((maj == ATTACK) & alive_local, axis=-1)
+        ret = jnp.sum((maj == RETREAT) & alive_local, axis=-1)
+        und = jnp.sum((maj == UNDEFINED) & alive_local, axis=-1)
+        att, ret, und = jax.lax.psum((att, ret, und), "node")
+        decision, needed, total = quorum_decision(att, ret, und)
+        return maj, decision, needed, total, att, ret, und
+
+    cache_key = (mesh, n)
+    if cache_key not in _COMPILED:
+        f = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(
+                P(),  # key (replicated)
+                P("data"),  # order
+                P("data"),  # leader
+                P("data", None),  # faulty: node axis replicated
+                P("data", None),  # alive
+            ),
+            out_specs=(
+                P("data", "node"),  # majorities
+                P("data"),
+                P("data"),
+                P("data"),
+                P("data"),
+                P("data"),
+                P("data"),
+            ),
+        )
+        _COMPILED[cache_key] = jax.jit(f)
+    maj, decision, needed, total, att, ret, und = _COMPILED[cache_key](
+        key, state.order, state.leader, state.faulty, state.alive
+    )
+    return {
+        "majorities": maj,
+        "decision": decision,
+        "needed": needed,
+        "total": total,
+        "n_attack": att,
+        "n_retreat": ret,
+        "n_undefined": und,
+    }

@@ -1,0 +1,123 @@
+"""Instance-axis data parallelism: massively-batched fault-pattern sweeps.
+
+BASELINE.json config #5: "10k-instance sweep over (n in [16,1024], m <= n/3)
+across a TPU slice".  Consensus instances are independent, so the instance
+axis shards across every chip with zero cross-chip traffic during the round
+— ICI is touched only by the final decision histogram (one tiny psum XLA
+inserts automatically when the replicated summary is requested).
+
+The reference runs ONE cluster per OS process (ba.py:354-363); this module
+is the "many independent clusters" scale-out it has no analogue for.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ba_tpu.core.eig import eig_round
+from ba_tpu.core.om import om1_round
+from ba_tpu.core.quorum import majority_counts, quorum_decision
+from ba_tpu.core.state import SimState
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+
+
+def make_sweep_state(
+    key: jax.Array,
+    batch: int,
+    capacity: int,
+    *,
+    min_n: int | None = None,
+    max_traitor_frac: float = 1 / 3,
+    order=ATTACK,
+) -> SimState:
+    """Sample a batch of random (n, fault-pattern) cluster configurations.
+
+    Per instance: cluster size n uniform in [min_n, capacity] (alive = the
+    first n slots, mirroring ascending spawn order ba.py:344-351), then an
+    independent traitor count in [0, floor(n * max_traitor_frac)] assigned to
+    uniformly-random lieutenants.  The leader (slot 0) stays honest so that
+    sweep decisions have a ground truth to validate against; flip extra bits
+    in ``faulty`` for adversarial-leader studies.
+
+    Guarantee note: with an honest leader, OM(m) validity holds when
+    n > 2t + m (a strict honest majority among eligible relays at every
+    resolve level).  The default 1/3 fraction satisfies this for OM(1) with
+    min_n >= 4; pass a tighter ``max_traitor_frac`` for deeper recursions.
+    """
+    if min_n is None:
+        min_n = min(4, capacity)
+    k_n, k_m, k_perm = jr.split(key, 3)
+    idx = jnp.arange(capacity)[None, :]
+    n = jr.randint(k_n, (batch,), min_n, capacity + 1)
+    alive = idx < n[:, None]
+    max_traitors = (n * max_traitor_frac).astype(jnp.int32)
+    n_traitors = jr.randint(k_m, (batch,), 0, max_traitors + 1)
+    # Rank lieutenants by random scores; the lowest n_traitors ranks lie.
+    scores = jr.uniform(k_perm, (batch, capacity))
+    scores = jnp.where(alive & (idx > 0), scores, jnp.inf)
+    order_ids = jnp.argsort(scores, axis=-1)
+    ranks = jnp.argsort(order_ids, axis=-1)
+    faulty = ranks < n_traitors[:, None]
+    return SimState(
+        order=jnp.broadcast_to(jnp.asarray(order, COMMAND_DTYPE), (batch,)),
+        leader=jnp.zeros((batch,), jnp.int32),
+        faulty=faulty,
+        alive=alive,
+        ids=jnp.broadcast_to(
+            jnp.arange(1, capacity + 1, dtype=jnp.int32), (batch, capacity)
+        ),
+    )
+
+
+def agreement_step(keys: jax.Array, state: SimState, m: int = 1):
+    """One agreement round per instance with per-instance PRNG keys.
+
+    The jittable heart of the sweep (and of bench.py): vmapped over the
+    batch so each instance draws independent fault coins — the vectorised
+    analogue of "fresh randomness per RPC call" (ba.py:44-49).
+    """
+
+    def one(k, order, leader, faulty, alive, ids):
+        st = SimState(order[None], leader[None], faulty[None], alive[None], ids[None])
+        maj = om1_round(k, st) if m == 1 else eig_round(k, st, m)
+        return maj[0]
+
+    majorities = jax.vmap(one)(
+        keys, state.order, state.leader, state.faulty, state.alive, state.ids
+    )
+    n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
+    decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
+    histogram = jnp.stack(
+        [
+            jnp.sum(decision == RETREAT),
+            jnp.sum(decision == ATTACK),
+            jnp.sum(decision == UNDEFINED),
+        ]
+    )
+    return {
+        "majorities": majorities,
+        "decision": decision,
+        "needed": needed,
+        "total": total,
+        "histogram": histogram,
+    }
+
+
+def sharded_sweep(mesh: Mesh, key: jax.Array, state: SimState, m: int = 1):
+    """Run one agreement round per instance, instances sharded over ``mesh``.
+
+    The state's batch axis is laid out on the mesh's "data" axis; every
+    per-instance output stays sharded, and only the 3-bin decision histogram
+    is replicated (the lone collective).
+    """
+    state = jax.tree.map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+        ),
+        state,
+    )
+    keys = jax.device_put(jr.split(key, state.batch), NamedSharding(mesh, P("data")))
+    return jax.jit(agreement_step, static_argnames="m")(keys, state, m=m)

@@ -1,0 +1,141 @@
+"""Execution backends for the interactive cluster.
+
+Two interchangeable engines behind ``Cluster``:
+
+- :class:`JaxBackend` — the TPU path.  Pads the roster to a power-of-two
+  capacity (so elastic ``g-add``/``g-kill`` reuses compiled programs instead
+  of recompiling per membership change) and runs the jitted batched core
+  with B=1.  The same core scales to thousands of instances in
+  ``ba_tpu.parallel``.
+- :class:`PyBackend` — a deliberately boring sequential-Python oracle with
+  the exact reference semantics (ba.py:159-195, 258-285), used for
+  differential testing of the tensorised core and for running the REPL
+  without JAX at all.
+
+Both draw faults from seeded RNG (the reference uses ``random.randint`` per
+RPC call, ba.py:44-49, 268-273 — unseeded; we make it reproducible).
+"""
+
+from __future__ import annotations
+
+import random
+
+from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
+
+
+class PyBackend:
+    """Sequential oracle: one cluster, plain loops, stdlib RNG only."""
+
+    def run_round(self, generals, leader_idx, order_code, seed):
+        rng = random.Random(seed)
+        n = len(generals)
+        alive = [g.alive for g in generals]
+        faulty = [g.faulty for g in generals]
+
+        # Round 1: push. A faulty leader flips a coin per recipient
+        # (equivocation, ba.py:268-273); the leader keeps the true order.
+        received = []
+        for i in range(n):
+            if i == leader_idx or not faulty[leader_idx]:
+                received.append(order_code)
+            else:
+                received.append(rng.randint(0, 1))
+
+        # Round 2: pull. Each lieutenant tallies its own received command
+        # plus every other alive non-primary general's answer; faulty
+        # responders coin-flip per query (ba.py:159-186, 44-49).
+        majorities = []
+        for i in range(n):
+            if i == leader_idx:
+                majorities.append(order_code)  # ba.py:284-285 (Q1)
+                continue
+            if not alive[i]:
+                majorities.append(UNDEFINED)
+                continue
+            n_attack = n_retreat = 0
+            for j in range(n):
+                if j == leader_idx or not alive[j]:
+                    continue
+                if j == i:
+                    vote = received[i]
+                elif faulty[j]:
+                    vote = rng.randint(0, 1)
+                else:
+                    vote = received[j]
+                if vote == ATTACK:
+                    n_attack += 1
+                else:
+                    n_retreat += 1
+            if n_attack > n_retreat:
+                majorities.append(ATTACK)
+            elif n_retreat > n_attack:
+                majorities.append(RETREAT)
+            else:
+                majorities.append(UNDEFINED)
+        return majorities
+
+
+class JaxBackend:
+    """The batched TPU core behind a B=1 interactive facade."""
+
+    def __init__(self, platform: str | None = None, m: int = 1):
+        import jax
+
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        self._jax = jax
+        self.m = m
+        self._compiled = {}  # capacity -> jitted fn
+
+    @staticmethod
+    def _capacity(n: int) -> int:
+        cap = 4
+        while cap < n:
+            cap *= 2
+        return cap
+
+    def _fn(self, capacity: int):
+        if capacity not in self._compiled:
+            import jax
+
+            from ba_tpu.core.eig import eig_round
+            from ba_tpu.core.om import om1_round
+
+            m = self.m
+
+            def step(key, state):
+                if m == 1:
+                    return om1_round(key, state)
+                return eig_round(key, state, m)
+
+            self._compiled[capacity] = jax.jit(step)
+        return self._compiled[capacity]
+
+    def run_round(self, generals, leader_idx, order_code, seed):
+        import jax.numpy as jnp
+        import jax.random as jr
+        import numpy as np
+
+        from ba_tpu.core.state import SimState
+        from ba_tpu.core.types import COMMAND_DTYPE
+
+        n = len(generals)
+        cap = self._capacity(n)
+        # Stage on host, transfer once — per-element .at[].set() would
+        # dispatch O(n) device scatters per interactive round.
+        faulty = np.zeros((1, cap), np.bool_)
+        alive = np.zeros((1, cap), np.bool_)
+        ids = np.zeros((1, cap), np.int32)
+        for i, g in enumerate(generals):
+            faulty[0, i] = g.faulty
+            alive[0, i] = g.alive
+            ids[0, i] = g.id
+        state = SimState(
+            order=jnp.full((1,), order_code, COMMAND_DTYPE),
+            leader=jnp.full((1,), leader_idx, jnp.int32),
+            faulty=jnp.asarray(faulty),
+            alive=jnp.asarray(alive),
+            ids=jnp.asarray(ids),
+        )
+        maj = self._fn(cap)(jr.key(seed), state)
+        return [int(v) for v in maj[0, :n]]

@@ -1,0 +1,200 @@
+"""Host-side cluster: membership registry, election-for-life, fault flags.
+
+This is the stateful shell around the pure consensus core — the TPU-native
+replacement for the reference's thread-per-general runtime (ba.py:66-122,
+344-351).  Threads, sockets and 0.1 s polling loops disappear; their
+*semantics* stay:
+
+- Generals get ascending ids from 1 and "ports" from 18812 (ba.py:344-351) —
+  ports are vestigial here (no TCP) but kept so `List`/diagnostics match.
+- Election is for life, by lowest id among the living (ba.py:124-157): the
+  leader only changes when the current one is killed, which the reference
+  detects by a 0.1 s TCP ping (ba.py:306-314) and we detect by an event-driven
+  ``tick()`` after every membership change — same converged outcome, no race
+  window (the reference's Q5 assert-crash cannot happen here).
+- New generals adopt the existing leader (discovery, ba.py:86-102) and never
+  trigger an election while one is alive.
+- Killed generals leave the roster (ba.py:415-425); their slots stay in the
+  core's ``alive`` mask so tensor shapes remain static between recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ba_tpu.core.quorum import quorum_threshold_py
+from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED, COMMAND_NAMES, command_from_name
+
+BASE_PORT = 18812  # rpyc's default port, kept for display parity (ba.py:355)
+
+
+@dataclasses.dataclass
+class General:
+    """Roster entry — the host-visible face of one general."""
+
+    id: int
+    port: int
+    faulty: bool = False
+    alive: bool = True
+
+
+@dataclasses.dataclass
+class RoundResult:
+    """Everything ``actual-order`` needs to print (ba.py:383-399)."""
+
+    per_general: list  # (id, is_primary, majority_str, faulty)
+    nr_faulty: int
+    n_attack: int
+    n_retreat: int
+    n_undefined: int
+    needed: int
+    total: int
+    decision: str  # "attack" | "retreat" | "undefined"
+
+
+class Cluster:
+    """B=1 interactive cluster with elastic membership.
+
+    ``backend`` provides ``run_round(generals, leader_idx, order_code, seed)
+    -> list[int]`` returning each roster general's majority code; the JAX
+    backend batches this same function over thousands of clusters in the
+    sweep API (ba_tpu.parallel).
+    """
+
+    def __init__(self, n: int, backend, seed: int = 0):
+        self.backend = backend
+        self.seed = seed
+        self._round = 0
+        self.generals: list[General] = []
+        self._next_id = 1
+        self.leader_id: int | None = None
+        self.add(n)
+
+    # -- membership ---------------------------------------------------------
+
+    def add(self, count: int) -> None:
+        """Spawn ``count`` generals with the next ids/ports (ba.py:427-437).
+
+        Joiners discover the current leader and do not disturb it
+        (ba.py:86-102); if the cluster had no leader a tick elects one.
+        """
+        for _ in range(count):
+            gid = self._next_id
+            self._next_id += 1
+            self.generals.append(General(id=gid, port=BASE_PORT + gid - 1))
+        self.tick()
+
+    def kill(self, gid: int) -> bool:
+        """Kill by id (ba.py:415-425). Returns False if no such general."""
+        g = self.find(gid)
+        if g is None or not g.alive:
+            return False
+        g.alive = False
+        self.generals = [x for x in self.generals if x.alive]
+        self.tick()
+        return True
+
+    def set_faulty(self, gid: int, faulty: bool) -> bool:
+        """Live fault injection (``g-state <id> faulty``, ba.py:401-407)."""
+        g = self.find(gid)
+        if g is None:
+            return False
+        g.faulty = faulty
+        return True
+
+    def find(self, gid: int):
+        for g in self.generals:
+            if g.id == gid:
+                return g
+        return None
+
+    def tick(self) -> None:
+        """Failure detection + election, event-driven.
+
+        The reference's per-general 0.1 s ping loop (ba.py:306-314) exists to
+        notice a dead leader and re-elect; with a host-side registry the same
+        transition is a lookup.  Election is for life (ba.py:124-125): a
+        living leader is never displaced.
+        """
+        alive = [g for g in self.generals if g.alive]
+        if not alive:
+            self.leader_id = None
+            return
+        if self.leader_id is None or self.find(self.leader_id) is None:
+            self.leader_id = min(g.id for g in alive)
+
+    @property
+    def leader(self):
+        return self.find(self.leader_id) if self.leader_id is not None else None
+
+    # -- the agreement round ------------------------------------------------
+
+    def actual_order(self, command: str) -> RoundResult | None:
+        """One full agreement round: the ``actual-order`` hot path.
+
+        Round semantics live in the backend (tensorised in ba_tpu.core); this
+        method reproduces the REPL-level bookkeeping of ba.py:376-399 +
+        ba.py:197-255: per-general majorities, the faulty count, and the
+        majority-of-majorities quorum.
+
+        String-parity quirk: the reference ships the raw command string, so
+        the *leader's* reported majority is that raw string even when it is
+        neither "attack" nor "retreat" (ba.py:284-285) — and the quorum then
+        buckets it as n_undefined (ba.py:208-215).  Lieutenants only ever see
+        attack/retreat (anything non-"attack" tallies as retreat,
+        ba.py:163-167).
+        """
+        if not self.generals:
+            return None  # the reference would crash here (SURVEY.md Q4)
+        self.tick()
+        order_code = command_from_name(command)
+        leader_idx = next(
+            i for i, g in enumerate(self.generals) if g.id == self.leader_id
+        )
+        majorities = self.backend.run_round(
+            self.generals, leader_idx, order_code, self._round_seed()
+        )
+        self._round += 1
+
+        per_general = []
+        n_attack = n_retreat = n_undefined = 0
+        nr_faulty = 0
+        for i, g in enumerate(self.generals):
+            is_primary = i == leader_idx
+            if is_primary:
+                maj_str = command  # raw string passthrough (ba.py:284-285)
+                bucket = {"attack": ATTACK, "retreat": RETREAT}.get(command, UNDEFINED)
+            else:
+                maj_str = COMMAND_NAMES[majorities[i]]
+                bucket = majorities[i]
+            if bucket == ATTACK:
+                n_attack += 1
+            elif bucket == RETREAT:
+                n_retreat += 1
+            else:
+                n_undefined += 1
+            if g.faulty:
+                nr_faulty += 1
+            per_general.append((g.id, is_primary, maj_str, g.faulty))
+
+        total = n_attack + n_retreat + n_undefined
+        needed = quorum_threshold_py(total)
+        if needed <= n_retreat:  # retreat first: ties prefer retreat (Q7)
+            decision = "retreat"
+        elif needed <= n_attack:
+            decision = "attack"
+        else:
+            decision = "undefined"
+        return RoundResult(
+            per_general=per_general,
+            nr_faulty=nr_faulty,
+            n_attack=n_attack,
+            n_retreat=n_retreat,
+            n_undefined=n_undefined,
+            needed=needed,
+            total=total,
+            decision=decision,
+        )
+
+    def _round_seed(self) -> int:
+        return (self.seed << 20) ^ self._round

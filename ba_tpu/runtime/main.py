@@ -1,0 +1,64 @@
+"""CLI entry point: ``python -m ba_tpu.runtime.main N [--backend ...]``.
+
+Launch-compatible with the reference's one-positional-arg contract
+(Generals_Byzantine_program.sh:1 -> ba.py:12) and extends it with the
+framework flags promised by BASELINE.json's north star: ``--backend=tpu``
+swaps the sequential Python loop for the JAX path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_cluster(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ba-tpu",
+        description="TPU-native Byzantine Generals simulator",
+    )
+    parser.add_argument("n", type=int, help="initial number of generals")
+    parser.add_argument(
+        "--backend",
+        choices=["tpu", "py"],
+        default="tpu",
+        help="tpu: batched JAX core; py: sequential Python oracle",
+    )
+    parser.add_argument(
+        "--platform",
+        default=None,
+        help="force a JAX platform (e.g. cpu) for the tpu backend",
+    )
+    parser.add_argument("--seed", type=int, default=0, help="fault-coin RNG seed")
+    parser.add_argument(
+        "-m",
+        type=int,
+        default=1,
+        dest="m",
+        help="OM recursion depth (1 = the reference's protocol)",
+    )
+    args = parser.parse_args(argv)
+
+    from ba_tpu.runtime.cluster import Cluster
+
+    if args.backend == "py":
+        from ba_tpu.runtime.backends import PyBackend
+
+        backend = PyBackend()
+    else:
+        from ba_tpu.runtime.backends import JaxBackend
+
+        backend = JaxBackend(platform=args.platform, m=args.m)
+    return Cluster(args.n, backend, seed=args.seed)
+
+
+def main(argv=None) -> int:
+    cluster = build_cluster(argv)
+    from ba_tpu.runtime.repl import run_repl
+
+    run_repl(cluster, sys.stdin, print)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

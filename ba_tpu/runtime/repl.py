@@ -1,0 +1,114 @@
+"""The interactive REPL: six commands, byte-identical output.
+
+Command surface and exact output formats follow SURVEY.md section 3.1
+(reference ba.py:354-445):
+
+- ``actual-order <cmd>`` — run one agreement round, print every general's
+  line ``G{id}, {primary|secondary}, majority={m}, state={F|NF}`` then the
+  ``Execute order: ...`` quorum line (ba.py:383-399, 237-255).
+- ``g-state`` / ``g-state <id> <faulty|non-faulty>`` — show / set fault
+  flags (ba.py:401-413); with three tokens the role column is omitted and
+  any third token other than "faulty" means non-faulty.
+- ``g-kill <id>`` — remove a general (ba.py:415-425).
+- ``g-add <n>`` — spawn n more (ba.py:427-437).
+- ``List`` — ``P{id}, {True|False}`` primary flags (ba.py:439-445).
+- ``Exit`` — leave the loop (ba.py:373-374).
+
+Divergences (all guarded crashes in the reference, documented in SURVEY.md
+section 3.3): unknown ids and an empty cluster are ignored instead of
+raising (Q4), and ``actual-order`` immediately after killing the leader
+cannot hit a not-yet-reelected assert (Q5) because election here is
+event-driven.
+"""
+
+from __future__ import annotations
+
+from ba_tpu.runtime.cluster import Cluster
+
+
+def _fmt_state(faulty: bool) -> str:
+    return "F" if faulty else "NF"
+
+
+def quorum_line(res) -> str:
+    """The ``Execute order: ...`` line, exactly as ba.py:237-255 builds it."""
+    quorum_text = f"{res.needed} out of {res.total} quorum suggests"
+    quorum_fail = f"{res.n_undefined} out of {res.total} quorum not consistent"
+    faulty_text = "Non-faulty nodes in the system"
+    if res.nr_faulty > 0:
+        faulty_text = f"{res.nr_faulty} faulty node(s) in the system"
+    if res.decision == "retreat":
+        decision = f"retreat! {faulty_text} - {quorum_text} retreat"
+    elif res.decision == "attack":
+        decision = f"attack! {faulty_text} - {quorum_text} attack"
+    else:
+        decision = (
+            "cannot be determined - not enough generals in the system! "
+            f"{faulty_text} - {quorum_fail}"
+        )
+    return f"Execute order: {decision}"
+
+
+def handle_command(cluster: Cluster, line: str, out) -> bool:
+    """Dispatch one REPL line.  Returns False when the loop should stop."""
+    cmd = line.split(" ")
+    command = cmd[0]
+
+    if command == "Exit":
+        return False
+
+    elif command == "actual-order":
+        if len(cmd) == 1:
+            return True
+        res = cluster.actual_order(cmd[1])
+        if res is None:
+            return True
+        for gid, is_primary, maj, faulty in res.per_general:
+            status = "primary" if is_primary else "secondary"
+            out(f"G{gid}, {status}, majority={maj}, state={_fmt_state(faulty)}")
+        out(quorum_line(res))
+
+    elif command == "g-state":
+        if len(cmd) == 3:
+            try:
+                gid = int(cmd[1])
+            except ValueError:
+                return True
+            # Any third token other than "faulty" means non-faulty
+            # (ba.py:407).
+            if not cluster.set_faulty(gid, cmd[2] == "faulty"):
+                return True
+        for g in cluster.generals:
+            primarity = ", primary" if g.id == cluster.leader_id else ", secondary"
+            primarity = primarity if len(cmd) != 3 else ""
+            out(f"G{g.id}{primarity}, state={_fmt_state(g.faulty)}")
+
+    elif command == "g-kill":
+        if len(cmd) == 1:
+            return True
+        try:
+            gid = int(cmd[1])
+        except ValueError:
+            return True
+        cluster.kill(gid)
+
+    elif command == "g-add":
+        if len(cmd) == 1:
+            return True
+        try:
+            count = int(cmd[1])
+        except ValueError:
+            return True
+        cluster.add(count)
+
+    elif command == "List":
+        for g in cluster.generals:
+            out(f"P{g.id}, {g.id == cluster.leader_id}")
+
+    return True
+
+
+def run_repl(cluster: Cluster, stdin, out) -> None:
+    for line in stdin:
+        if not handle_command(cluster, line.rstrip("\n"), out):
+            break

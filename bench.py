@@ -1,0 +1,79 @@
+"""Benchmark: agreement-rounds/sec on the reference's own headline case.
+
+Workload: BASELINE.json config #1 — OM(1), n=4 generals, 1 traitor
+lieutenant — batched over 131072 independent consensus instances on one
+chip.  The reference's ceiling for the same case is ~10 rounds/sec: its
+``wait_majority`` polls at 0.1 s (ba.py:287-289) and the run-loop tick adds
+another 0.1 s (ba.py:301), so one agreement can never finish faster than a
+tick; ``vs_baseline`` is measured against that 10 rounds/sec floor.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+REFERENCE_ROUNDS_PER_SEC = 10.0  # 0.1 s poll floor, ba.py:287-301
+
+
+def main() -> None:
+    platform = os.environ.get("BA_TPU_BENCH_PLATFORM")
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ba_tpu.core import make_state, om1_agreement
+    from ba_tpu.core.types import ATTACK
+
+    batch = int(os.environ.get("BA_TPU_BENCH_BATCH", 131072))
+    n = 4
+    faulty = jnp.zeros((batch, n), bool).at[:, 2].set(True)
+    state = make_state(batch, n, order=ATTACK, faulty=faulty)
+
+    @jax.jit
+    def round_fn(key, state):
+        out = om1_agreement(key, state)
+        # Reduce to a tiny result so timing measures the round, not D2H.
+        return (
+            out["decision"].astype(jnp.int32).sum(),
+            out["needed"].sum(),
+        )
+
+    key = jr.key(0)
+    # Warmup / compile.
+    jax.block_until_ready(round_fn(key, state))
+
+    iters = 30
+    t0 = time.perf_counter()
+    for i in range(iters):
+        res = round_fn(jr.fold_in(key, i), state)
+    jax.block_until_ready(res)
+    elapsed = time.perf_counter() - t0
+
+    rounds_per_sec = batch * iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "agreement-rounds/sec",
+                "value": round(rounds_per_sec, 1),
+                "unit": "rounds/s (OM(1), n=4, 1 traitor, B=%d)" % batch,
+                "vs_baseline": round(rounds_per_sec / REFERENCE_ROUNDS_PER_SEC, 1),
+                "platform": jax.devices()[0].platform,
+                "batch": batch,
+                "iters": iters,
+                "elapsed_s": round(elapsed, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Differential tests: JAX tensor core vs the sequential Python oracle.
+
+The PyBackend is a loop-for-loop transcription of the reference's semantics
+(SURVEY.md section 3.2); agreement between the two engines on every
+deterministic case is the parity argument for the tensorised core.
+"""
+
+import pytest
+
+from ba_tpu.runtime.backends import JaxBackend, PyBackend
+from ba_tpu.runtime.cluster import Cluster
+from ba_tpu.runtime.repl import handle_command
+
+
+def drive(cluster, lines):
+    out = []
+    for line in lines:
+        if not handle_command(cluster, line, out.append):
+            break
+    return out
+
+
+SCRIPTS = [
+    ["actual-order attack"],
+    ["actual-order retreat"],
+    ["g-state 3 faulty", "actual-order attack"],
+    ["g-kill 2", "actual-order retreat"],
+    ["g-kill 1", "g-add 1", "actual-order attack", "List"],
+    ["g-state 2 faulty", "g-state 4 faulty", "actual-order retreat"],
+    ["actual-order charge"],
+]
+
+
+@pytest.mark.parametrize("script", SCRIPTS, ids=[" ".join(s)[:40] for s in SCRIPTS])
+def test_backends_agree_deterministic(script):
+    # Every script here has deterministic output (enough honest generals
+    # that traitor coins cannot flip any majority).
+    out_py = drive(Cluster(5, PyBackend(), seed=7), script)
+    out_jax = drive(Cluster(5, JaxBackend(platform="cpu"), seed=7), script)
+    assert out_py == out_jax
+
+
+def test_backends_agree_om3():
+    # OM(3) via the EIG tree vs OM(1): identical on fault-free clusters.
+    script = ["actual-order attack", "g-kill 3", "actual-order retreat"]
+    out_m1 = drive(Cluster(6, JaxBackend(platform="cpu", m=1), seed=1), script)
+    out_m3 = drive(Cluster(6, JaxBackend(platform="cpu", m=3), seed=1), script)
+    out_py = drive(Cluster(6, PyBackend(), seed=1), script)
+    assert out_m1 == out_m3 == out_py
+
+
+def test_faulty_leader_agreement_property():
+    # With a faulty leader both engines must keep all honest lieutenants in
+    # agreement with each other (IC1), though the agreed value is random.
+    for seed in range(6):
+        for backend in (PyBackend(), JaxBackend(platform="cpu")):
+            cluster = Cluster(5, backend, seed=seed)
+            drive(cluster, ["g-state 1 faulty"])
+            res = cluster.actual_order("attack")
+            lieutenant_majorities = {
+                maj for (_, is_primary, maj, _) in res.per_general if not is_primary
+            }
+            assert len(lieutenant_majorities) == 1
+
+
+def test_jax_backend_capacity_reuse():
+    # g-add within the padded capacity must not recompile; crossing a
+    # power-of-two boundary compiles exactly one new program.
+    backend = JaxBackend(platform="cpu")
+    cluster = Cluster(3, backend, seed=0)
+    drive(cluster, ["actual-order attack"])
+    assert set(backend._compiled) == {4}
+    drive(cluster, ["g-add 1", "actual-order attack"])
+    assert set(backend._compiled) == {4}
+    drive(cluster, ["g-add 1", "actual-order attack"])
+    assert set(backend._compiled) == {4, 8}

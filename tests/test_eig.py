@@ -59,6 +59,19 @@ def test_om2_faulty_leader_agreement(seed):
     assert np.all(maj[:, honest] == maj[:, honest][:, :1])
 
 
+@pytest.mark.parametrize("n", [2, 3])
+@pytest.mark.parametrize("m", [2, 3])
+def test_deep_recursion_tiny_cluster_matches_om1(n, m):
+    # n < m+2 runs out of relays: the resolve must fall back to the OM(0)
+    # base case, not report a spurious tie (matches OM(1) on honest nodes).
+    from ba_tpu.core import om1_round
+
+    state = make_state(4, n, order=ATTACK)
+    deep = np.asarray(eig_round(jr.key(0), state, m))
+    om1 = np.asarray(om1_round(jr.key(0), state))
+    assert np.array_equal(deep, om1)
+
+
 def test_dead_relays_excluded():
     alive = jnp.ones((4, 6), bool).at[:, 5].set(False)
     state = make_state(4, 6, order=RETREAT, alive=alive)

@@ -1,0 +1,98 @@
+"""Sharding tests on the 8-device virtual CPU mesh (SURVEY.md section 5)."""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from ba_tpu.core import ATTACK, RETREAT, make_state
+from ba_tpu.parallel import (
+    make_mesh,
+    make_sweep_state,
+    om1_node_sharded,
+    sharded_sweep,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh42(eight_devices):
+    return make_mesh((4, 2), ("data", "node"))
+
+
+@pytest.fixture(scope="module")
+def mesh8(eight_devices):
+    return make_mesh((8, 1), ("data", "node"))
+
+
+def test_sweep_state_shapes():
+    state = make_sweep_state(jr.key(0), 64, 16)
+    assert state.faulty.shape == (64, 16)
+    n_alive = np.asarray(state.alive).sum(-1)
+    assert (n_alive >= 4).all() and (n_alive <= 16).all()
+    # Leader honest, traitors only among alive lieutenants, <= n/3.
+    f = np.asarray(state.faulty)
+    assert not f[:, 0].any()
+    assert (f & ~np.asarray(state.alive)).sum() == 0
+    assert (f.sum(-1) <= n_alive // 3).all()
+
+
+def test_sharded_sweep_all_decide_order(mesh8):
+    # Honest leader + traitors <= (n-1)/3 per instance: every instance's
+    # quorum must decide the ordered command (IC1+IC2 at sweep scale).
+    state = make_sweep_state(jr.key(1), 256, 16, order=ATTACK)
+    out = sharded_sweep(mesh8, jr.key(2), state, m=1)
+    hist = np.asarray(out["histogram"])
+    assert hist.tolist() == [0, 256, 0]
+    assert (np.asarray(out["decision"]) == ATTACK).all()
+
+
+def test_sharded_sweep_om2(mesh8):
+    # OM(m) validity needs n > 2t + m (majority of honest eligible relays
+    # at every resolve level), so cap traitors at n/4 for m=2, n=8.
+    state = make_sweep_state(
+        jr.key(3), 64, 8, min_n=8, max_traitor_frac=0.25, order=RETREAT
+    )
+    out = sharded_sweep(mesh8, jr.key(4), state, m=2)
+    assert np.asarray(out["histogram"]).tolist() == [64, 0, 0]
+
+
+def test_node_sharded_matches_dense(mesh42):
+    # No faults: node-sharded OM(1) must agree exactly with the dense core.
+    from ba_tpu.core import om1_agreement
+
+    state = make_state(8, 16, order=ATTACK)
+    sharded = om1_node_sharded(mesh42, jr.key(5), state)
+    dense = jax.jit(om1_agreement)(jr.key(5), state)
+    assert (np.asarray(sharded["majorities"]) == ATTACK).all()
+    assert np.array_equal(
+        np.asarray(sharded["decision"]), np.asarray(dense["decision"])
+    )
+    assert np.array_equal(np.asarray(sharded["total"]), np.asarray(dense["total"]))
+
+
+def test_node_sharded_dead_and_faulty(mesh42):
+    # 1 traitor + 1 dead out of 16: validity still deterministic.
+    faulty = jnp.zeros((4, 16), bool).at[:, 5].set(True)
+    alive = jnp.ones((4, 16), bool).at[:, 9].set(False)
+    state = make_state(4, 16, order=RETREAT, faulty=faulty, alive=alive)
+    out = om1_node_sharded(mesh42, jr.key(6), state)
+    maj = np.asarray(out["majorities"])
+    honest = [i for i in range(16) if i not in (5, 9)]
+    assert (maj[:, honest] == RETREAT).all()
+    assert (np.asarray(out["total"]) == 15).all()
+    assert (np.asarray(out["decision"]) == RETREAT).all()
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out["majorities"].shape == (256, 16)
+
+
+def test_graft_entry_dryrun(eight_devices):
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)

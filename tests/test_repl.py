@@ -1,0 +1,181 @@
+"""Golden-output REPL tests: byte-identical strings vs SURVEY.md section 3.1."""
+
+import subprocess
+import sys
+
+import pytest
+
+from ba_tpu.runtime.backends import PyBackend
+from ba_tpu.runtime.cluster import Cluster
+from ba_tpu.runtime.repl import handle_command
+
+
+def drive(cluster, lines):
+    out = []
+    for line in lines:
+        if not handle_command(cluster, line, out.append):
+            break
+    return out
+
+
+@pytest.fixture()
+def cluster4():
+    return Cluster(4, PyBackend(), seed=0)
+
+
+def test_g_state_initial(cluster4):
+    assert drive(cluster4, ["g-state"]) == [
+        "G1, primary, state=NF",
+        "G2, secondary, state=NF",
+        "G3, secondary, state=NF",
+        "G4, secondary, state=NF",
+    ]
+
+
+def test_actual_order_all_honest(cluster4):
+    assert drive(cluster4, ["actual-order attack"]) == [
+        "G1, primary, majority=attack, state=NF",
+        "G2, secondary, majority=attack, state=NF",
+        "G3, secondary, majority=attack, state=NF",
+        "G4, secondary, majority=attack, state=NF",
+        "Execute order: attack! Non-faulty nodes in the system"
+        " - 3 out of 4 quorum suggests attack",
+    ]
+
+
+def test_g_state_set_faulty_drops_role_column(cluster4):
+    assert drive(cluster4, ["g-state 2 faulty"]) == [
+        "G1, state=NF",
+        "G2, state=F",
+        "G3, state=NF",
+        "G4, state=NF",
+    ]
+
+
+def test_actual_order_one_faulty_lieutenant(cluster4):
+    # Deterministic regardless of the traitor's coins: every lieutenant
+    # tallies its own true order plus at least one honest peer.
+    out = drive(cluster4, ["g-state 2 faulty", "actual-order retreat"])
+    assert out[4:] == [
+        "G1, primary, majority=retreat, state=NF",
+        "G2, secondary, majority=retreat, state=F",
+        "G3, secondary, majority=retreat, state=NF",
+        "G4, secondary, majority=retreat, state=NF",
+        "Execute order: retreat! 1 faulty node(s) in the system"
+        " - 3 out of 4 quorum suggests retreat",
+    ]
+
+
+def test_kill_add_list_and_reelection(cluster4):
+    out = drive(
+        cluster4,
+        ["g-kill 1", "List", "g-add 2", "List", "actual-order attack"],
+    )
+    assert out == [
+        "P2, True",
+        "P3, False",
+        "P4, False",
+        "P2, True",
+        "P3, False",
+        "P4, False",
+        "P5, False",
+        "P6, False",
+        "G2, primary, majority=attack, state=NF",
+        "G3, secondary, majority=attack, state=NF",
+        "G4, secondary, majority=attack, state=NF",
+        "G5, secondary, majority=attack, state=NF",
+        "G6, secondary, majority=attack, state=NF",
+        "Execute order: attack! Non-faulty nodes in the system"
+        " - 3 out of 5 quorum suggests attack",
+    ]
+
+
+def test_raw_command_string_passthrough(cluster4):
+    # The leader reports the raw string as its majority (ba.py:284-285);
+    # lieutenants tally non-"attack" as retreat (ba.py:163-167).
+    out = drive(cluster4, ["actual-order foo"])
+    assert out == [
+        "G1, primary, majority=foo, state=NF",
+        "G2, secondary, majority=retreat, state=NF",
+        "G3, secondary, majority=retreat, state=NF",
+        "G4, secondary, majority=retreat, state=NF",
+        "Execute order: retreat! Non-faulty nodes in the system"
+        " - 3 out of 4 quorum suggests retreat",
+    ]
+
+
+def test_single_general_undefined_quorum():
+    # n=1 with a non-attack/retreat order: the leader's raw majority buckets
+    # as undefined, total=1, needed=1 -> "cannot be determined" line
+    # (ba.py:225-255 with the total==1 override).
+    cluster = Cluster(1, PyBackend(), seed=0)
+    out = drive(cluster, ["actual-order foo"])
+    assert out == [
+        "G1, primary, majority=foo, state=NF",
+        "Execute order: cannot be determined - not enough generals in the"
+        " system! Non-faulty nodes in the system - 1 out of 1 quorum not"
+        " consistent",
+    ]
+
+
+def test_guarded_edges_do_not_crash(cluster4):
+    # Unknown ids, empty args, unknown commands, empty cluster (reference
+    # crashes on some of these: SURVEY.md Q4).
+    out = drive(
+        cluster4,
+        [
+            "g-kill",
+            "g-kill 99",
+            "g-state 99 faulty",
+            "g-add",
+            "nonsense",
+            "",
+            "actual-order",
+            "g-kill 1",
+            "g-kill 2",
+            "g-kill 3",
+            "g-kill 4",
+            "List",
+            "actual-order attack",
+            "g-state",
+        ],
+    )
+    assert out == []
+
+
+def test_exit_stops_loop(cluster4):
+    out = drive(cluster4, ["Exit", "g-state"])
+    assert out == []
+
+
+def test_faulty_leader_election_not_disturbed(cluster4):
+    # Fault injection never triggers re-election (election is for life,
+    # ba.py:124-125); only death does.
+    drive(cluster4, ["g-state 1 faulty"])
+    assert cluster4.leader_id == 1
+    drive(cluster4, ["g-kill 1"])
+    assert cluster4.leader_id == 2
+
+
+def test_cli_subprocess_py_backend():
+    """End-to-end through the real launcher contract (stdin -> stdout)."""
+    script = "g-state\nactual-order attack\nExit\n"
+    proc = subprocess.run(
+        [sys.executable, "-m", "ba_tpu.runtime.main", "3", "--backend", "py"],
+        input=script,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.splitlines() == [
+        "G1, primary, state=NF",
+        "G2, secondary, state=NF",
+        "G3, secondary, state=NF",
+        "G1, primary, majority=attack, state=NF",
+        "G2, secondary, majority=attack, state=NF",
+        "G3, secondary, majority=attack, state=NF",
+        "Execute order: attack! Non-faulty nodes in the system"
+        " - 2 out of 3 quorum suggests attack",
+    ]
