@@ -1,0 +1,23 @@
+"""Crypto subsystem: batched Ed25519 for signed Byzantine agreement.
+
+The reference's oral messages (plain strings over RPC, /root/reference/
+ba.py:39-57) carry no authentication; BASELINE.json's north star upgrades
+them to SM(m) *signed* messages with batched Ed25519.  Layers:
+
+- ``oracle``  — pure-Python ground truth (RFC 8032), host-side signing.
+- ``sha512``  — batched SHA-512 as uint32-pair tensor ops.
+- ``field``   — batched GF(2^255-19) in int32 limbs.
+- ``ed25519`` — batched verification, one jittable program.
+"""
+
+from ba_tpu.crypto import field, oracle, sha512
+from ba_tpu.crypto.ed25519 import compress, decompress, verify
+
+__all__ = [
+    "field",
+    "oracle",
+    "sha512",
+    "compress",
+    "decompress",
+    "verify",
+]

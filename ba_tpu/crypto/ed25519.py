@@ -1,0 +1,206 @@
+"""Batched Ed25519 verification as one jittable JAX program.
+
+The hot op of the SM(m) signed-message protocol (BASELINE.json config #3):
+thousands of independent signature checks per agreement round, vectorised
+over the batch axis.  The curve lives in extended twisted-Edwards
+coordinates (X : Y : Z : T), where the a=-1 / d-nonsquare addition law is
+*complete* — one branch-free formula for add and double, which is exactly
+what SIMD lanes and XLA want (no data-dependent control flow anywhere;
+scalar multiplication is a lax.scan over scalar bits with a select).
+
+Verification checks the RFC 8032 equation without cofactor multiplication,
+
+    [S]B == R + [h]A,   h = SHA-512(R || A || M),
+
+matching the pure-Python oracle (ba_tpu.crypto.oracle) bit for bit; the
+oracle and RFC 8032 test vectors are the differential tests.  The 512-bit h
+is used as a scalar directly — no mod-L reduction is needed for
+correctness, and 256 extra ladder steps beat implementing Barrett mod-L on
+the device.
+
+The reference (/root/reference/ba.py) has no signatures; this module is the
+north-star addition that makes oral messages *signed* messages.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ba_tpu.crypto import field as F
+from ba_tpu.crypto.oracle import B_X, B_Y, D, L, P, SQRT_M1
+from ba_tpu.crypto.sha512 import sha512
+
+# -- constants ----------------------------------------------------------------
+
+_D = F.constant(D)
+_D2 = F.constant(2 * D % P)
+_SQRT_M1 = F.constant(SQRT_M1)
+_ONE = F.constant(1)
+_BASE = (
+    F.constant(B_X),
+    F.constant(B_Y),
+    F.constant(1),
+    F.constant(B_X * B_Y % P),
+)
+
+Point = tuple  # (X, Y, Z, T) limb tensors, shapes [..., 22]
+
+
+def identity(shape) -> Point:
+    z = F.zeros(shape)
+    one = jnp.broadcast_to(_ONE, (*shape, F.LIMBS))
+    return (z, one, one, z)
+
+
+def base_point(shape) -> Point:
+    return tuple(jnp.broadcast_to(c, (*shape, F.LIMBS)) for c in _BASE)
+
+
+def point_add(p: Point, q: Point) -> Point:
+    """Complete unified addition (add-2008-hwcd-3, a=-1): 8 muls + 1 small.
+
+    Valid for doubling too; inputs must be carry()-normalized (every mul
+    output is), operands formed as one lazy add/sub of normalized values.
+    """
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = F.mul(F.sub(y1, x1), F.sub(y2, x2))
+    b = F.mul(F.add(y1, x1), F.add(y2, x2))
+    c = F.mul(F.mul(t1, t2), _D2)
+    d = F.mul_small(F.mul(z1, z2), 2)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def point_select(mask: jnp.ndarray, p: Point, q: Point) -> Point:
+    """Per-batch-element select: mask [...] bool -> p where True else q."""
+    m = mask[..., None]
+    return tuple(jnp.where(m, a, b) for a, b in zip(p, q))
+
+
+def scalar_mult(point: Point, bits: jnp.ndarray) -> Point:
+    """[k]P via double-and-add-always: bits [..., nbits] int32, LSB first.
+
+    One lax.scan over the bit axis — 2 complete additions per step, a
+    select instead of a branch.  nbits is static (256 for S, 512 for h).
+    """
+    nbits = bits.shape[-1]
+    bits_t = jnp.moveaxis(bits, -1, 0)  # [nbits, ...]
+
+    def step(state, bit):
+        acc, q = state
+        acc = point_select(bit == 1, point_add(acc, q), acc)
+        return (acc, point_add(q, q)), None
+
+    init = (identity(bits.shape[:-1]), point)
+    (acc, _), _ = jax.lax.scan(step, init, bits_t, length=nbits)
+    return acc
+
+
+def scalar_mult_base(bits: jnp.ndarray) -> Point:
+    return scalar_mult(base_point(bits.shape[:-1]), bits)
+
+
+def point_eq(p: Point, q: Point) -> jnp.ndarray:
+    """Projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1."""
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    return F.eq(F.mul(x1, z2), F.mul(x2, z1)) & F.eq(F.mul(y1, z2), F.mul(y2, z1))
+
+
+def compress(p: Point) -> jnp.ndarray:
+    """Point -> 32-byte encoding (y with the sign of x in the top bit)."""
+    x, y, z, _ = p
+    zi = F.inv(z)
+    xa = F.canonical(F.mul(x, zi))
+    ya = F.canonical(F.mul(y, zi))
+    by = F.to_bytes(ya)
+    sign = (xa[..., 0] & 1).astype(jnp.uint8)
+    return by.at[..., 31].add(sign << 7)
+
+
+def _lt_const(by: jnp.ndarray, bound: int) -> jnp.ndarray:
+    """Little-endian uint8 [..., 32] < bound, lexicographic from the top."""
+    bnd = np.frombuffer(bound.to_bytes(32, "little"), np.uint8)
+    lt = jnp.zeros(by.shape[:-1], bool)
+    eq_so_far = jnp.ones(by.shape[:-1], bool)
+    for i in range(31, -1, -1):
+        bi = by[..., i].astype(jnp.int32)
+        c = int(bnd[i])
+        lt = lt | (eq_so_far & (bi < c))
+        eq_so_far = eq_so_far & (bi == c)
+    return lt
+
+
+def decompress(by: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
+    """32-byte encodings [..., 32] -> (Point, valid mask).
+
+    RFC 8032 5.1.3: y from the low 255 bits (rejected unless y < p), x
+    from x^2 = (y^2-1)/(d y^2+1) via the (p+3)/8 exponent trick, sqrt(-1)
+    correction, sign-bit choice; x == 0 with sign 1 is invalid.  On an
+    invalid mask lane the returned coordinates are garbage — callers must
+    gate on the mask (verify() does).
+    """
+    sign = (by[..., 31] >> 7).astype(jnp.int32)
+    masked = by.at[..., 31].set(by[..., 31] & 0x7F)
+    ok = _lt_const(masked, P)
+    y = F.from_bytes(masked)
+    yy = F.square(y)
+    u = F.sub(yy, jnp.broadcast_to(_ONE, yy.shape))
+    v = F.carry(F.add(F.mul(yy, _D), jnp.broadcast_to(_ONE, yy.shape)))
+    v3 = F.mul(F.square(v), v)
+    v7 = F.mul(F.square(v3), v)
+    t = F.pow_const(F.mul(u, v7), (P - 5) // 8)
+    x = F.mul(F.mul(u, v3), t)
+    vxx = F.mul(v, F.square(x))
+    root1 = F.eq(vxx, u)
+    root2 = F.eq(vxx, F.sub(F.zeros(u.shape[:-1]), u))
+    x = jnp.where(root2[..., None], F.mul(x, _SQRT_M1), x)
+    ok = ok & (root1 | root2)
+    xc = F.canonical(x)
+    x_zero = F.is_zero(xc)
+    ok = ok & ~(x_zero & (sign == 1))
+    flip = (xc[..., 0] & 1) != sign
+    xc = jnp.where(flip[..., None], F.canonical(F.sub(F.zeros(xc.shape[:-1]), xc)), xc)
+    one = jnp.broadcast_to(_ONE, y.shape)
+    return (xc, y, one, F.mul(xc, y)), ok
+
+
+def verify(pk: jnp.ndarray, msg: jnp.ndarray, sig: jnp.ndarray) -> jnp.ndarray:
+    """Batched verify: pk [B, 32], msg [B, L] (L static), sig [B, 64] uint8
+    -> bool [B].  Semantics identical to oracle.verify per lane.
+
+    Graph-size trick: A and R decompress in one 2B call, and [S]B / [h]A
+    run as one 2B double-and-add scan over 512 bits (S zero-padded) —
+    halving the compiled program versus four separate subgraphs, which
+    matters because XLA optimization time grows superlinearly in module
+    size.
+    """
+    B = pk.shape[0]
+    r_enc = sig[..., :32]
+    s_enc = sig[..., 32:]
+    pts, oks = decompress(jnp.concatenate([pk, r_enc], axis=0))
+    a_pt = tuple(c[:B] for c in pts)
+    r_pt = tuple(c[B:] for c in pts)
+    ok_a, ok_r = oks[:B], oks[B:]
+    ok_s = _lt_const(s_enc, L)
+    h_bytes = sha512(jnp.concatenate([r_enc, pk, msg], axis=-1))
+    h_bits = F.bytes_to_bits(h_bytes)  # [B, 512]
+    s_bits = F.bytes_to_bits(s_enc)  # [B, 256]
+    s_bits = jnp.concatenate([s_bits, jnp.zeros_like(s_bits)], axis=-1)
+    bits = jnp.concatenate([s_bits, h_bits], axis=0)  # [2B, 512]
+    points = tuple(
+        jnp.concatenate([b, a], axis=0)
+        for b, a in zip(base_point((B,)), a_pt)
+    )
+    prods = scalar_mult(points, bits)
+    left = tuple(c[:B] for c in prods)
+    ha = tuple(c[B:] for c in prods)
+    right = point_add(r_pt, ha)
+    return ok_a & ok_r & ok_s & point_eq(left, right)

@@ -1,0 +1,246 @@
+"""Batched GF(2^255 - 19) arithmetic in int32 lanes.
+
+TPUs have no 64-bit integer units, so a field element is 22 signed 12-bit
+limbs in int32 lanes: value = sum(limb[i] * 2**(12*i)), shape [..., 22].
+The bounds work out exactly for int32:
+
+- normalized limbs are in [0, 4096); add/sub leave limbs in (-8192, 8192)
+  without carrying;
+- schoolbook multiply of two such values is a 43-limb convolution whose
+  terms are at most 22 * 8191^2 < 1.48e9 < 2^31 — no overflow;
+- the convolution is one [.., 484] x [484, 43] matmul against a static 0/1
+  anti-diagonal matrix, so the hot op is a single fused dot per field mul
+  instead of an unrolled 484-term scalar loop (compiler-friendly: the trace
+  stays tiny and XLA tiles the dot).
+
+Reduction folds limbs >= 22 back with 2^264 = 19 * 2^9 (mod p); carries use
+arithmetic shifts so negative intermediates (from sub) flow through without
+a borrow pass.  Exponentiation (inverse, sqrt) is a lax.scan over exponent
+bits — compiled once, no data-dependent Python control flow.
+
+No counterpart exists in the reference (/root/reference/ba.py has no
+crypto); this implements the BASELINE.json north-star's batched Ed25519.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BITS = 12
+LIMBS = 22
+MASK = (1 << BITS) - 1
+P_INT = 2**255 - 19
+# 2^(12*22) = 2^264 = 2^9 * 2^255 ≡ 19 * 2^9 (mod p)
+FOLD = 19 << (BITS * LIMBS - 255)
+
+# Static anti-diagonal scatter matrix: conv[k] = sum_{i+j=k} a[i]*b[j].
+_CONV = np.zeros((LIMBS * LIMBS, 2 * LIMBS - 1), np.int32)
+for _i in range(LIMBS):
+    for _j in range(LIMBS):
+        _CONV[_i * LIMBS + _j, _i + _j] = 1
+
+
+def _np_limbs(v: int) -> np.ndarray:
+    out = np.zeros(LIMBS, np.int32)
+    for i in range(LIMBS):
+        out[i] = v & MASK
+        v >>= BITS
+    assert v == 0
+    return out
+
+
+def constant(v: int) -> jnp.ndarray:
+    """Static field constant as a [LIMBS] limb vector."""
+    return jnp.asarray(_np_limbs(v % P_INT))
+
+
+def zeros(shape) -> jnp.ndarray:
+    return jnp.zeros((*shape, LIMBS), jnp.int32)
+
+
+def _fold_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry pass on [..., 22]: every limb's carry moves up one
+    limb in a single vector shift; limb 21's carry wraps to limb 0 * FOLD.
+
+    Arithmetic (floor) shifts make this exact for negative limbs: for any
+    int32 v, v == (v >> 12) * 4096 + (v & 4095), so the remainder is always
+    in [0, 4096) and negative values ride the (possibly negative) carries.
+    """
+    c = x >> BITS
+    r = x - (c << BITS)
+    up = jnp.concatenate([c[..., -1:] * FOLD, c[..., :-1]], axis=-1)
+    return r + up
+
+
+def carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce [..., LIMBS] to multiply-safe "carried" form.
+
+    Contract (stress-tested in tests/test_crypto.py): for inputs whose
+    limbs are bounded by ~4.4e7 (a folded convolution; lazy add/sub values
+    are far smaller), five parallel passes settle limbs 1..21 into
+    (-16, 4097) and limb 0 into (-9728, 13824) — the wrap-around fold can
+    leave one FOLD-sized surplus (or deficit for negative values).  One
+    lazy add/sub of two carried values then keeps |limb 0| < 27652 and the
+    rest below 8192, so the schoolbook convolution of two such operands
+    peaks below 1.9e9 — inside int32.  Exact normalization only happens in
+    canonical().
+    """
+    for _ in range(5):
+        x = _fold_pass(x)
+    return x
+
+
+def _reduce_wide(c: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a 43-limb convolution (|terms| <= ~1.8e9) to carried form."""
+    # Two growing no-fold passes tame the raw sums so that the fold
+    # products below stay inside int32: after them limbs sit in [0, 4096)
+    # except for carry residue at positions 43 (< 4200) and 44 (< 100).
+    w = c
+    for _ in range(2):
+        cr = w >> BITS
+        r = w - (cr << BITS)
+        w = jnp.concatenate([r, jnp.zeros_like(r[..., :1])], axis=-1)
+        w = w.at[..., 1:].add(cr)
+    # Positions 22..43 fold to 0..21 via 2^264 ≡ 19*2^9; position 44 is
+    # 2^(12*44) = (2^264)^2 * 2^(12*0)... folded twice: 19^2 * 2^18 =
+    # 361 * 2^6 at limb 1.  Peak addend ~4.1e7 — int32-safe.
+    lo = w[..., :LIMBS] + w[..., LIMBS : 2 * LIMBS] * FOLD
+    lo = lo.at[..., 1].add(w[..., 2 * LIMBS] * (361 << 6))
+    return carry(lo)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply: [..., 22] x [..., 22] -> [..., 22] normalized."""
+    a, b = jnp.broadcast_arrays(a, b)
+    outer = a[..., :, None] * b[..., None, :]
+    flat = outer.reshape(*outer.shape[:-2], LIMBS * LIMBS)
+    conv = jnp.matmul(flat, jnp.asarray(_CONV))
+    return _reduce_wide(conv)
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lazy add: limbs may leave [0, 4096) but stay multiply-safe."""
+    return a + b
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lazy subtract: limbs may go negative; carry()/mul() handle it."""
+    return a - b
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small positive int (k * 4096 * 22 must fit int32)."""
+    return carry(a * k)
+
+
+def pow_const(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a**e for a static exponent, as a lax.scan over e's bits (LSB first)."""
+    nbits = e.bit_length()
+    bits = jnp.asarray([(e >> i) & 1 for i in range(nbits)], jnp.int32)
+    one = jnp.broadcast_to(constant(1), a.shape)
+
+    def step(state, bit):
+        result, base = state
+        result = jnp.where((bit == 1)[..., None], mul(result, base), result)
+        return (result, square(base)), None
+
+    (result, _), _ = jax.lax.scan(step, (one, carry(a)), bits)
+    return result
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    return pow_const(a, P_INT - 2)
+
+
+def canonical(a: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce to the canonical representative in [0, p).
+
+    Input may be any multiply-safe lazy value (even negative); output limbs
+    are the unique encoding of the value in [0, p), every limb in [0, 4096).
+    """
+    # Carried form encodes a value in (-2^20, 2^264); +16p clears the
+    # negative edge without leaving 22 limbs.
+    a = carry(carry(a) + jnp.asarray(_np_limbs(16 * P_INT)))
+    # Squash bits 256+ : 2^256 ≡ 38 (mod p).  Two rounds bring the value
+    # under 2^256 + small; a third pass settles limb 0's surplus.
+    for _ in range(3):
+        top = a[..., LIMBS - 1] >> 4
+        a = a.at[..., LIMBS - 1].add(-(top << 4))
+        a = a.at[..., 0].add(top * 38)
+        a = _fold_pass(a)
+    # Value now in [0, 2p + small): subtract p while >= p, at most 3 times.
+    p_limbs = jnp.asarray(_np_limbs(P_INT))
+    for _ in range(3):
+        diff = a - p_limbs
+        # diff >= 0 iff the borrow chain's final carry is >= 0.
+        borrow = jnp.zeros_like(diff[..., 0])
+        limbs = []
+        for i in range(LIMBS):
+            v = diff[..., i] + borrow
+            limbs.append(v & MASK)
+            borrow = v >> BITS
+        ge = borrow >= 0
+        reduced = jnp.stack(limbs, axis=-1)
+        a = jnp.where(ge[..., None], reduced, a)
+    # Exact final chain: the value is in [0, p) with nonnegative limbs that
+    # may individually touch 4096; one sequential pass normalizes bitwise
+    # (canonical() is rare — equality tests and byte encoding only).
+    c = jnp.zeros_like(a[..., 0])
+    limbs = []
+    for i in range(LIMBS):
+        v = a[..., i] + c
+        limbs.append(v & MASK)
+        c = v >> BITS
+    return jnp.stack(limbs, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Canonical equality: [...] bool."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+# -- byte/bit conversions (little-endian, RFC 8032 layout) -------------------
+
+
+def from_bytes(by: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [..., 32] little-endian -> limbs [..., 22] (top bit included;
+    callers mask bit 255 themselves where the encoding steals it)."""
+    bits = bytes_to_bits(by)  # [..., 256]
+    pad = jnp.zeros((*bits.shape[:-1], BITS * LIMBS - 256), bits.dtype)
+    bits = jnp.concatenate([bits, pad], axis=-1)
+    grouped = bits.reshape(*bits.shape[:-1], LIMBS, BITS).astype(jnp.int32)
+    weights = jnp.asarray([1 << i for i in range(BITS)], jnp.int32)
+    return jnp.einsum("...lb,b->...l", grouped, weights)
+
+
+def to_bytes(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical little-endian encoding: limbs [..., 22] -> uint8 [..., 32]."""
+    a = canonical(a)
+    shifts = jnp.arange(BITS, dtype=jnp.int32)
+    bits = (a[..., :, None] >> shifts) & 1  # [..., 22, 12]
+    bits = bits.reshape(*a.shape[:-1], BITS * LIMBS)[..., :256]
+    return bits_to_bytes(bits)
+
+
+def bytes_to_bits(by: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [..., n] -> bits [..., 8n], little-endian within each byte."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (by[..., :, None] >> shifts) & 1
+    return bits.reshape(*by.shape[:-1], by.shape[-1] * 8).astype(jnp.int32)
+
+
+def bits_to_bytes(bits: jnp.ndarray) -> jnp.ndarray:
+    grouped = bits.reshape(*bits.shape[:-1], bits.shape[-1] // 8, 8)
+    weights = jnp.asarray([1 << i for i in range(8)], jnp.int32)
+    return jnp.einsum("...nb,b->...n", grouped, weights).astype(jnp.uint8)

@@ -1,0 +1,238 @@
+"""Batched SHA-512 as a JAX tensor program (uint32 lane pairs).
+
+Ed25519 needs SHA-512 twice per signature (key expansion / the challenge
+scalar h = H(R || A || M)); verifying thousands of SM(m) messages on device
+means hashing thousands of 96-byte inputs per round.  TPUs have no 64-bit
+integer lanes, so every 64-bit word lives as an (hi, lo) pair of uint32
+lanes and the whole compression function vectorises over the batch axis —
+80 rounds of pure VPU element-wise ops, no data-dependent control flow.
+
+Message length is static (shapes must be static under jit); the padding
+layout is precomputed in Python per length.  Round constants and initial
+state are derived at import from their definitions (cube/square roots of
+the first primes) and asserted against the published values.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _iroot(n: int, k: int) -> int:
+    """Floor integer k-th root by Newton iteration on Python ints."""
+    if n == 0:
+        return 0
+    x = 1 << ((n.bit_length() + k - 1) // k)
+    while True:
+        y = ((k - 1) * x + n // x ** (k - 1)) // k
+        if y >= x:
+            return x
+        x = y
+
+
+def _frac_root_bits(p: int, k: int) -> int:
+    """First 64 bits of the fractional part of p**(1/k)."""
+    root = _iroot(p << (64 * k), k)
+    return root & ((1 << 64) - 1)
+
+
+def _primes(count: int) -> list[int]:
+    out, c = [], 2
+    while len(out) < count:
+        if all(c % q for q in out if q * q <= c):
+            out.append(c)
+        c += 1
+    return out
+
+
+_P80 = _primes(80)
+K64 = [_frac_root_bits(p, 3) for p in _P80]
+H64 = [_frac_root_bits(p, 2) for p in _P80[:8]]
+assert K64[0] == 0x428A2F98D728AE22 and K64[79] == 0x6C44198C4A475817
+assert H64[0] == 0x6A09E667F3BCC908 and H64[7] == 0x5BE0CD19137E2179
+
+_KH = np.array([k >> 32 for k in K64], np.uint32)
+_KL = np.array([k & 0xFFFFFFFF for k in K64], np.uint32)
+_IH = np.array([h >> 32 for h in H64], np.uint32)
+_IL = np.array([h & 0xFFFFFFFF for h in H64], np.uint32)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _add64_many(*pairs):
+    h, l = pairs[0]
+    for ph, pl in pairs[1:]:
+        h, l = _add64(h, l, ph, pl)
+    return h, l
+
+
+def _rotr64(h, l, n: int):
+    n %= 64
+    if n == 0:
+        return h, l
+    if n == 32:
+        return l, h
+    if n < 32:
+        return (
+            (h >> n) | (l << (32 - n)),
+            (l >> n) | (h << (32 - n)),
+        )
+    m = n - 32
+    return (
+        (l >> m) | (h << (32 - m)),
+        (h >> m) | (l << (32 - m)),
+    )
+
+
+def _shr64(h, l, n: int):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _xor3(a, b, c):
+    return a ^ b ^ c
+
+
+def _big_sigma0(h, l):
+    r1 = _rotr64(h, l, 28)
+    r2 = _rotr64(h, l, 34)
+    r3 = _rotr64(h, l, 39)
+    return _xor3(r1[0], r2[0], r3[0]), _xor3(r1[1], r2[1], r3[1])
+
+
+def _big_sigma1(h, l):
+    r1 = _rotr64(h, l, 14)
+    r2 = _rotr64(h, l, 18)
+    r3 = _rotr64(h, l, 41)
+    return _xor3(r1[0], r2[0], r3[0]), _xor3(r1[1], r2[1], r3[1])
+
+
+def _small_sigma0(h, l):
+    r1 = _rotr64(h, l, 1)
+    r2 = _rotr64(h, l, 8)
+    r3 = _shr64(h, l, 7)
+    return _xor3(r1[0], r2[0], r3[0]), _xor3(r1[1], r2[1], r3[1])
+
+
+def _small_sigma1(h, l):
+    r1 = _rotr64(h, l, 19)
+    r2 = _rotr64(h, l, 61)
+    r3 = _shr64(h, l, 6)
+    return _xor3(r1[0], r2[0], r3[0]), _xor3(r1[1], r2[1], r3[1])
+
+
+def _compress(state, wh, wl):
+    """One 1024-bit block: state is a list of 8 (h, l) pairs; wh/wl are
+    [B, 16] uint32 big-endian words of the block.
+
+    The 80 rounds run as one lax.scan (body ~50 vector ops) instead of an
+    unrolled trace — XLA's optimization time is superlinear in module size
+    and an unrolled SHA-512 alone stalls the CPU backend for minutes.  The
+    message schedule W is computed in the same scan with a 16-word sliding
+    window in the carry: for t < 16 the word comes from the block (selected
+    by a static per-step flag), afterwards from the sigma recurrence.
+    """
+    B = wh.shape[0]
+    zeros = jnp.zeros((80 - 16, B), jnp.uint32)
+    in_h = jnp.concatenate([jnp.moveaxis(wh, 0, 1), zeros])  # [80, B]
+    in_l = jnp.concatenate([jnp.moveaxis(wl, 0, 1), zeros])
+    is_input = (jnp.arange(80) < 16).astype(jnp.uint32)
+    xs = (jnp.asarray(_KH), jnp.asarray(_KL), in_h, in_l, is_input)
+
+    init_regs = tuple(
+        jnp.broadcast_to(part, (B,)) for pair in state for part in pair
+    )
+    init_win = (jnp.zeros((16, B), jnp.uint32), jnp.zeros((16, B), jnp.uint32))
+
+    def step(carry, x):
+        regs, (win_h, win_l) = carry
+        kh, kl, ih, il, flag = x
+        s0 = _small_sigma0(win_h[1], win_l[1])  # W[t-15]
+        s1 = _small_sigma1(win_h[14], win_l[14])  # W[t-2]
+        sh, sl = _add64_many(s1, (win_h[9], win_l[9]), s0, (win_h[0], win_l[0]))
+        use_in = flag == 1
+        wth = jnp.where(use_in, ih, sh)
+        wtl = jnp.where(use_in, il, sl)
+
+        ah, al, bh, bl, ch, cl, dh, dl, eh, el, fh, fl, gh, gl, hh, hl = regs
+        S1 = _big_sigma1(eh, el)
+        chh = (eh & fh) ^ (~eh & gh)
+        chl = (el & fl) ^ (~el & gl)
+        t1 = _add64_many((hh, hl), S1, (chh, chl), (kh, kl), (wth, wtl))
+        S0 = _big_sigma0(ah, al)
+        majh = (ah & bh) ^ (ah & ch) ^ (bh & ch)
+        majl = (al & bl) ^ (al & cl) ^ (bl & cl)
+        t2 = _add64(S0[0], S0[1], majh, majl)
+        neh, nel = _add64(dh, dl, t1[0], t1[1])
+        nah, nal = _add64(t1[0], t1[1], t2[0], t2[1])
+        new_regs = (nah, nal, ah, al, bh, bl, ch, cl, neh, nel, eh, el, fh, fl, gh, gl)
+        new_win = (
+            jnp.concatenate([win_h[1:], wth[None]]),
+            jnp.concatenate([win_l[1:], wtl[None]]),
+        )
+        return (new_regs, new_win), None
+
+    (regs, _), _ = jax.lax.scan(step, (init_regs, init_win), xs)
+    new = [(regs[2 * i], regs[2 * i + 1]) for i in range(8)]
+    return [
+        _add64(sh, sl, nh, nl) for (sh, sl), (nh, nl) in zip(state, new)
+    ]
+
+
+def _pad_layout(nbytes: int) -> tuple[int, np.ndarray]:
+    """(n_blocks, tail) for a message of static length nbytes: tail is the
+    padding bytes appended (0x80, zeros, 128-bit big-endian bit length)."""
+    pad_len = (112 - (nbytes + 1)) % 128
+    tail = np.zeros(1 + pad_len + 16, np.uint8)
+    tail[0] = 0x80
+    bitlen = nbytes * 8
+    tail[-16:] = np.frombuffer(bitlen.to_bytes(16, "big"), np.uint8)
+    total = nbytes + len(tail)
+    assert total % 128 == 0
+    return total // 128, tail
+
+
+def sha512(msg: jnp.ndarray) -> jnp.ndarray:
+    """Batched SHA-512: uint8 [B, L] -> uint8 [B, 64].  L is static."""
+    B, nbytes = msg.shape
+    n_blocks, tail = _pad_layout(nbytes)
+    padded = jnp.concatenate(
+        [msg.astype(jnp.uint8), jnp.broadcast_to(jnp.asarray(tail), (B, len(tail)))],
+        axis=1,
+    )
+    # Big-endian uint32 words: [B, n_blocks, 32 words of 4 bytes].
+    by = padded.reshape(B, n_blocks * 32, 4).astype(jnp.uint32)
+    words = (by[..., 0] << 24) | (by[..., 1] << 16) | (by[..., 2] << 8) | by[..., 3]
+    words = words.reshape(B, n_blocks, 16, 2)
+    wh = words[..., 0]
+    wl = words[..., 1]
+
+    state = [
+        (
+            jnp.broadcast_to(jnp.uint32(int(_IH[i])), (B,)),
+            jnp.broadcast_to(jnp.uint32(int(_IL[i])), (B,)),
+        )
+        for i in range(8)
+    ]
+    for blk in range(n_blocks):
+        state = _compress(state, wh[:, blk], wl[:, blk])
+
+    out = []
+    for sh, sl in state:
+        for word in (sh, sl):
+            out.extend(
+                [
+                    (word >> 24) & 0xFF,
+                    (word >> 16) & 0xFF,
+                    (word >> 8) & 0xFF,
+                    word & 0xFF,
+                ]
+            )
+    return jnp.stack(out, axis=1).astype(jnp.uint8)

@@ -1,0 +1,226 @@
+"""Crypto subsystem tests: oracle vs RFC 8032, field vs bigints, batched
+SHA-512 vs hashlib, batched Ed25519 verify vs the oracle.
+
+The reference has no crypto (SURVEY.md section 2: ba.py is unsigned oral
+messages only); these tests cover the BASELINE.json north-star addition.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ba_tpu.crypto import ed25519, field as F, oracle
+from ba_tpu.crypto.sha512 import sha512
+
+P = F.P_INT
+
+
+# -- oracle vs RFC 8032 -------------------------------------------------------
+
+RFC8032_VECTORS = [
+    # (secret key, public key, message, signature) — RFC 8032 section 7.1
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+]
+
+
+@pytest.mark.parametrize("sk,pk,msg,sig", RFC8032_VECTORS)
+def test_oracle_rfc8032(sk, pk, msg, sig):
+    sk_b, msg_b = bytes.fromhex(sk), bytes.fromhex(msg)
+    assert oracle.publickey(sk_b).hex() == pk
+    assert oracle.sign(sk_b, bytes.fromhex(pk), msg_b).hex() == sig
+    assert oracle.verify(bytes.fromhex(pk), msg_b, bytes.fromhex(sig))
+    assert not oracle.verify(bytes.fromhex(pk), msg_b + b"x", bytes.fromhex(sig))
+
+
+# -- field arithmetic vs Python bigints --------------------------------------
+
+
+def _to_limbs(vals):
+    out = np.zeros((len(vals), F.LIMBS), np.int32)
+    for b, v in enumerate(vals):
+        for i in range(F.LIMBS):
+            out[b, i] = v & F.MASK
+            v >>= F.BITS
+    return jnp.asarray(out)
+
+
+def _from_canon(x):
+    x = np.asarray(F.canonical(x))
+    assert x.min() >= 0 and x.max() <= F.MASK, "canonical limbs out of range"
+    vals = []
+    for row in x:
+        v = 0
+        for i in reversed(range(F.LIMBS)):
+            v = (v << F.BITS) | int(row[i])
+        vals.append(v)
+    return vals
+
+
+@pytest.fixture(scope="module")
+def field_values():
+    rng = np.random.default_rng(7)
+    vals = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(24)]
+    vals[:4] = [0, 1, P - 1, (P - 1) // 2]
+    return vals
+
+
+def test_field_ops(field_values):
+    a_i = field_values
+    b_i = list(reversed(field_values))
+    a, b = _to_limbs(a_i), _to_limbs(b_i)
+    assert _from_canon(F.mul(a, b)) == [x * y % P for x, y in zip(a_i, b_i)]
+    assert _from_canon(F.add(a, b)) == [(x + y) % P for x, y in zip(a_i, b_i)]
+    assert _from_canon(F.sub(a, b)) == [(x - y) % P for x, y in zip(a_i, b_i)]
+    # Negative-valued lazy operands through a multiply.
+    assert _from_canon(F.mul(F.sub(a, b), F.sub(b, a))) == [
+        (x - y) * (y - x) % P for x, y in zip(a_i, b_i)
+    ]
+
+
+def test_field_deep_chain_stays_in_bounds(field_values):
+    """Stress the carried-limb contract: long mul/sub/add chains must keep
+    every limb inside the int32-safe envelope and the value exact."""
+    a_i = field_values
+    b_i = list(reversed(field_values))
+    a, b = _to_limbs(a_i), _to_limbs(b_i)
+    x = F.mul(F.sub(a, b), F.sub(b, a))
+    exp = [(p - q) * (q - p) % P for p, q in zip(a_i, b_i)]
+    for _ in range(20):
+        x = F.mul(F.sub(x, a), F.add(x, b))
+        exp = [(e - p) * (e + q) % P for e, p, q in zip(exp, a_i, b_i)]
+        arr = np.asarray(x)
+        assert abs(arr[..., 0]).max() < 13824
+        assert arr[..., 1:].min() > -16 and arr[..., 1:].max() <= 4096
+    assert _from_canon(x) == exp
+
+
+def test_field_inv_pow_bytes(field_values):
+    a_i = field_values
+    a = _to_limbs(a_i)
+    assert _from_canon(F.inv(a)) == [pow(v, P - 2, P) if v else 0 for v in a_i]
+    e = (P + 3) // 8
+    assert _from_canon(F.pow_const(a, e)) == [pow(v, e, P) for v in a_i]
+    by = jnp.asarray(
+        np.stack([np.frombuffer(v.to_bytes(32, "little"), np.uint8) for v in a_i])
+    )
+    assert _from_canon(F.from_bytes(by)) == a_i
+    assert (np.asarray(F.to_bytes(F.from_bytes(by))) == np.asarray(by)).all()
+    assert np.asarray(F.eq(a, a)).all()
+    assert np.asarray(F.is_zero(F.sub(a, a))).all()
+
+
+# -- batched SHA-512 vs hashlib ----------------------------------------------
+
+
+@pytest.mark.parametrize("length", [0, 32, 96, 111, 112, 127, 128, 200])
+def test_sha512_matches_hashlib(length):
+    rng = np.random.default_rng(length)
+    msgs = rng.integers(0, 256, size=(4, length), dtype=np.uint8)
+    got = np.asarray(sha512(jnp.asarray(msgs)))
+    for b in range(4):
+        exp = np.frombuffer(hashlib.sha512(msgs[b].tobytes()).digest(), np.uint8)
+        assert (got[b] == exp).all()
+
+
+# -- batched Ed25519 verify vs oracle ----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def sig_batch():
+    """8 lanes: 4 valid, then corrupted sig / corrupted msg / wrong key /
+    valid — exercising every rejection path next to accept paths."""
+    msgs, pks, sigs, expect = [], [], [], []
+    for i in range(4):
+        sk, pk = oracle.keypair(bytes([i]))
+        m = bytes([i]) * 32
+        sig = oracle.sign(sk, pk, m)
+        msgs.append(m)
+        pks.append(pk)
+        sigs.append(sig)
+        expect.append(True)
+    m = bytes(32)
+    sk, pk = oracle.keypair(b"x")
+    sig = oracle.sign(sk, pk, m)
+    bad_sig = bytearray(sig)
+    bad_sig[0] ^= 1
+    msgs.append(m), pks.append(pk), sigs.append(bytes(bad_sig)), expect.append(False)
+    bad_msg = bytearray(m)
+    bad_msg[5] ^= 0xFF
+    msgs.append(bytes(bad_msg)), pks.append(pk), sigs.append(sig), expect.append(False)
+    _, pk2 = oracle.keypair(b"y")
+    msgs.append(m), pks.append(pk2), sigs.append(sig), expect.append(False)
+    msgs.append(m), pks.append(pk), sigs.append(sig), expect.append(True)
+    to_arr = lambda rows: jnp.asarray(np.stack([np.frombuffer(r, np.uint8) for r in rows]))
+    return to_arr(pks), to_arr(msgs), to_arr(sigs), expect
+
+
+def test_verify_matches_oracle(sig_batch):
+    pk, msg, sig, expect = sig_batch
+    got = np.asarray(jax.jit(ed25519.verify)(pk, msg, sig))
+    assert got.tolist() == expect
+    # Cross-check every lane against the oracle too.
+    for b in range(pk.shape[0]):
+        assert expect[b] == oracle.verify(
+            bytes(np.asarray(pk[b])), bytes(np.asarray(msg[b])), bytes(np.asarray(sig[b]))
+        )
+
+
+def test_compress_decompress_roundtrip():
+    enc = []
+    for i in range(4):
+        _, pk = oracle.keypair(bytes([40 + i]))
+        enc.append(np.frombuffer(pk, np.uint8))
+    by = jnp.asarray(np.stack(enc))
+    pts, ok = ed25519.decompress(by)
+    assert np.asarray(ok).all()
+    back = np.asarray(ed25519.compress(pts))
+    assert (back == np.asarray(by)).all()
+
+
+def test_oracle_rejects_noncanonical_x_zero():
+    """RFC 8032 5.1.3 step 4: y=1 with sign bit 1 encodes x=0 non-canonically;
+    accepting it lets [h]A collapse to the identity — a forgery vector.  The
+    oracle and the device kernel must both reject it."""
+    bad_pk = bytes([1] + [0] * 30 + [0x80])
+    sk, pk = oracle.keypair(b"canon")
+    s = 5
+    r_enc = oracle.encode_point(oracle.scalarmult(oracle.BASE, s))
+    forged = r_enc + s.to_bytes(32, "little")
+    assert not oracle.verify(bad_pk, b"m" * 32, forged)
+    got = np.asarray(
+        ed25519.verify(
+            jnp.asarray(np.frombuffer(bad_pk, np.uint8)[None]),
+            jnp.asarray(np.frombuffer(b"m" * 32, np.uint8)[None]),
+            jnp.asarray(np.frombuffer(forged, np.uint8)[None]),
+        )
+    )
+    assert not got[0]
+
+
+def test_decompress_rejects_junk():
+    # y >= p is an invalid encoding (RFC 8032 5.1.3 step 1).
+    bad = np.zeros((2, 32), np.uint8)
+    bad[0] = 0xFF  # y = 2^255-1 with sign bit -> y >= p after masking
+    bad[0, 31] = 0x7F
+    # A y whose x^2 has no square root: y=2 works for ed25519.
+    bad[1, 0] = 2
+    _, ok = ed25519.decompress(jnp.asarray(bad))
+    assert not np.asarray(ok)[0]
+    assert not np.asarray(ok)[1]
