@@ -1,0 +1,179 @@
+"""SM(m): Lamport-Shostak-Pease signed-message Byzantine agreement, batched.
+
+The reference implements only unsigned oral messages (OM(1)-style,
+ba.py:258-285 + 159-195); SM(m) is the BASELINE.json north-star upgrade
+("signed messages"), and the protocol that scales to n=1024, m=32 (config
+#4): signatures collapse the O(n^m) EIG tree to O(n^2) per relay round,
+because a value's *provenance* is carried by its signature chain instead of
+by which tree path delivered it.
+
+Protocol (Byzantine Generals paper, algorithm SM(m)):
+
+1. The commander signs its order and sends it to every lieutenant.
+2. For m relay rounds, every general forwards each properly-signed value it
+   holds (appending its signature); a value's chain at relay round r has
+   exactly r distinct signers.
+3. Each general ends with the set V of commander-signed values it saw;
+   ``choice(V)``: exactly one value -> that value, otherwise (empty, or the
+   commander provably equivocated) -> UNDEFINED, mirroring the framework's
+   tie convention (ba.py:188-195 maps ties to "undefined"; the paper's
+   default-retreat choice is one jnp.where away).
+
+Tensor model (all shapes static; B independent instances):
+
+- ``seen[b, i, v]`` (v in {RETREAT, ATTACK}) is general i's V-set as a
+  2-bit mask — the whole state of the protocol.
+- Round 1 reuses ``round1_broadcast``: an honest commander sends its order,
+  a faulty one equivocates with per-recipient coins (ba.py:268-273
+  semantics).
+- A relay round is one masked OR-reduction over senders — the all-to-all
+  [B, n, n, 2] "who forwards what to whom" cube, the signed analogue of
+  OM's answer cube.
+- Forgery-freeness is structural: no general can *create* a value-entry —
+  values only enter ``seen`` via the commander's round-1 row, so a faulty
+  lieutenant's only powers are selective withholding (per-(receiver,
+  sender, value) coins) and chain-laundering (below).  That is exactly the
+  adversary of the signed-messages model.
+- Chain-length soundness: an honest general first learning v at relay
+  round r implies a chain of r distinct signers; if v was never held by an
+  honest general before, all r signers are traitors, so r <= t (coalition
+  size).  The simulation enforces that bound: a coalition-only value can
+  be first revealed no later than relay round t_b (traitor count of
+  instance b).  Once any honest general holds v, it relays to everyone the
+  next round, so later faulty sends are redundant — the model lets them
+  happen freely then.  This keeps every simulated execution reachable by a
+  real adversary, which is what the IC1/IC2 property tests rely on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from ba_tpu.core.om import round1_broadcast
+from ba_tpu.core.quorum import majority_counts, quorum_decision
+from ba_tpu.core.state import SimState
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+
+
+def _initial_seen(state: SimState, received: jnp.ndarray) -> jnp.ndarray:
+    """seen[b, i, v] after the commander's signed round-1 push."""
+    B, n = state.faulty.shape
+    vals = jnp.stack([received == RETREAT, received == ATTACK], axis=-1)
+    return vals & state.alive[..., None]
+
+
+def sm_relay_rounds(
+    key: jax.Array,
+    state: SimState,
+    seen: jnp.ndarray,
+    m: int,
+    withhold: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Run m relay rounds; returns the final seen[b, i, v] masks.
+
+    ``withhold`` (optional, [m, B, n, n, 2] bool) pins the faulty senders'
+    per-(round, receiver, sender, value) withholding decisions — the
+    adversary schedule.  Default: fair coins, the vectorized analogue of
+    the reference's per-call randomness (ba.py:44-49).
+    """
+    B, n = state.faulty.shape
+    is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0  # [B, n]
+    # Coalition size: traitors among the living (incl. a faulty commander).
+    t = jnp.sum(state.faulty & state.alive, axis=-1)  # [B]
+
+    honest = state.alive & ~state.faulty  # [B, n]
+    for r in range(1, m + 1):  # relay round r: chains have r+1 signers
+        if withhold is None:
+            coins = jr.bernoulli(jr.fold_in(key, r), 0.5, (B, n, n, 2))
+        else:
+            coins = ~withhold[r - 1]
+        # Who was held by some honest general *before* this round: those
+        # values are already public — faulty sends of them are unrestricted
+        # (and redundant).  Coalition-only values obey the chain bound.
+        held_honest = jnp.any(seen & honest[..., None], axis=1)  # [B, 2]
+        chain_ok = (r <= t)[:, None] | held_honest  # [B, 2]
+        faulty_sends = (
+            seen[:, None, :, :]  # sender j holds v
+            & coins
+            & state.faulty[:, None, :, None]
+            & chain_ok[:, None, None, :]
+        )
+        honest_sends = seen[:, None, :, :] & honest[:, None, :, None]
+        sends = (faulty_sends | honest_sends) & state.alive[:, None, :, None]
+        incoming = jnp.any(sends, axis=2)  # [B, n, v] OR over senders
+        seen = (seen | incoming) & state.alive[..., None]
+    return seen
+
+
+def sm_choice(state: SimState, seen: jnp.ndarray) -> jnp.ndarray:
+    """choice(V) per general: [B, n] int8.
+
+    |V| == 1 -> the value; 0 or 2 (silent or provably-equivocating
+    commander) -> UNDEFINED.  The commander reports its own order
+    (ba.py:284-285, SURVEY.md Q1 parity).
+    """
+    n = state.faulty.shape[1]
+    has_r = seen[..., 0]
+    has_a = seen[..., 1]
+    choice = jnp.where(
+        has_a & ~has_r,
+        jnp.asarray(ATTACK, COMMAND_DTYPE),
+        jnp.where(
+            has_r & ~has_a,
+            jnp.asarray(RETREAT, COMMAND_DTYPE),
+            jnp.asarray(UNDEFINED, COMMAND_DTYPE),
+        ),
+    )
+    is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0
+    return jnp.where(is_leader, state.order[:, None], choice)
+
+
+def sm_round(
+    key: jax.Array,
+    state: SimState,
+    m: int,
+    withhold: jnp.ndarray | None = None,
+    sig_valid: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Full SM(m) exchange -> per-general choices [B, n] int8.
+
+    ``sig_valid`` (optional [B, n] bool) marks which round-1 messages
+    carried a valid commander signature — the hook through which the real
+    batched Ed25519 kernel (ba_tpu.crypto.ed25519.verify) feeds the
+    protocol; invalid messages are dropped before any value enters V.
+    """
+    k1, k2 = jr.split(key)
+    received = round1_broadcast(k1, state)
+    seen = _initial_seen(state, received)
+    if sig_valid is not None:
+        seen = seen & sig_valid[..., None]
+    seen = sm_relay_rounds(k2, state, seen, m, withhold)
+    return sm_choice(state, seen)
+
+
+def sm_agreement(
+    key: jax.Array,
+    state: SimState,
+    m: int,
+    withhold: jnp.ndarray | None = None,
+    sig_valid: jnp.ndarray | None = None,
+):
+    """SM(m) agreement + the 3f+1 quorum layer: the signed ``actual-order``.
+
+    Same output dict as ``om1_agreement`` (the REPL's hot path,
+    ba.py:376-399) so backends can swap OM for SM transparently.
+    """
+    majorities = sm_round(key, state, m, withhold, sig_valid)
+    n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
+    decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
+    return {
+        "majorities": majorities,
+        "decision": decision,
+        "needed": needed,
+        "total": total,
+        "n_attack": n_attack,
+        "n_retreat": n_retreat,
+        "n_undefined": n_undefined,
+    }
