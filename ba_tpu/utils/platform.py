@@ -1,0 +1,47 @@
+"""Virtual-device platform setup shared by tests and the driver entry.
+
+This image's ``sitecustomize`` imports jax at interpreter startup and latches
+``JAX_PLATFORMS`` from the environment (a TPU tunnel backend that deadlocks if
+re-selected under a CPU-only env var), so switching to the virtual CPU mesh
+must happen via ``jax.config.update`` in-process.  ``XLA_FLAGS`` is read
+lazily at first backend init, so mutating ``os.environ`` is early enough as
+long as it happens before the first ``jax.devices()`` call.
+
+Mirrors the reference's trick of simulating a multi-node cluster inside one
+process (thread-per-general with real sockets, ba.py:79-80,344-351): here the
+"cluster" is n virtual XLA CPU devices, so every sharding/collective path is
+exercised without multi-chip TPU hardware (SURVEY.md section 5).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu_devices(n: int = 8) -> None:
+    """Ensure >= n virtual CPU devices and select the CPU platform.
+
+    Must run before the first ``jax.devices()``/backend query in the process.
+    Honors ``BA_TPU_TESTS_ON_TPU=1``: then it is a no-op so the caller runs
+    against whatever real hardware the environment provides.
+
+    An existing ``--xla_force_host_platform_device_count`` smaller than n is
+    upgraded in place; an equal-or-larger one is preserved.
+    """
+    if os.environ.get("BA_TPU_TESTS_ON_TPU") == "1":
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    pat = re.escape(_COUNT_FLAG) + r"=(\d+)"
+    m = re.search(pat, flags)
+    if m is None:
+        flags = (flags + f" {_COUNT_FLAG}={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = re.sub(pat, f"{_COUNT_FLAG}={n}", flags)
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

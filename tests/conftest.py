@@ -1,32 +1,15 @@
 """Test harness config: force an 8-device virtual CPU mesh before jax runs.
 
-Mirrors the reference's trick of simulating a multi-node cluster inside one
-process (thread-per-general with real sockets, ba.py:79-80,344-351): here the
-"cluster" is 8 virtual XLA CPU devices, so every sharding/collective path is
-exercised without TPU hardware (SURVEY.md section 5).
-
-Environment quirk: this image's ``sitecustomize`` imports jax at interpreter
-startup and latches ``JAX_PLATFORMS`` from the environment (a TPU tunnel
-backend that deadlocks if re-selected under a CPU-only env), so we must
-switch platforms via ``jax.config.update`` rather than env vars.  XLA_FLAGS
-is still read lazily at first backend init, so setting it here (before any
-``jax.devices()`` call) is early enough.  Set ``BA_TPU_TESTS_ON_TPU=1`` to
-run the suite on real TPU hardware instead.
+The platform quirk and the virtual-mesh rationale live in
+``ba_tpu.utils.platform`` (shared with ``__graft_entry__.dryrun_multichip``).
+Set ``BA_TPU_TESTS_ON_TPU=1`` to run the suite on real TPU hardware instead.
 """
-
-import os
 
 import pytest
 
-if os.environ.get("BA_TPU_TESTS_ON_TPU") != "1":
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    import jax
+from ba_tpu.utils.platform import force_virtual_cpu_devices
 
-    jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu_devices(8)
 
 
 @pytest.fixture(scope="session")
