@@ -23,6 +23,7 @@ from ba_tpu.core.quorum import (
 from ba_tpu.core.om import om1_round, om1_agreement
 from ba_tpu.core.eig import eig_agreement
 from ba_tpu.core.election import elect_lowest_id
+from ba_tpu.core.sm import sm_round, sm_agreement, sm_relay_rounds, sm_choice
 
 __all__ = [
     "RETREAT",
@@ -41,4 +42,8 @@ __all__ = [
     "om1_agreement",
     "eig_agreement",
     "elect_lowest_id",
+    "sm_round",
+    "sm_agreement",
+    "sm_relay_rounds",
+    "sm_choice",
 ]

@@ -34,15 +34,18 @@ Tensor model (all shapes static; B independent instances):
   lieutenant's only powers are selective withholding (per-(receiver,
   sender, value) coins) and chain-laundering (below).  That is exactly the
   adversary of the signed-messages model.
-- Chain-length soundness: an honest general first learning v at relay
-  round r implies a chain of r distinct signers; if v was never held by an
-  honest general before, all r signers are traitors, so r <= t (coalition
-  size).  The simulation enforces that bound: a coalition-only value can
-  be first revealed no later than relay round t_b (traitor count of
+- Chain-length soundness: a value accepted at relay round r carries a
+  chain of r+1 distinct signers — the commander plus r relaying
+  lieutenants (SM(m)'s acceptance rule).  If v was never held by an honest
+  general before round r, all of those signers are traitors: the commander
+  plus r lieutenant-traitors, i.e. r+1 <= t (coalition size, commander
+  included).  The simulation enforces that bound: a coalition-only value
+  can be first revealed only at relay rounds r < t_b (traitor count of
   instance b).  Once any honest general holds v, it relays to everyone the
   next round, so later faulty sends are redundant — the model lets them
   happen freely then.  This keeps every simulated execution reachable by a
-  real adversary, which is what the IC1/IC2 property tests rely on.
+  real adversary, which is what the IC1/IC2 property tests
+  (tests/test_sm.py) rely on.
 """
 
 from __future__ import annotations
@@ -79,7 +82,6 @@ def sm_relay_rounds(
     the reference's per-call randomness (ba.py:44-49).
     """
     B, n = state.faulty.shape
-    is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0  # [B, n]
     # Coalition size: traitors among the living (incl. a faulty commander).
     t = jnp.sum(state.faulty & state.alive, axis=-1)  # [B]
 
@@ -93,7 +95,9 @@ def sm_relay_rounds(
         # values are already public — faulty sends of them are unrestricted
         # (and redundant).  Coalition-only values obey the chain bound.
         held_honest = jnp.any(seen & honest[..., None], axis=1)  # [B, 2]
-        chain_ok = (r <= t)[:, None] | held_honest  # [B, 2]
+        # Coalition-only reveal at relay round r needs r+1 <= t distinct
+        # traitor signers (commander + r relayers), hence r < t.
+        chain_ok = (r < t)[:, None] | held_honest  # [B, 2]
         faulty_sends = (
             seen[:, None, :, :]  # sender j holds v
             & coins
@@ -136,6 +140,7 @@ def sm_round(
     m: int,
     withhold: jnp.ndarray | None = None,
     sig_valid: jnp.ndarray | None = None,
+    received: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Full SM(m) exchange -> per-general choices [B, n] int8.
 
@@ -143,9 +148,14 @@ def sm_round(
     carried a valid commander signature — the hook through which the real
     batched Ed25519 kernel (ba_tpu.crypto.ed25519.verify) feeds the
     protocol; invalid messages are dropped before any value enters V.
+    ``received`` (optional [B, n] int8) pins the round-1 broadcast — the
+    signed pipeline (ba_tpu.crypto.signed) computes it first, signs it
+    host-side, then passes it back in so sign and verify cover the same
+    values.
     """
     k1, k2 = jr.split(key)
-    received = round1_broadcast(k1, state)
+    if received is None:
+        received = round1_broadcast(k1, state)
     seen = _initial_seen(state, received)
     if sig_valid is not None:
         seen = seen & sig_valid[..., None]
@@ -159,13 +169,14 @@ def sm_agreement(
     m: int,
     withhold: jnp.ndarray | None = None,
     sig_valid: jnp.ndarray | None = None,
+    received: jnp.ndarray | None = None,
 ):
     """SM(m) agreement + the 3f+1 quorum layer: the signed ``actual-order``.
 
     Same output dict as ``om1_agreement`` (the REPL's hot path,
     ba.py:376-399) so backends can swap OM for SM transparently.
     """
-    majorities = sm_round(key, state, m, withhold, sig_valid)
+    majorities = sm_round(key, state, m, withhold, sig_valid, received)
     n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
     decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
     return {

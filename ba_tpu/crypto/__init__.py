@@ -8,16 +8,22 @@ them to SM(m) *signed* messages with batched Ed25519.  Layers:
 - ``sha512``  — batched SHA-512 as uint32-pair tensor ops.
 - ``field``   — batched GF(2^255-19) in int32 limbs.
 - ``ed25519`` — batched verification, one jittable program.
+- ``signed``  — the SM(m) bridge: host-sign round-1 orders, device-verify
+  the batch, feed the validity mask into the relay rounds.
 """
 
-from ba_tpu.crypto import field, oracle, sha512
+from ba_tpu.crypto import field, oracle, sha512, signed
 from ba_tpu.crypto.ed25519 import compress, decompress, verify
+from ba_tpu.crypto.signed import signed_sm_agreement, verify_received
 
 __all__ = [
     "field",
     "oracle",
     "sha512",
+    "signed",
     "compress",
     "decompress",
     "verify",
+    "signed_sm_agreement",
+    "verify_received",
 ]

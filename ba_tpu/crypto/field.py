@@ -136,7 +136,14 @@ def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Multiply by a small positive int (k * 4096 * 22 must fit int32)."""
+    """Multiply by a small positive int.
+
+    Safe bound: ``a`` may be in carried form, whose limbs reach ~13824
+    (see ``carry``'s input contract), so ``k * 13824`` must stay within
+    carry()'s ~4.4e7 input bound — i.e. k <= ~3000.  Asserted statically;
+    only tiny k (2) is used today.
+    """
+    assert 0 < k <= 3000, f"mul_small: k={k} exceeds carry()'s input bound"
     return carry(a * k)
 
 

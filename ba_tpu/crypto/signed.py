@@ -1,0 +1,149 @@
+"""Signed orders: Ed25519-authenticated round-1 broadcast for SM(m).
+
+The bridge between the host signer and the device verifier — the missing
+half of the reference's trust model.  The reference's oral messages are
+plain strings over RPC (ba.py:39-57): any general can lie about what the
+commander said.  SM(m) removes that power with signatures; here the
+commander signs each *value* it utters ("commander of instance b says v"),
+recipients verify in one batched Ed25519 device call, and the resulting
+[B, n] validity mask feeds ``sm_round(sig_valid=...)`` so unauthenticated
+values never enter any general's V-set.
+
+Split of labor:
+
+- Signing is host-side (``ba_tpu.crypto.oracle``, pure Python): commanders
+  are few (one per instance) and sign at most two distinct values each —
+  per-instance memoization makes this O(B) scalar mults, off the hot path.
+- Verification is device-side (``ba_tpu.crypto.ed25519.verify``): B x n
+  checks per round, the batched hot op (BASELINE config #3).
+
+Message encoding (MSG_LEN bytes, static for the SHA-512 kernel):
+``b"BAv1" || instance u32 LE || value u8 || zero pad``.  Binding the
+instance id prevents cross-instance replay inside a batch; the value is
+the signed claim itself.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ba_tpu.crypto import oracle
+
+MSG_LEN = 16
+_MAGIC = b"BAv1"
+
+_verify_jit = None  # lazily-created jitted ed25519.verify (shared cache)
+
+
+def commander_keys(batch: int, seed: int = 0) -> tuple[list[bytes], np.ndarray]:
+    """Deterministic per-instance commander keypairs.
+
+    Returns (secret keys as a list of 32-byte strings, public keys as a
+    uint8 [B, 32] array ready for the device verifier).
+    """
+    sks, pks = [], []
+    for b in range(batch):
+        sk, pk = oracle.keypair(f"{seed}:{b}".encode())
+        sks.append(sk)
+        pks.append(np.frombuffer(pk, np.uint8))
+    return sks, np.stack(pks)
+
+
+def order_message(instance: int, value: int) -> bytes:
+    """The signed claim: "commander of ``instance`` says ``value``"."""
+    body = _MAGIC + int(instance).to_bytes(4, "little") + bytes([value & 0xFF])
+    return body.ljust(MSG_LEN, b"\0")
+
+
+def sign_received(
+    sks: list[bytes],
+    pks: np.ndarray,
+    received: np.ndarray,
+    corrupt: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Sign the round-1 values: received [B, n] int -> (msgs, sigs) uint8.
+
+    Each (b, i) entry is the commander-of-b-signed message for the value
+    general i received; a commander signs each distinct value once
+    (deterministic Ed25519), so equivocation = two honestly-signed
+    contradictory claims — exactly the paper's faulty-commander power.
+
+    ``corrupt`` (optional [B, n] bool) flips a signature byte on marked
+    entries, modelling transmission/forgery faults the verifier must
+    reject.
+
+    Returns msgs uint8 [B, n, MSG_LEN] and sigs uint8 [B, n, 64].
+    """
+    B, n = received.shape
+    msgs = np.zeros((B, n, MSG_LEN), np.uint8)
+    sigs = np.zeros((B, n, 64), np.uint8)
+    for b in range(B):
+        pk = pks[b].tobytes()
+        cache: dict[int, tuple[bytes, bytes]] = {}
+        for i in range(n):
+            v = int(received[b, i])
+            if v not in cache:
+                msg = order_message(b, v)
+                cache[v] = (msg, oracle.sign(sks[b], pk, msg))
+            msg, sig = cache[v]
+            msgs[b, i] = np.frombuffer(msg, np.uint8)
+            sigs[b, i] = np.frombuffer(sig, np.uint8)
+    if corrupt is not None:
+        sigs = sigs.copy()
+        sigs[..., 0] ^= np.where(corrupt, np.uint8(0xFF), np.uint8(0))
+    return msgs, sigs
+
+
+def verify_received(pks, msgs, sigs):
+    """Batched device verification: -> [B, n] bool sig-validity mask.
+
+    pks [B, 32], msgs [B, n, MSG_LEN], sigs [B, n, 64] (uint8, any
+    array-like).  Flattens to one [B*n] ``ed25519.verify`` call — the hot
+    batched kernel — and reshapes back.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ba_tpu.crypto.ed25519 import verify
+
+    global _verify_jit
+    if _verify_jit is None:
+        _verify_jit = jax.jit(verify)
+    pks = jnp.asarray(pks, jnp.uint8)
+    msgs = jnp.asarray(msgs, jnp.uint8)
+    sigs = jnp.asarray(sigs, jnp.uint8)
+    B, n = msgs.shape[:2]
+    pk_bn = jnp.broadcast_to(pks[:, None, :], (B, n, 32)).reshape(B * n, 32)
+    ok = _verify_jit(pk_bn, msgs.reshape(B * n, -1), sigs.reshape(B * n, 64))
+    return ok.reshape(B, n)
+
+
+def signed_sm_agreement(
+    key,
+    state,
+    m: int,
+    withhold=None,
+    corrupt: np.ndarray | None = None,
+    seed: int = 0,
+):
+    """End-to-end signed SM(m): sign -> verify on device -> relay -> quorum.
+
+    The full signed upgrade of the reference's ``actual-order`` hot path
+    (ba.py:376-399): round-1 broadcast with commander equivocation
+    (ba.py:268-273 semantics), host Ed25519 signing of each uttered value,
+    batched device verification, and m relay rounds gated on the validity
+    mask.  Returns the ``om1_agreement``-shaped dict plus ``sig_valid``.
+    """
+    import jax.random as jr
+
+    from ba_tpu.core.om import round1_broadcast
+    from ba_tpu.core.sm import sm_agreement
+
+    k1, k2 = jr.split(key)
+    received = round1_broadcast(k1, state)
+    sks, pks = commander_keys(state.batch, seed)
+    msgs, sigs = sign_received(sks, pks, np.asarray(received), corrupt)
+    sig_valid = verify_received(pks, msgs, sigs)
+    out = sm_agreement(k2, state, m, withhold, sig_valid, received)
+    out["sig_valid"] = sig_valid
+    return out

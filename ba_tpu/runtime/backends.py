@@ -76,16 +76,38 @@ class PyBackend:
 
 
 class JaxBackend:
-    """The batched TPU core behind a B=1 interactive facade."""
+    """The batched TPU core behind a B=1 interactive facade.
 
-    def __init__(self, platform: str | None = None, m: int = 1):
+    ``protocol`` selects the agreement engine: ``"om"`` (oral messages —
+    OM(1) for m == 1, the EIG tree otherwise) or ``"sm"`` (signed
+    messages, the Lamport-Shostak-Pease SM(m) upgrade).  ``signed=True``
+    (sm only) runs the full Ed25519 pipeline per round: host-sign the
+    commander's uttered values, verify the batch on device, gate the
+    relay rounds on the validity mask (ba_tpu.crypto.signed).
+    """
+
+    def __init__(
+        self,
+        platform: str | None = None,
+        m: int = 1,
+        protocol: str = "om",
+        signed: bool = False,
+    ):
         import jax
 
         if platform:
             jax.config.update("jax_platforms", platform)
+        if protocol not in ("om", "sm"):
+            raise ValueError(f"unknown protocol {protocol!r}")
+        if signed and protocol != "sm":
+            raise ValueError("signed=True requires protocol='sm'")
         self._jax = jax
         self.m = m
+        self.protocol = protocol
+        self.signed = signed
         self._compiled = {}  # capacity -> jitted fn
+        self._signed_compiled = {}  # capacity -> (jitted r1, jitted post-sign)
+        self._keys = None  # cached (sks, pks) for the B=1 commander
 
     @staticmethod
     def _capacity(n: int) -> int:
@@ -100,10 +122,14 @@ class JaxBackend:
 
             from ba_tpu.core.eig import eig_round
             from ba_tpu.core.om import om1_round
+            from ba_tpu.core.sm import sm_round
 
             m = self.m
+            protocol = self.protocol
 
             def step(key, state):
+                if protocol == "sm":
+                    return sm_round(key, state, m)
                 if m == 1:
                     return om1_round(key, state)
                 return eig_round(key, state, m)
@@ -111,16 +137,14 @@ class JaxBackend:
             self._compiled[capacity] = jax.jit(step)
         return self._compiled[capacity]
 
-    def run_round(self, generals, leader_idx, order_code, seed):
+    def _make_state(self, generals, leader_idx, order_code):
         import jax.numpy as jnp
-        import jax.random as jr
         import numpy as np
 
         from ba_tpu.core.state import SimState
         from ba_tpu.core.types import COMMAND_DTYPE
 
-        n = len(generals)
-        cap = self._capacity(n)
+        cap = self._capacity(len(generals))
         # Stage on host, transfer once — per-element .at[].set() would
         # dispatch O(n) device scatters per interactive round.
         faulty = np.zeros((1, cap), np.bool_)
@@ -130,12 +154,67 @@ class JaxBackend:
             faulty[0, i] = g.faulty
             alive[0, i] = g.alive
             ids[0, i] = g.id
-        state = SimState(
+        return SimState(
             order=jnp.full((1,), order_code, COMMAND_DTYPE),
             leader=jnp.full((1,), leader_idx, jnp.int32),
             faulty=jnp.asarray(faulty),
             alive=jnp.asarray(alive),
             ids=jnp.asarray(ids),
         )
-        maj = self._fn(cap)(jr.key(seed), state)
+
+    def _signed_fns(self, capacity: int):
+        """Jitted (round-1 broadcast, post-sign SM) pair per capacity.
+
+        The host Ed25519 signer sits between the two device programs, so
+        the signed path is split there; everything on device is compiled
+        once per capacity, like the unsigned ``_fn`` cache.
+        """
+        if capacity not in self._signed_compiled:
+            import jax
+
+            from ba_tpu.core.om import round1_broadcast
+            from ba_tpu.core.sm import sm_round
+
+            m = self.m
+
+            def post(key, state, sig_valid, received):
+                return sm_round(
+                    key, state, m, sig_valid=sig_valid, received=received
+                )
+
+            self._signed_compiled[capacity] = (
+                jax.jit(round1_broadcast),
+                jax.jit(post),
+            )
+        return self._signed_compiled[capacity]
+
+    def _run_signed(self, state, seed):
+        import jax.random as jr
+        import numpy as np
+
+        from ba_tpu.crypto.signed import (
+            commander_keys,
+            sign_received,
+            verify_received,
+        )
+
+        if self._keys is None:
+            self._keys = commander_keys(1, seed=0)
+        sks, pks = self._keys
+        r1, post = self._signed_fns(state.n)
+        k1, k2 = jr.split(jr.key(seed))
+        received = r1(k1, state)
+        msgs, sigs = sign_received(sks, pks, np.asarray(received))
+        sig_valid = verify_received(pks, msgs, sigs)
+        return post(k2, state, sig_valid, received)
+
+    def run_round(self, generals, leader_idx, order_code, seed):
+        import jax.random as jr
+
+        n = len(generals)
+        state = self._make_state(generals, leader_idx, order_code)
+        if self.signed:
+            maj = self._run_signed(state, seed)
+        else:
+            maj = self._fn(state.n)(jr.key(seed), state)
         return [int(v) for v in maj[0, :n]]

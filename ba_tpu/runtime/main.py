@@ -37,18 +37,40 @@ def build_cluster(argv=None):
         dest="m",
         help="OM recursion depth (1 = the reference's protocol)",
     )
+    parser.add_argument(
+        "--protocol",
+        choices=["om", "sm"],
+        default="om",
+        help="om: oral messages (reference semantics); sm: signed messages",
+    )
+    parser.add_argument(
+        "--signed",
+        action="store_true",
+        help="sm only: real Ed25519 sign/verify per round (host sign, "
+        "batched device verify)",
+    )
     args = parser.parse_args(argv)
 
     from ba_tpu.runtime.cluster import Cluster
 
     if args.backend == "py":
+        if args.protocol != "om" or args.signed:
+            parser.error(
+                "--protocol sm/--signed require --backend tpu "
+                "(the py oracle only implements unsigned oral messages)"
+            )
         from ba_tpu.runtime.backends import PyBackend
 
         backend = PyBackend()
     else:
         from ba_tpu.runtime.backends import JaxBackend
 
-        backend = JaxBackend(platform=args.platform, m=args.m)
+        backend = JaxBackend(
+            platform=args.platform,
+            m=args.m,
+            protocol=args.protocol,
+            signed=args.signed,
+        )
     return Cluster(args.n, backend, seed=args.seed)
 
 
