@@ -23,7 +23,13 @@ from ba_tpu.core.quorum import (
 from ba_tpu.core.om import om1_round, om1_agreement
 from ba_tpu.core.eig import eig_agreement
 from ba_tpu.core.election import elect_lowest_id
-from ba_tpu.core.sm import sm_round, sm_agreement, sm_relay_rounds, sm_choice
+from ba_tpu.core.sm import (
+    sm_round,
+    sm_agreement,
+    sm_relay_rounds,
+    sm_relay_rounds_collapsed,
+    sm_choice,
+)
 
 __all__ = [
     "RETREAT",
@@ -45,5 +51,6 @@ __all__ = [
     "sm_round",
     "sm_agreement",
     "sm_relay_rounds",
+    "sm_relay_rounds_collapsed",
     "sm_choice",
 ]

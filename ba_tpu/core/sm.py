@@ -111,17 +111,63 @@ def sm_relay_rounds(
     return seen
 
 
-def sm_choice(state: SimState, seen: jnp.ndarray) -> jnp.ndarray:
-    """choice(V) per general: [B, n] int8.
+def sm_relay_rounds_collapsed(
+    key: jax.Array,
+    state: SimState,
+    seen: jnp.ndarray,
+    m: int,
+) -> jnp.ndarray:
+    """O(B*n)-per-round relay, distributionally exact for fair-coin traitors.
+
+    In the exact cube (``sm_relay_rounds`` with ``withhold=None``), receiver
+    i's incoming bit for value v is
+
+        (OR of iid fair coins over the k faulty alive holders of v,
+         gated by the chain bound)  OR  (v held by any honest general)
+
+    and the coins are independent across receivers.  The OR of k iid
+    Bernoulli(1/2) draws is Bernoulli(1 - 2^-k), still independent across
+    receivers — so sample that directly and never materialise the
+    [B, n, n, 2] send cube.  Every execution reachable by the exact model is
+    reachable here with identical probability (the transition law of the
+    ``seen`` Markov chain matches round by round); tests/test_sm.py pins the
+    equivalence both deterministically (t = 0) and statistically.
+
+    This is the path that makes the n=1024 scale point (BASELINE config #4)
+    cheap: an SM(m) round costs O(B * n) instead of O(B * n^2), so the
+    quadratic term survives only where an explicit ``withhold`` schedule
+    demands per-(receiver, sender) control.
+    """
+    B, n = state.faulty.shape
+    t = jnp.sum(state.faulty & state.alive, axis=-1)  # [B]
+    honest = state.alive & ~state.faulty
+    traitor = state.faulty & state.alive
+
+    def one_round(seen, r):
+        held_honest = jnp.any(seen & honest[..., None], axis=1)  # [B, 2]
+        chain_ok = (r < t)[:, None] | held_honest  # [B, 2]
+        k_cnt = jnp.sum(seen & traitor[..., None], axis=1)  # [B, 2]
+        p = jnp.where(chain_ok, 1.0 - jnp.exp2(-k_cnt.astype(jnp.float32)), 0.0)
+        u = jr.uniform(jr.fold_in(key, r), (B, n, 2))
+        incoming = (u < p[:, None, :]) | held_honest[:, None, :]
+        seen = (seen | incoming) & state.alive[..., None]
+        return seen, None
+
+    seen, _ = jax.lax.scan(one_round, seen, jnp.arange(1, m + 1))
+    return seen
+
+
+def choice_from_seen(seen: jnp.ndarray) -> jnp.ndarray:
+    """The value part of choice(V): [..., 2] bool V-sets -> [...] int8.
 
     |V| == 1 -> the value; 0 or 2 (silent or provably-equivocating
-    commander) -> UNDEFINED.  The commander reports its own order
-    (ba.py:284-285, SURVEY.md Q1 parity).
+    commander) -> UNDEFINED.  Shared by the unsharded path and the
+    node-sharded one (ba_tpu.parallel.sm_parallel) so the tie convention
+    lives in exactly one place.
     """
-    n = state.faulty.shape[1]
     has_r = seen[..., 0]
     has_a = seen[..., 1]
-    choice = jnp.where(
+    return jnp.where(
         has_a & ~has_r,
         jnp.asarray(ATTACK, COMMAND_DTYPE),
         jnp.where(
@@ -130,6 +176,16 @@ def sm_choice(state: SimState, seen: jnp.ndarray) -> jnp.ndarray:
             jnp.asarray(UNDEFINED, COMMAND_DTYPE),
         ),
     )
+
+
+def sm_choice(state: SimState, seen: jnp.ndarray) -> jnp.ndarray:
+    """choice(V) per general: [B, n] int8.
+
+    The commander reports its own order (ba.py:284-285, SURVEY.md Q1
+    parity); everyone else takes ``choice_from_seen``.
+    """
+    n = state.faulty.shape[1]
+    choice = choice_from_seen(seen)
     is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0
     return jnp.where(is_leader, state.order[:, None], choice)
 
@@ -141,6 +197,7 @@ def sm_round(
     withhold: jnp.ndarray | None = None,
     sig_valid: jnp.ndarray | None = None,
     received: jnp.ndarray | None = None,
+    collapsed: bool = False,
 ) -> jnp.ndarray:
     """Full SM(m) exchange -> per-general choices [B, n] int8.
 
@@ -152,6 +209,9 @@ def sm_round(
     signed pipeline (ba_tpu.crypto.signed) computes it first, signs it
     host-side, then passes it back in so sign and verify cover the same
     values.
+    ``collapsed`` selects the O(B*n) fair-coin relay
+    (``sm_relay_rounds_collapsed``); incompatible with ``withhold``, which
+    needs the per-(receiver, sender) cube.
     """
     k1, k2 = jr.split(key)
     if received is None:
@@ -159,7 +219,12 @@ def sm_round(
     seen = _initial_seen(state, received)
     if sig_valid is not None:
         seen = seen & sig_valid[..., None]
-    seen = sm_relay_rounds(k2, state, seen, m, withhold)
+    if collapsed:
+        if withhold is not None:
+            raise ValueError("collapsed relay cannot honor a withhold schedule")
+        seen = sm_relay_rounds_collapsed(k2, state, seen, m)
+    else:
+        seen = sm_relay_rounds(k2, state, seen, m, withhold)
     return sm_choice(state, seen)
 
 
@@ -170,13 +235,14 @@ def sm_agreement(
     withhold: jnp.ndarray | None = None,
     sig_valid: jnp.ndarray | None = None,
     received: jnp.ndarray | None = None,
+    collapsed: bool = False,
 ):
     """SM(m) agreement + the 3f+1 quorum layer: the signed ``actual-order``.
 
     Same output dict as ``om1_agreement`` (the REPL's hot path,
     ba.py:376-399) so backends can swap OM for SM transparently.
     """
-    majorities = sm_round(key, state, m, withhold, sig_valid, received)
+    majorities = sm_round(key, state, m, withhold, sig_valid, received, collapsed)
     n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
     decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
     return {

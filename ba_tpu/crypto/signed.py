@@ -118,6 +118,25 @@ def verify_received(pks, msgs, sigs):
     return ok.reshape(B, n)
 
 
+def sign_round1(key, state, seed: int = 0, corrupt: np.ndarray | None = None):
+    """The shared sign-then-verify preamble of every signed agreement.
+
+    Runs the round-1 broadcast, signs each uttered value host-side, and
+    verifies the batch on device.  Returns ``(relay_key, received,
+    sig_valid)`` ready for any SM relay path (unsharded or node-sharded).
+    """
+    import jax.random as jr
+
+    from ba_tpu.core.om import round1_broadcast
+
+    k1, k2 = jr.split(key)
+    received = round1_broadcast(k1, state)
+    sks, pks = commander_keys(state.batch, seed)
+    msgs, sigs = sign_received(sks, pks, np.asarray(received), corrupt)
+    sig_valid = verify_received(pks, msgs, sigs)
+    return k2, received, sig_valid
+
+
 def signed_sm_agreement(
     key,
     state,
@@ -125,6 +144,7 @@ def signed_sm_agreement(
     withhold=None,
     corrupt: np.ndarray | None = None,
     seed: int = 0,
+    collapsed: bool = False,
 ):
     """End-to-end signed SM(m): sign -> verify on device -> relay -> quorum.
 
@@ -134,16 +154,36 @@ def signed_sm_agreement(
     batched device verification, and m relay rounds gated on the validity
     mask.  Returns the ``om1_agreement``-shaped dict plus ``sig_valid``.
     """
-    import jax.random as jr
-
-    from ba_tpu.core.om import round1_broadcast
     from ba_tpu.core.sm import sm_agreement
 
-    k1, k2 = jr.split(key)
-    received = round1_broadcast(k1, state)
-    sks, pks = commander_keys(state.batch, seed)
-    msgs, sigs = sign_received(sks, pks, np.asarray(received), corrupt)
-    sig_valid = verify_received(pks, msgs, sigs)
-    out = sm_agreement(k2, state, m, withhold, sig_valid, received)
+    k2, received, sig_valid = sign_round1(key, state, seed, corrupt)
+    out = sm_agreement(k2, state, m, withhold, sig_valid, received, collapsed)
+    out["sig_valid"] = sig_valid
+    return out
+
+
+def signed_sm_agreement_sharded(
+    mesh,
+    key,
+    state,
+    m: int,
+    corrupt: np.ndarray | None = None,
+    seed: int = 0,
+    collapsed: bool = True,
+):
+    """Signed SM(m) across a device mesh: the n=1024-scale signed path.
+
+    Same sign -> verify -> relay -> quorum pipeline as
+    ``signed_sm_agreement``, but the relay and quorum run node-sharded
+    (``ba_tpu.parallel.sm_parallel.sm_node_sharded``): instances shard over
+    "data", the n generals of each cluster over "node".
+    """
+    from ba_tpu.parallel.sm_parallel import sm_node_sharded
+
+    k2, received, sig_valid = sign_round1(key, state, seed, corrupt)
+    out = sm_node_sharded(
+        mesh, k2, state, m,
+        received=received, sig_valid=sig_valid, collapsed=collapsed,
+    )
     out["sig_valid"] = sig_valid
     return out

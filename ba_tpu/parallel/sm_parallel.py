@@ -1,0 +1,192 @@
+"""Node-axis sharded SM(m): one huge signed cluster across chips.
+
+The large-n execution path (BASELINE config #4: n=1024, m=32).  The dense
+EIG tree is O(n^m) and cannot reach that point (ba_tpu/core/eig.py); SM(m)
+is O(n^2) per relay round — and O(n) per round in the collapsed fair-coin
+model (``sm_relay_rounds_collapsed``) — so n=1024 generals shard across the
+mesh's "node" axis the way om1_node_sharded shards OM(1):
+
+- generals (holders *and* receivers of signed values) shard over "node";
+  each chip keeps only its generals' V-sets ``seen[b, n_local, 2]``;
+- collapsed relay round: the only cross-chip state is the [b, 2]
+  honest-holder / traitor-holder counts — one tiny ``psum`` over "node"
+  per round, O(b) ICI bytes (vs the reference's O(n^2) RPC mesh,
+  ba.py:159-186);
+- exact relay round (explicit adversaries): each chip re-assembles the
+  global V-sets with one ``all_gather`` ([b, n, 2] bool, O(b*n) ICI bytes)
+  and draws per-(receiver, sender) coins only for its own receivers —
+  per-chip memory O(b * n * n_local), never the full cube;
+- the quorum layer is the same single ``psum`` as om1_node_sharded
+  (the majority-of-majorities gather, ba.py:197-223).
+
+Round-1 broadcast runs unsharded (it is O(B*n), off the hot path) via the
+shared ``round1_broadcast`` and enters the shard_map replicated along
+"node" — the same contract the signed pipeline (ba_tpu.crypto.signed) uses
+when it pins ``received`` so its host signer sees the values the device
+relays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ba_tpu.core.om import round1_broadcast
+from ba_tpu.core.quorum import quorum_decision
+from ba_tpu.core.sm import choice_from_seen
+from ba_tpu.core.state import SimState
+from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
+
+# pjit-cache guard, same rationale as node_parallel._COMPILED: rebuilding
+# the shard_map closure per call would retrace every round.
+_COMPILED: dict = {}
+
+
+def sm_node_sharded(
+    mesh: Mesh,
+    key: jax.Array,
+    state: SimState,
+    m: int,
+    *,
+    received: jnp.ndarray | None = None,
+    sig_valid: jnp.ndarray | None = None,
+    collapsed: bool = True,
+):
+    """SM(m) agreement with generals sharded over the "node" mesh axis.
+
+    state: SimState with batch B (sharded over "data") and n divisible by
+    the node-axis size.  ``received``/``sig_valid`` (optional [B, n]) pin
+    the round-1 values and their Ed25519 validity mask, exactly as in
+    ``sm_round``.  ``collapsed`` selects the O(n)-per-round fair-coin relay;
+    ``collapsed=False`` runs the exact per-(receiver, sender) coin model.
+    Returns the ``om1_agreement``-style dict with ``majorities`` sharded
+    [B, n] and replicated quorum outputs.
+    """
+    B, n = state.faulty.shape
+    n_node = mesh.shape["node"]
+    assert n % n_node == 0, f"n={n} must divide node axis {n_node}"
+    if received is None:
+        # Round 1 off-device-mesh: shared code path with sm_round, entering
+        # the shard_map node-replicated (O(B*n), not worth sharding).
+        k1, key = jr.split(key)
+        received = round1_broadcast(k1, state)
+    has_sig = sig_valid is not None
+
+    def shard_fn(key, order, leader, faulty, alive, rcv, *extra):
+        node_idx = jax.lax.axis_index("node")
+        data_idx = jax.lax.axis_index("data")
+        b = order.shape[0]
+        n_local = n // n_node
+        i_global = node_idx * n_local + jnp.arange(n_local)  # [n_local]
+        local = lambda x: jnp.take(x, i_global, axis=1)
+
+        honest = alive & ~faulty
+        traitor = faulty & alive
+        t = jnp.sum(traitor, axis=-1)  # [b] coalition size
+        alive_l = local(alive)
+        honest_l = local(honest)
+        traitor_l = local(traitor)
+        rcv_l = local(rcv)
+
+        # This chip's generals' V-sets after the signed round-1 push.
+        seen_l = jnp.stack([rcv_l == RETREAT, rcv_l == ATTACK], axis=-1)
+        seen_l = seen_l & alive_l[..., None]
+        if has_sig:
+            seen_l = seen_l & local(extra[0])[..., None]
+
+        # Relay coins: distinct stream per (data, node) shard, disjoint from
+        # the round-1 stream (which folds in data_idx alone).
+        k_relay = jr.fold_in(key, 1000 + node_idx + n_node * data_idx)
+
+        if collapsed:
+
+            def one_round(seen_l, r):
+                held = jnp.sum(seen_l & honest_l[..., None], axis=1)  # [b, 2]
+                k_cnt = jnp.sum(seen_l & traitor_l[..., None], axis=1)
+                held, k_cnt = jax.lax.psum((held, k_cnt), "node")
+                held_honest = held > 0
+                chain_ok = (r < t)[:, None] | held_honest
+                p = jnp.where(
+                    chain_ok, 1.0 - jnp.exp2(-k_cnt.astype(jnp.float32)), 0.0
+                )
+                u = jr.uniform(jr.fold_in(k_relay, r), (b, n_local, 2))
+                incoming = (u < p[:, None, :]) | held_honest[:, None, :]
+                return (seen_l | incoming) & alive_l[..., None], None
+
+            seen_l, _ = jax.lax.scan(one_round, seen_l, jnp.arange(1, m + 1))
+        else:
+            for r in range(1, m + 1):
+                # Global V-sets: one [b, n, 2]-bool all_gather per round.
+                seen_g = jax.lax.all_gather(seen_l, "node", axis=1, tiled=True)
+                held_honest = jnp.any(seen_g & honest[..., None], axis=1)
+                chain_ok = (r < t)[:, None] | held_honest  # [b, 2]
+                coins = jr.bernoulli(
+                    jr.fold_in(k_relay, r), 0.5, (b, n_local, n, 2)
+                )
+                faulty_sends = (
+                    seen_g[:, None, :, :]
+                    & coins
+                    & faulty[:, None, :, None]
+                    & chain_ok[:, None, None, :]
+                )
+                honest_sends = seen_g[:, None, :, :] & honest[:, None, :, None]
+                sends = (faulty_sends | honest_sends) & alive[:, None, :, None]
+                incoming = jnp.any(sends, axis=2)  # [b, n_local, 2]
+                seen_l = (seen_l | incoming) & alive_l[..., None]
+
+        # choice(V) for this chip's generals (sm_choice semantics; the
+        # leader override needs i_global so only that part is local).
+        choice = choice_from_seen(seen_l)
+        is_leader_l = i_global[None, :] == leader[:, None]
+        maj = jnp.where(is_leader_l, order[:, None], choice)
+
+        # Quorum: local counts, one psum over "node" (ba.py:197-223).
+        att = jnp.sum((maj == ATTACK) & alive_l, axis=-1)
+        ret = jnp.sum((maj == RETREAT) & alive_l, axis=-1)
+        und = jnp.sum((maj == UNDEFINED) & alive_l, axis=-1)
+        att, ret, und = jax.lax.psum((att, ret, und), "node")
+        decision, needed, total = quorum_decision(att, ret, und)
+        return maj, decision, needed, total, att, ret, und
+
+    cache_key = (mesh, n, m, collapsed, has_sig)
+    if cache_key not in _COMPILED:
+        in_specs = [
+            P(),  # key (replicated)
+            P("data"),  # order
+            P("data"),  # leader
+            P("data", None),  # faulty: node axis replicated
+            P("data", None),  # alive
+            P("data", None),  # received
+        ]
+        if has_sig:
+            in_specs.append(P("data", None))
+        f = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=(
+                P("data", "node"),  # majorities
+                P("data"),
+                P("data"),
+                P("data"),
+                P("data"),
+                P("data"),
+                P("data"),
+            ),
+        )
+        _COMPILED[cache_key] = jax.jit(f)
+    args = [key, state.order, state.leader, state.faulty, state.alive, received]
+    if has_sig:
+        args.append(sig_valid)
+    maj, decision, needed, total, att, ret, und = _COMPILED[cache_key](*args)
+    return {
+        "majorities": maj,
+        "decision": decision,
+        "needed": needed,
+        "total": total,
+        "n_attack": att,
+        "n_retreat": ret,
+        "n_undefined": und,
+    }

@@ -1,0 +1,186 @@
+"""Node-sharded + collapsed SM(m): the large-n (n=1024) execution path.
+
+Pins the three claims sm_parallel.py / sm_relay_rounds_collapsed make:
+
+- the collapsed O(n)-per-round relay is *distributionally* identical to the
+  exact per-(receiver, sender)-coin cube (deterministic equality when no
+  traitor holds a coin, statistical equality of outcome frequencies
+  otherwise) and preserves IC1/IC2 at the t = m boundary;
+- the node-sharded round (both modes) computes the same protocol as the
+  unsharded reference implementation on an 8-virtual-device mesh;
+- BASELINE config #4's scale point — n=1024, m=32 signed — actually runs,
+  sharded and single-device, which the dense EIG tree (O(n^m)) cannot do.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import jax.random as jr
+
+from ba_tpu.core import (
+    ATTACK,
+    RETREAT,
+    UNDEFINED,
+    make_state,
+    sm_agreement,
+    sm_round,
+)
+from ba_tpu.crypto.signed import signed_sm_agreement_sharded
+from ba_tpu.parallel import make_mesh, sm_node_sharded
+
+from tests.test_sm import assert_ic1, honest_lieutenants
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh((4, 2), ("data", "node"))
+
+
+# -- collapsed relay: equivalence with the exact cube -------------------------
+
+
+def test_collapsed_equals_exact_when_deterministic():
+    # No faulty general ever holds an unrevealed value -> both models are
+    # coin-free and must agree bit-for-bit.
+    state = make_state(32, 8, order=ATTACK)
+    exact = np.asarray(sm_round(jr.key(0), state, 3))
+    fast = np.asarray(sm_round(jr.key(0), state, 3, collapsed=True))
+    np.testing.assert_array_equal(exact, fast)
+
+
+def test_collapsed_matches_exact_distribution():
+    # Faulty commander (t=1, m=1): receivers' outcomes are random in both
+    # models; per-general outcome frequencies must match within binomial
+    # noise.  B=16384 -> 4-sigma tolerance ~ 0.016.
+    B, n = 16384, 6
+    faulty = jnp.zeros((B, n), bool).at[:, 0].set(True)
+    state = make_state(B, n, order=ATTACK, faulty=faulty)
+    exact = np.asarray(sm_round(jr.key(1), state, 1))
+    fast = np.asarray(sm_round(jr.key(2), state, 1, collapsed=True))
+    for code in (ATTACK, RETREAT, UNDEFINED):
+        f_exact = (exact == code).mean(axis=0)  # [n]
+        f_fast = (fast == code).mean(axis=0)
+        np.testing.assert_allclose(f_exact, f_fast, atol=0.016)
+
+
+@pytest.mark.parametrize("m,traitors", [(1, [0]), (2, [0, 2])])
+def test_collapsed_ic1_at_boundary(m, traitors):
+    # IC1 must hold at t = m with a faulty commander — the chain-length
+    # boundary the exact model protects (ADVICE.md round 1); the collapsed
+    # sampler must inherit the same bound.
+    B = 8192
+    faulty = jnp.zeros((B, 5), bool).at[:, traitors].set(True)
+    state = make_state(B, 5, order=ATTACK, faulty=faulty)
+    choices = np.asarray(sm_round(jr.key(3), state, m, collapsed=True))
+    assert_ic1(choices, honest_lieutenants(state))
+
+
+def test_collapsed_ic2_honest_commander():
+    B = 1024
+    faulty = jr.bernoulli(jr.key(9), 0.4, (B, 6)).at[:, 0].set(False)
+    state = make_state(B, 6, order=RETREAT, faulty=faulty)
+    choices = np.asarray(sm_round(jr.key(4), state, 2, collapsed=True))
+    honest = honest_lieutenants(state)
+    assert np.all(choices[honest] == RETREAT)
+
+
+# -- node-sharded SM ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("collapsed", [True, False])
+def test_sharded_matches_unsharded_deterministic(mesh, collapsed):
+    # Honest commander: the whole exchange is deterministic, so the sharded
+    # round must equal the unsharded one exactly, mode-independently.
+    state = make_state(8, 8, order=ATTACK)
+    want = sm_agreement(jr.key(5), state, 2)
+    got = sm_node_sharded(mesh, jr.key(5), state, 2, collapsed=collapsed)
+    for k in ("majorities", "decision", "needed", "total"):
+        np.testing.assert_array_equal(np.asarray(want[k]), np.asarray(got[k]))
+
+
+@pytest.mark.parametrize("collapsed", [True, False])
+def test_sharded_ic1_faulty_commander(mesh, collapsed):
+    # t = m = 1 with a faulty commander: agreement must survive sharding
+    # (the chain bound is enforced from psum'd global counts).
+    B = 512
+    faulty = jnp.zeros((B, 8), bool).at[:, 0].set(True)
+    state = make_state(B, 8, order=ATTACK, faulty=faulty)
+    out = sm_node_sharded(mesh, jr.key(6), state, 1, collapsed=collapsed)
+    maj = np.asarray(out["majorities"])
+    assert_ic1(maj, honest_lieutenants(state))
+    # Quorum counts must be consistent with the sharded majorities.
+    for k, code in (("n_attack", ATTACK), ("n_retreat", RETREAT),
+                    ("n_undefined", UNDEFINED)):
+        assert np.array_equal(np.asarray(out[k]), (maj == code).sum(axis=1))
+
+
+def test_sharded_sig_valid_gates_vsets(mesh):
+    # m=0, one corrupted signature -> that general's V is empty -> UNDEFINED;
+    # everyone else follows the order.  Exercises the received/sig_valid
+    # plumbing of the sharded path end-to-end.
+    B, n = 4, 8
+    state = make_state(B, n, order=RETREAT)
+    received = jnp.full((B, n), RETREAT, jnp.int8)
+    sig_valid = jnp.ones((B, n), bool).at[:, 3].set(False)
+    out = sm_node_sharded(
+        mesh, jr.key(7), state, 0, received=received, sig_valid=sig_valid
+    )
+    maj = np.asarray(out["majorities"])
+    assert np.all(maj[:, 3] == UNDEFINED)
+    keep = np.ones(n, bool)
+    keep[[0, 3]] = False
+    assert np.all(maj[:, keep] == RETREAT)
+
+
+def test_sharded_sig_valid_recovered_by_relay(mesh):
+    # Same corruption with m=1: honest relays re-deliver the signed value.
+    B, n = 4, 8
+    state = make_state(B, n, order=RETREAT)
+    received = jnp.full((B, n), RETREAT, jnp.int8)
+    sig_valid = jnp.ones((B, n), bool).at[:, 3].set(False)
+    out = sm_node_sharded(
+        mesh, jr.key(8), state, 1, received=received, sig_valid=sig_valid
+    )
+    assert np.all(np.asarray(out["majorities"]) == RETREAT)
+
+
+def test_signed_sharded_end_to_end(mesh):
+    # The full signed pipeline (host sign -> device Ed25519 verify -> node-
+    # sharded relay) with one corrupted signature: the victim recovers via
+    # honest relay (m=1), and the decision is unanimous.
+    B, n = 4, 8  # B must divide the mesh's data axis
+    corrupt = np.zeros((B, n), bool)
+    corrupt[:, 5] = True
+    state = make_state(B, n, order=ATTACK)
+    out = signed_sm_agreement_sharded(mesh, jr.key(9), state, 1, corrupt=corrupt)
+    assert np.all(~np.asarray(out["sig_valid"])[:, 5])
+    assert np.all(np.asarray(out["majorities"]) == ATTACK)
+    assert np.all(np.asarray(out["decision"]) == ATTACK)
+
+
+# -- the n=1024 scale point ---------------------------------------------------
+
+
+def test_n1024_m32_sharded(mesh):
+    # BASELINE config #4: n=1024 generals, m=32, on the 8-device mesh.
+    # 32 traitors (m = t), faulty commander included — the hardest
+    # guaranteed-agreement point.  EIG at this n/m would need n^32 cells.
+    B, n, m = 4, 1024, 32
+    traitors = np.arange(32)
+    faulty = jnp.zeros((B, n), bool).at[:, traitors].set(True)
+    state = make_state(B, n, order=ATTACK, faulty=faulty)
+    out = sm_node_sharded(mesh, jr.key(10), state, m, collapsed=True)
+    maj = np.asarray(out["majorities"])
+    assert_ic1(maj, honest_lieutenants(state))
+    assert np.asarray(out["total"]).tolist() == [n] * B
+
+
+def test_n1024_m32_single_device():
+    # The same scale point unsharded (one chip): the collapsed relay keeps
+    # it O(B * n * m) so a single device handles it comfortably.
+    B, n, m = 4, 1024, 32
+    faulty = jnp.zeros((B, n), bool).at[:, :32].set(True)
+    state = make_state(B, n, order=ATTACK, faulty=faulty)
+    choices = np.asarray(sm_round(jr.key(11), state, m, collapsed=True))
+    assert_ic1(choices, honest_lieutenants(state))
