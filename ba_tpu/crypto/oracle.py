@@ -133,8 +133,15 @@ def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
     return left == right
 
 
+def secret_from_seed(seed: bytes) -> bytes:
+    """Deterministic 32-byte secret key from an arbitrary seed — the single
+    derivation shared by :func:`keypair` and the fast host signer
+    (ba_tpu.crypto.signed.commander_keys)."""
+    return hashlib.sha512(b"ba_tpu-key:" + seed).digest()[:32]
+
+
 def keypair(seed: bytes) -> tuple[bytes, bytes]:
-    """Deterministic (sk, pk): sk is SHA-512(seed)[:32] so fixtures are
-    reproducible from small integer seeds."""
-    sk = hashlib.sha512(b"ba_tpu-key:" + seed).digest()[:32]
+    """Deterministic (sk, pk) so fixtures are reproducible from small
+    integer seeds."""
+    sk = secret_from_seed(seed)
     return sk, publickey(sk)

@@ -11,11 +11,18 @@ values never enter any general's V-set.
 
 Split of labor:
 
-- Signing is host-side (``ba_tpu.crypto.oracle``, pure Python): commanders
-  are few (one per instance) and sign at most two distinct values each —
-  per-instance memoization makes this O(B) scalar mults, off the hot path.
+- Signing is host-side: commanders are few (one per instance) and sign at
+  most two distinct values each, so signing is O(B) signs off the hot
+  path.  The signer is the native Ed25519 from the baked-in
+  ``cryptography`` wheel when importable (~30k signs/s) with the
+  pure-Python ``ba_tpu.crypto.oracle`` as both fallback and ground truth —
+  Ed25519 is deterministic, so the two produce identical bytes
+  (tests/test_sm.py pins this).
 - Verification is device-side (``ba_tpu.crypto.ed25519.verify``): B x n
-  checks per round, the batched hot op (BASELINE config #3).
+  checks per round, the batched hot op (BASELINE config #3).  For
+  sweep-scale work the per-(instance, value) signature tables let the
+  verifier check each distinct signature once ([B, 2]) and gather the
+  [B, n] validity mask, instead of re-verifying n identical copies.
 
 Message encoding (MSG_LEN bytes, static for the SHA-512 kernel):
 ``b"BAv1" || instance u32 LE || value u8 || zero pad``.  Binding the
@@ -29,23 +36,57 @@ import numpy as np
 
 from ba_tpu.crypto import oracle
 
+try:  # native Ed25519 (baked-in wheel); oracle is the fallback + oracle
+    from cryptography.hazmat.primitives import serialization as _ser
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey as _NativeSK,
+    )
+
+    _HAVE_NATIVE = True
+except ImportError:  # pragma: no cover - cryptography is baked into the image
+    _HAVE_NATIVE = False
+
 MSG_LEN = 16
 _MAGIC = b"BAv1"
 
 _verify_jit = None  # lazily-created jitted ed25519.verify (shared cache)
 
 
+def host_publickey(sk: bytes) -> bytes:
+    """RFC 8032 public key, native-accelerated when available."""
+    if _HAVE_NATIVE:
+        return (
+            _NativeSK.from_private_bytes(sk)
+            .public_key()
+            .public_bytes(_ser.Encoding.Raw, _ser.PublicFormat.Raw)
+        )
+    return oracle.publickey(sk)
+
+
+def host_sign(sk: bytes, pk: bytes, msg: bytes) -> bytes:
+    """RFC 8032 signature, native-accelerated when available.
+
+    Deterministic, so the native path and ``oracle.sign`` are
+    byte-identical (pinned by test_host_signer_matches_oracle).
+    """
+    if _HAVE_NATIVE:
+        return _NativeSK.from_private_bytes(sk).sign(msg)
+    return oracle.sign(sk, pk, msg)
+
+
 def commander_keys(batch: int, seed: int = 0) -> tuple[list[bytes], np.ndarray]:
     """Deterministic per-instance commander keypairs.
 
     Returns (secret keys as a list of 32-byte strings, public keys as a
-    uint8 [B, 32] array ready for the device verifier).
+    uint8 [B, 32] array ready for the device verifier).  The sk derivation
+    matches ``oracle.keypair`` exactly; pk computation uses the native
+    signer when available.
     """
     sks, pks = [], []
     for b in range(batch):
-        sk, pk = oracle.keypair(f"{seed}:{b}".encode())
+        sk = oracle.secret_from_seed(f"{seed}:{b}".encode())
         sks.append(sk)
-        pks.append(np.frombuffer(pk, np.uint8))
+        pks.append(np.frombuffer(host_publickey(sk), np.uint8))
     return sks, np.stack(pks)
 
 
@@ -53,6 +94,29 @@ def order_message(instance: int, value: int) -> bytes:
     """The signed claim: "commander of ``instance`` says ``value``"."""
     body = _MAGIC + int(instance).to_bytes(4, "little") + bytes([value & 0xFF])
     return body.ljust(MSG_LEN, b"\0")
+
+
+def sign_value_tables(
+    sks: list[bytes], pks: np.ndarray, n_values: int = 2
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-(instance, value) signature tables: ``n_values`` signs per commander.
+
+    A commander utters at most ``n_values`` distinct claims and Ed25519 is
+    deterministic, so every signature the protocol can ever carry lives in
+    these tables: msgs uint8 [B, V, MSG_LEN], sigs uint8 [B, V, 64].
+    Equivocation = two honestly-signed contradictory claims — exactly the
+    paper's faulty-commander power.
+    """
+    B = len(sks)
+    msgs = np.zeros((B, n_values, MSG_LEN), np.uint8)
+    sigs = np.zeros((B, n_values, 64), np.uint8)
+    for b, sk in enumerate(sks):
+        pk = pks[b].tobytes()
+        for v in range(n_values):
+            msg = order_message(b, v)
+            msgs[b, v] = np.frombuffer(msg, np.uint8)
+            sigs[b, v] = np.frombuffer(host_sign(sk, pk, msg), np.uint8)
+    return msgs, sigs
 
 
 def sign_received(
@@ -64,9 +128,8 @@ def sign_received(
     """Sign the round-1 values: received [B, n] int -> (msgs, sigs) uint8.
 
     Each (b, i) entry is the commander-of-b-signed message for the value
-    general i received; a commander signs each distinct value once
-    (deterministic Ed25519), so equivocation = two honestly-signed
-    contradictory claims — exactly the paper's faulty-commander power.
+    general i received, gathered from the ``sign_value_tables`` (a
+    commander signs each distinct value once).
 
     ``corrupt`` (optional [B, n] bool) flips a signature byte on marked
     entries, modelling transmission/forgery faults the verifier must
@@ -75,31 +138,31 @@ def sign_received(
     Returns msgs uint8 [B, n, MSG_LEN] and sigs uint8 [B, n, 64].
     """
     B, n = received.shape
-    msgs = np.zeros((B, n, MSG_LEN), np.uint8)
-    sigs = np.zeros((B, n, 64), np.uint8)
-    for b in range(B):
-        pk = pks[b].tobytes()
-        cache: dict[int, tuple[bytes, bytes]] = {}
-        for i in range(n):
-            v = int(received[b, i])
-            if v not in cache:
-                msg = order_message(b, v)
-                cache[v] = (msg, oracle.sign(sks[b], pk, msg))
-            msg, sig = cache[v]
-            msgs[b, i] = np.frombuffer(msg, np.uint8)
-            sigs[b, i] = np.frombuffer(sig, np.uint8)
+    received = np.asarray(received).astype(np.int64)
+    assert received.min() >= 0 and received.max() <= 1, "round-1 values are 0/1"
+    msgs_t, sigs_t = sign_value_tables(sks, pks)
+    rows = np.arange(B)[:, None]
+    msgs = msgs_t[rows, received]  # [B, n, MSG_LEN]
+    sigs = sigs_t[rows, received]  # [B, n, 64]
     if corrupt is not None:
         sigs = sigs.copy()
         sigs[..., 0] ^= np.where(corrupt, np.uint8(0xFF), np.uint8(0))
     return msgs, sigs
 
 
+VERIFY_CHUNK = 4096  # ed25519.verify live-intermediate footprint grows with
+# batch; beyond ~4k lanes the scalar-mult tables spill and throughput
+# collapses superlinearly (measured r2: 8.7k/s at 4096, 345/s at 20480).
+# Chunked dispatch keeps every call on the good side of the cliff.
+
+
 def verify_received(pks, msgs, sigs):
     """Batched device verification: -> [B, n] bool sig-validity mask.
 
     pks [B, 32], msgs [B, n, MSG_LEN], sigs [B, n, 64] (uint8, any
-    array-like).  Flattens to one [B*n] ``ed25519.verify`` call — the hot
-    batched kernel — and reshapes back.
+    array-like).  Flattens to [B*n] and dispatches ``ed25519.verify`` in
+    VERIFY_CHUNK-sized pieces (padding the tail so one compiled kernel
+    serves every call), then reshapes back.
     """
     import jax
     import jax.numpy as jnp
@@ -113,17 +176,65 @@ def verify_received(pks, msgs, sigs):
     msgs = jnp.asarray(msgs, jnp.uint8)
     sigs = jnp.asarray(sigs, jnp.uint8)
     B, n = msgs.shape[:2]
-    pk_bn = jnp.broadcast_to(pks[:, None, :], (B, n, 32)).reshape(B * n, 32)
-    ok = _verify_jit(pk_bn, msgs.reshape(B * n, -1), sigs.reshape(B * n, 64))
-    return ok.reshape(B, n)
+    total = B * n
+    pk_bn = jnp.broadcast_to(pks[:, None, :], (B, n, 32)).reshape(total, 32)
+    msgs = msgs.reshape(total, -1)
+    sigs = sigs.reshape(total, 64)
+    if total <= VERIFY_CHUNK:
+        return _verify_jit(pk_bn, msgs, sigs).reshape(B, n)
+    pad = (-total) % VERIFY_CHUNK
+    if pad:
+        pk_bn = jnp.concatenate([pk_bn, jnp.tile(pk_bn[:1], (pad, 1))])
+        msgs = jnp.concatenate([msgs, jnp.tile(msgs[:1], (pad, 1))])
+        sigs = jnp.concatenate([sigs, jnp.tile(sigs[:1], (pad, 1))])
+    oks = [
+        _verify_jit(
+            pk_bn[o : o + VERIFY_CHUNK],
+            msgs[o : o + VERIFY_CHUNK],
+            sigs[o : o + VERIFY_CHUNK],
+        )
+        for o in range(0, total + pad, VERIFY_CHUNK)
+    ]
+    return jnp.concatenate(oks)[:total].reshape(B, n)
 
 
-def sign_round1(key, state, seed: int = 0, corrupt: np.ndarray | None = None):
+def sig_valid_from_tables(ok, received):
+    """Gather the [B, n] validity mask from per-value verdicts ok [B, V].
+
+    The dedup counterpart of ``verify_received``: every general of instance
+    b holds one of b's (at most V) table signatures, so checking the tables
+    once covers all n copies — O(B*V) verifies instead of O(B*n).
+
+    The V=2 case is a broadcast select, NOT ``take_along_axis``: fused into
+    the agreement program, the gather lowers to a serialized scatter/gather
+    on TPU (~350x slower than the whole relay; measured r2), while the
+    select fuses cleanly.
+    """
+    import jax.numpy as jnp
+
+    ok = jnp.asarray(ok)
+    received = jnp.asarray(received)
+    if ok.shape[1] == 2:
+        return jnp.where(received == 1, ok[:, 1:2], ok[:, 0:1])
+    return jnp.take_along_axis(ok, received.astype(jnp.int32), axis=1)
+
+
+def sign_round1(
+    key,
+    state,
+    seed: int = 0,
+    corrupt: np.ndarray | None = None,
+    dedup_verify: bool = False,
+):
     """The shared sign-then-verify preamble of every signed agreement.
 
     Runs the round-1 broadcast, signs each uttered value host-side, and
     verifies the batch on device.  Returns ``(relay_key, received,
     sig_valid)`` ready for any SM relay path (unsharded or node-sharded).
+
+    ``dedup_verify`` verifies each distinct (instance, value) signature
+    once and gathers the mask (``sig_valid_from_tables``) — the
+    sweep-scale path; per-copy ``corrupt`` faults need the full verify.
     """
     import jax.random as jr
 
@@ -132,8 +243,14 @@ def sign_round1(key, state, seed: int = 0, corrupt: np.ndarray | None = None):
     k1, k2 = jr.split(key)
     received = round1_broadcast(k1, state)
     sks, pks = commander_keys(state.batch, seed)
-    msgs, sigs = sign_received(sks, pks, np.asarray(received), corrupt)
-    sig_valid = verify_received(pks, msgs, sigs)
+    if dedup_verify:
+        assert corrupt is None, "per-copy corruption needs the full verify"
+        msgs_t, sigs_t = sign_value_tables(sks, pks)
+        ok = verify_received(pks, msgs_t, sigs_t)  # [B, V]
+        sig_valid = sig_valid_from_tables(ok, np.asarray(received))
+    else:
+        msgs, sigs = sign_received(sks, pks, np.asarray(received), corrupt)
+        sig_valid = verify_received(pks, msgs, sigs)
     return k2, received, sig_valid
 
 

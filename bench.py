@@ -1,35 +1,72 @@
-"""Benchmark: agreement-rounds/sec on the reference's own headline case.
+"""Benchmark: all five BASELINE.json configs on one chip, one JSON line.
 
-Workload: BASELINE.json config #1 — OM(1), n=4 generals, 1 traitor
-lieutenant — batched over 131072 independent consensus instances on one
-chip.  The reference's ceiling for the same case is ~10 rounds/sec: its
-``wait_majority`` polls at 0.1 s (ba.py:287-289) and the run-loop tick adds
-another 0.1 s (ba.py:301), so one agreement can never finish faster than a
-tick; ``vs_baseline`` is measured against that 10 rounds/sec floor.
+Configs (BASELINE.md:31-36):
 
-Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+1. ``om1_n4``        — OM(1), n=4, 1 traitor, unsigned; the reference's own
+                       headline case.  Its ceiling is ~10 rounds/s: its
+                       ``wait_majority`` polls at 0.1 s (ba.py:287-289) and
+                       the run-loop tick adds another 0.1 s (ba.py:301), so
+                       one agreement can never beat a tick.
+2. ``om3_n10``       — OM(3), n=10, 3 traitors, unsigned, dense EIG tree.
+3. ``sm1_n64_signed``— SM(1), n=64, signed: the batched Ed25519 device
+                       verify (the tracked "verifies/sec" metric) plus the
+                       full signed round.
+4. ``n1024_m32``     — n=1024 generals, m=32, single instance, collapsed
+                       SM relay (the EIG tree would need n^32 cells).
+5. ``sweep10k_signed``— the north star: 10k independent (n<=1024, m=3)
+                       signed instances per round.  Host signing uses the
+                       per-(instance, value) tables (2 signs/commander,
+                       one-time setup); each timed round runs the whole
+                       device pipeline — round-1 broadcast, signature-mask
+                       gather, 3 collapsed relay rounds, quorum.
+
+The primary metric stays config #1's rounds/s (continuity with round 1's
+BENCH json); every config's numbers ride in the same line under "configs",
+with rough analytic bytes-per-round estimates so "fast" is falsifiable:
+these workloads are int8/bool elementwise + RNG (VPU work, no matmuls), so
+the honest accounting is achieved bytes/s vs HBM peak — except Ed25519,
+which is int32-multiply bound.
+
+``--profile DIR`` wraps the timed loops in ``jax.profiler.trace`` (view
+with TensorBoard or xprof).
 """
 
 from __future__ import annotations
 
+import argparse
+import contextlib
 import json
 import os
+import sys
 import time
 
 
 REFERENCE_ROUNDS_PER_SEC = 10.0  # 0.1 s poll floor, ba.py:287-301
+HBM_PEAK_GBPS = float(os.environ.get("BA_TPU_HBM_PEAK_GBPS", 1200.0))  # v4 chip
 
 
-def main() -> None:
-    platform = os.environ.get("BA_TPU_BENCH_PLATFORM")
+def _timed(fn, make_args, iters, reps=3):
+    """Compile/warm on iteration 0, then time ``iters`` dispatches.
+
+    Takes the fastest of ``reps`` repetitions: the TPU-tunnel backend is a
+    shared service with +-2x run-to-run noise (measured r2), and min-of-reps
+    is the standard noise-robust estimate of achievable throughput.
+    """
     import jax
 
-    if platform:
-        jax.config.update("jax_platforms", platform)
-    import jax.numpy as jnp
-    import jax.random as jr
+    jax.block_until_ready(fn(*make_args(0)))
+    best = float("inf")
+    for r in range(reps):
+        t0 = time.perf_counter()
+        res = None
+        for i in range(1, iters + 1):
+            res = fn(*make_args(r * iters + i))
+        jax.block_until_ready(res)
+        best = min(best, time.perf_counter() - t0)
+    return best
 
+
+def bench_om1_n4(jax, jnp, jr):
     from ba_tpu.core import make_state, om1_agreement
     from ba_tpu.core.types import ATTACK
 
@@ -39,40 +76,278 @@ def main() -> None:
     state = make_state(batch, n, order=ATTACK, faulty=faulty)
 
     @jax.jit
-    def round_fn(key, state):
+    def step(key, state):
         out = om1_agreement(key, state)
-        # Reduce to a tiny result so timing measures the round, not D2H.
-        return (
-            out["decision"].astype(jnp.int32).sum(),
-            out["needed"].sum(),
-        )
+        return out["decision"].astype(jnp.int32).sum(), out["needed"].sum()
 
     key = jr.key(0)
-    # Warmup / compile.
-    jax.block_until_ready(round_fn(key, state))
-
     iters = 30
-    t0 = time.perf_counter()
-    for i in range(iters):
-        res = round_fn(jr.fold_in(key, i), state)
-    jax.block_until_ready(res)
-    elapsed = time.perf_counter() - t0
+    elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
+    bytes_round = batch * (2 * n * n + 5 * n)  # answer+coin cubes, int8 rows
+    return {
+        "rounds_per_sec": round(batch * iters / elapsed, 1),
+        "batch": batch, "n": n, "m": 1, "iters": iters,
+        "elapsed_s": round(elapsed, 4),
+        "bytes_per_round_est": bytes_round,
+        "achieved_gbps_est": round(bytes_round * iters / elapsed / 1e9, 2),
+        "bound": "dispatch/latency (tiny per-round footprint)",
+    }
 
-    rounds_per_sec = batch * iters / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "agreement-rounds/sec",
-                "value": round(rounds_per_sec, 1),
-                "unit": "rounds/s (OM(1), n=4, 1 traitor, B=%d)" % batch,
-                "vs_baseline": round(rounds_per_sec / REFERENCE_ROUNDS_PER_SEC, 1),
-                "platform": jax.devices()[0].platform,
-                "batch": batch,
-                "iters": iters,
-                "elapsed_s": round(elapsed, 4),
-            }
-        )
+
+def bench_om3_n10(jax, jnp, jr):
+    from ba_tpu.core import eig_agreement, make_state
+    from ba_tpu.core.types import ATTACK
+
+    batch = int(os.environ.get("BA_TPU_BENCH_EIG_BATCH", 4096))
+    n, m = 10, 3
+    faulty = jnp.zeros((batch, n), bool).at[:, [2, 5, 7]].set(True)
+    state = make_state(batch, n, order=ATTACK, faulty=faulty)
+
+    @jax.jit
+    def step(key, state):
+        out = eig_agreement(key, state, m)
+        return out["decision"].astype(jnp.int32).sum(), out["needed"].sum()
+
+    key = jr.key(1)
+    iters = 20
+    elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
+    # EIG levels 1..m: n^l cells per general, touched ~3x (coins, send
+    # tensor, resolve pass), all int8.
+    cells = sum(n ** l for l in range(1, m + 1))
+    bytes_round = batch * n * cells * 3
+    return {
+        "rounds_per_sec": round(batch * iters / elapsed, 1),
+        "batch": batch, "n": n, "m": m, "iters": iters,
+        "elapsed_s": round(elapsed, 4),
+        "bytes_per_round_est": bytes_round,
+        "achieved_gbps_est": round(bytes_round * iters / elapsed / 1e9, 2),
+        "bound": "HBM bandwidth (dense EIG tree materialisation)",
+    }
+
+
+def bench_sm1_n64_signed(jax, jnp, jr):
+    import numpy as np
+
+    from ba_tpu.core import make_state, sm_agreement
+    from ba_tpu.core.types import ATTACK
+    from ba_tpu.crypto.ed25519 import verify
+    from ba_tpu.crypto.signed import commander_keys, sign_received
+
+    batch = int(os.environ.get("BA_TPU_BENCH_SIG_BATCH", 64))
+    n, m = 64, 1
+    faulty = jnp.zeros((batch, n), bool).at[:, 1].set(True)
+    state = make_state(batch, n, order=ATTACK, faulty=faulty)
+
+    # (a) the raw batched-verify kernel: every general checks its copy.
+    sks, pks = commander_keys(batch)
+    from ba_tpu.core.om import round1_broadcast
+
+    received = round1_broadcast(jr.key(2), state)
+    msgs, sigs = sign_received(sks, pks, np.asarray(received))
+    nv = batch * n
+    pk_flat = jnp.asarray(np.repeat(pks, n, axis=0))
+    margs = (pk_flat, jnp.asarray(msgs).reshape(nv, -1),
+             jnp.asarray(sigs).reshape(nv, 64))
+    vjit = jax.jit(verify)
+    v_iters = 3
+    v_elapsed = _timed(lambda *a: vjit(*a), lambda i: margs, v_iters)
+    verifies_per_sec = nv * v_iters / v_elapsed
+
+    # (b) the full signed agreement round on-device (verify mask reused —
+    # commander signatures are per-(instance, value), already checked).
+    sig_valid = jnp.ones((batch, n), bool)
+
+    @jax.jit
+    def step(key, state, sig_valid):
+        out = sm_agreement(key, state, m, None, sig_valid, None, False)
+        return out["decision"].astype(jnp.int32).sum()
+
+    key = jr.key(3)
+    iters = 20
+    elapsed = _timed(
+        step, lambda i: (jr.fold_in(key, i), state, sig_valid), iters
     )
+    # ~1.2M int32 multiplies per verify: ~4000 field muls (two 256-bit
+    # scalar mults in extended coords) x ~300 ops each (10x10 limb
+    # products + carries).
+    est_mults = 1.2e6
+    return {
+        "rounds_per_sec": round(batch * iters / elapsed, 1),
+        "ed25519_verifies_per_sec": round(verifies_per_sec, 1),
+        "verify_batch": nv, "batch": batch, "n": n, "m": m,
+        "iters": iters, "elapsed_s": round(elapsed, 4),
+        "verify_elapsed_s": round(v_elapsed, 4),
+        "est_int32_gmults_per_sec": round(verifies_per_sec * est_mults / 1e9, 1),
+        "bound": "compute (int32 limb multiplies on VPU)",
+    }
+
+
+def bench_n1024_m32(jax, jnp, jr):
+    from ba_tpu.core import make_state, sm_agreement
+    from ba_tpu.core.types import ATTACK
+
+    n, m = 1024, 32
+    faulty = jnp.zeros((1, n), bool).at[:, :m].set(True)
+    state = make_state(1, n, order=ATTACK, faulty=faulty)
+    inner = 100  # sequential rounds per dispatch: keeps the TPU-tunnel
+    # dispatch latency (tens of ms, high variance) out of the measurement
+
+    @jax.jit
+    def step(key, state):
+        def one(acc, k):
+            out = sm_agreement(k, state, m, None, None, None, True)
+            return acc + out["decision"].astype(jnp.int32).sum(), None
+
+        acc, _ = jax.lax.scan(one, jnp.int32(0), jr.split(key, inner))
+        return acc
+
+    key = jr.key(4)
+    iters = 5
+    elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
+    bytes_round = m * n * 2 * 6  # per relay round: uniforms f32 + seen bools
+    return {
+        "rounds_per_sec": round(inner * iters / elapsed, 1),
+        "batch": 1, "n": n, "m": m, "iters": inner * iters,
+        "elapsed_s": round(elapsed, 4),
+        "bytes_per_round_est": bytes_round,
+        "bound": "sequential-depth latency (single instance, 32 dependent "
+                 "relay rounds/agreement)",
+    }
+
+
+def bench_sweep10k_signed(jax, jnp, jr):
+    import numpy as np
+
+    from ba_tpu.core import sm_agreement
+    from ba_tpu.crypto.signed import (
+        commander_keys,
+        sign_value_tables,
+        verify_received,
+    )
+    from ba_tpu.parallel import make_sweep_state
+
+    batch = int(os.environ.get("BA_TPU_BENCH_SWEEP_BATCH", 10240))
+    cap, m = 1024, 3
+    state = make_sweep_state(jr.key(5), batch, cap)
+
+    # One-time setup, off the clock: per-instance keys, 2 signs each, and
+    # one device verify of each distinct signature ([B, 2] tables).
+    t0 = time.perf_counter()
+    sks, pks = commander_keys(batch)
+    msgs_t, sigs_t = sign_value_tables(sks, pks)
+    setup_sign_s = time.perf_counter() - t0
+    # Warm the verify kernel on a chunk-sized slice so the one-time XLA
+    # compile is not billed as throughput.
+    c = min(batch, 2048)
+    jax.block_until_ready(verify_received(pks[:c], msgs_t[:c], sigs_t[:c]))
+    t0 = time.perf_counter()
+    ok = verify_received(pks, msgs_t, sigs_t)  # [B, 2]
+    ok = jax.block_until_ready(ok)
+    setup_verify_s = time.perf_counter() - t0
+    table_verifies_per_sec = 2 * batch / setup_verify_s
+
+    # The timed step is the whole per-round signed pipeline on device:
+    # round-1 equivocation broadcast -> per-copy signature-mask gather from
+    # the verified tables -> m collapsed relay rounds -> quorum.
+    from ba_tpu.core.om import round1_broadcast
+    from ba_tpu.crypto.signed import sig_valid_from_tables
+
+    @jax.jit
+    def step(key, state, ok):
+        k1, k2 = jr.split(key)
+        received = round1_broadcast(k1, state)
+        sig_valid = sig_valid_from_tables(ok, received)
+        out = sm_agreement(k2, state, m, None, sig_valid, received, True)
+        return out["decision"].astype(jnp.int32).sum()
+
+    key = jr.key(6)
+    iters = 50
+    elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state, ok), iters)
+    # Per round: m uniform draws [B, cap, 2] f32 + seen/broadcast int8 rows.
+    bytes_round = batch * cap * (m * 2 * 4 + 8)
+    rps = batch * iters / elapsed
+    return {
+        "rounds_per_sec": round(rps, 1),
+        "vs_target_1M": round(rps / 1e6, 3),
+        "batch": batch, "n_max": cap, "m": m, "iters": iters,
+        "elapsed_s": round(elapsed, 4),
+        "setup_sign_s": round(setup_sign_s, 2),
+        "setup_verify_s": round(setup_verify_s, 2),
+        "table_verifies_per_sec": round(table_verifies_per_sec, 1),
+        "bytes_per_round_est": bytes_round,
+        "achieved_gbps_est": round(bytes_round * iters / elapsed / 1e9, 2),
+        "bound": "VPU throughput (threefry RNG + elementwise relay; "
+                 "far from HBM peak)",
+        "note": "signing+table-verify are one-time setup; each timed round "
+                "re-broadcasts, re-gathers sig masks, relays and decides",
+    }
+
+
+CONFIGS = {
+    # Latency-sensitive configs first: dispatch through the TPU tunnel gets
+    # noticeably slower once the big Ed25519-verify programs have run
+    # (measured r2: config #4 drops ~100x when sequenced after #3).
+    "om1_n4": bench_om1_n4,
+    "om3_n10": bench_om3_n10,
+    "n1024_m32": bench_n1024_m32,
+    "sweep10k_signed": bench_sweep10k_signed,
+    "sm1_n64_signed": bench_sm1_n64_signed,
+}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--profile", metavar="DIR", default=None,
+                        help="write a jax.profiler trace to DIR")
+    parser.add_argument("--configs", default=os.environ.get(
+        "BA_TPU_BENCH_CONFIGS", ",".join(CONFIGS)),
+        help="comma-separated subset of: " + ",".join(CONFIGS))
+    args = parser.parse_args()
+
+    platform = os.environ.get("BA_TPU_BENCH_PLATFORM")
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    trace = (jax.profiler.trace(args.profile) if args.profile
+             else contextlib.nullcontext())
+    results = {}
+    with trace:
+        for name in args.configs.split(","):
+            name = name.strip()
+            print(f"bench: {name} ...", file=sys.stderr, flush=True)
+            results[name] = CONFIGS[name](jax, jnp, jr)
+
+    primary_name = "om1_n4" if "om1_n4" in results else next(iter(results))
+    primary = results[primary_name]
+    unit = "rounds/s (%s)" % (
+        "OM(1), n=4, 1 traitor, B=%d" % primary.get("batch", 0)
+        if primary_name == "om1_n4"
+        else primary_name
+    )
+    line = {
+        "metric": "agreement-rounds/sec",
+        "value": primary["rounds_per_sec"],
+        "unit": unit,
+        "vs_baseline": round(
+            primary["rounds_per_sec"] / REFERENCE_ROUNDS_PER_SEC, 1
+        ),
+        "platform": jax.devices()[0].platform,
+        "hbm_peak_gbps_assumed": HBM_PEAK_GBPS,
+        "configs": results,
+    }
+    if "sweep10k_signed" in results:
+        line["north_star_rounds_per_sec"] = results["sweep10k_signed"][
+            "rounds_per_sec"
+        ]
+    if "sm1_n64_signed" in results:
+        line["ed25519_verifies_per_sec"] = results["sm1_n64_signed"][
+            "ed25519_verifies_per_sec"
+        ]
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -24,7 +24,11 @@ from ba_tpu.core import ATTACK, RETREAT, UNDEFINED, make_state, sm_agreement, sm
 from ba_tpu.crypto import oracle
 from ba_tpu.crypto.signed import (
     commander_keys,
+    host_publickey,
+    host_sign,
+    order_message,
     sign_received,
+    sign_round1,
     signed_sm_agreement,
     verify_received,
 )
@@ -178,6 +182,28 @@ def test_sm_agreement_quorum_outputs():
 # -- Ed25519 integration ------------------------------------------------------
 
 SIG_B, SIG_N = 2, 4  # one shape for every signed test -> one jit compile
+
+
+def test_host_signer_matches_oracle():
+    # The native (cryptography-wheel) host signer and the pure-Python
+    # oracle must be byte-identical — Ed25519 is deterministic.
+    sk, pk = oracle.keypair(b"host-signer")
+    msg = order_message(3, 1)
+    assert host_publickey(sk) == pk
+    assert host_sign(sk, pk, msg) == oracle.sign(sk, pk, msg)
+
+
+def test_dedup_verify_matches_full():
+    # Verifying the per-(instance, value) tables once and gathering must
+    # yield the same mask as verifying every general's copy, including
+    # under commander equivocation (both values uttered).
+    faulty = jnp.zeros((SIG_B, SIG_N), bool).at[:, 0].set(True)
+    state = make_state(SIG_B, SIG_N, order=ATTACK, faulty=faulty)
+    k2a, rec_a, sv_a = sign_round1(jr.key(6), state)
+    k2b, rec_b, sv_b = sign_round1(jr.key(6), state, dedup_verify=True)
+    np.testing.assert_array_equal(np.asarray(rec_a), np.asarray(rec_b))
+    np.testing.assert_array_equal(np.asarray(sv_a), np.asarray(sv_b))
+    assert np.all(np.asarray(sv_a))  # honestly-signed values all verify
 
 
 def test_verify_received_matches_oracle():
