@@ -12,9 +12,12 @@ Layout (mirrors SURVEY.md section 1's layer map, TPU-first):
 - ``ba_tpu.core``     — pure-functional protocol math: OM(1), recursive
   OM(m)/EIG, SM(m) signed messages, quorum thresholds, election. The
   reference's L3 protocol logic (ba.py:126-319) as jittable tensor ops.
-- ``ba_tpu.ops``      — Pallas TPU kernels for the hot reductions.
-- ``ba_tpu.crypto``   — batched Ed25519 / SHA-512 (JAX int32-limb kernels with
-  a native C++ CPU oracle for differential testing).
+- ``ba_tpu.ops``      — Pallas TPU kernels: the Ed25519 scalar-mult ladder
+  (limb-plane VMEM arithmetic) and the fused masked-majority reduce, each
+  with jnp fallbacks and measured justifications (see ops/__init__).
+- ``ba_tpu.crypto``   — batched Ed25519 / SHA-512 (JAX int32-limb programs;
+  pure-Python RFC 8032 oracle + the baked-in native ``cryptography`` wheel
+  as host signer, both differential-tested against each other).
 - ``ba_tpu.parallel`` — device-mesh sharding: instance-axis data parallelism
   and node-axis "sequence parallelism" with XLA collectives; the TPU
   equivalent of the reference's RPyC/TCP backend (ba.py:79-102).

@@ -24,6 +24,8 @@ north-star addition that makes oral messages *signed* messages.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -32,6 +34,20 @@ import jax.numpy as jnp
 from ba_tpu.crypto import field as F
 from ba_tpu.crypto.oracle import B_X, B_Y, D, L, P, SQRT_M1
 from ba_tpu.crypto.sha512 import sha512
+
+
+def _use_pallas() -> bool:
+    """Route the scalar-mult ladder through the Pallas kernel?
+
+    BA_TPU_PALLAS=1 forces it, =0 disables, default ("auto") enables it on
+    real TPU only — the kernel is TPU-codegen (Mosaic); CPU tests exercise
+    it explicitly via interpret mode (tests/test_ops.py).  Read at trace
+    time, so flip it before the first jit of verify().
+    """
+    v = os.environ.get("BA_TPU_PALLAS", "auto")
+    if v in ("0", "1"):
+        return v == "1"
+    return jax.devices()[0].platform == "tpu"
 
 # -- constants ----------------------------------------------------------------
 
@@ -199,7 +215,12 @@ def verify(pk: jnp.ndarray, msg: jnp.ndarray, sig: jnp.ndarray) -> jnp.ndarray:
         jnp.concatenate([b, a], axis=0)
         for b, a in zip(base_point((B,)), a_pt)
     )
-    prods = scalar_mult(points, bits)
+    if _use_pallas():
+        from ba_tpu.ops.ladder import scalar_mult as pallas_scalar_mult
+
+        prods = pallas_scalar_mult(points, bits)
+    else:
+        prods = scalar_mult(points, bits)
     left = tuple(c[:B] for c in prods)
     ha = tuple(c[B:] for c in prods)
     right = point_add(r_pt, ha)

@@ -32,6 +32,8 @@ the signed claim itself.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ba_tpu.crypto import oracle
@@ -150,10 +152,20 @@ def sign_received(
     return msgs, sigs
 
 
-VERIFY_CHUNK = 4096  # ed25519.verify live-intermediate footprint grows with
-# batch; beyond ~4k lanes the scalar-mult tables spill and throughput
-# collapses superlinearly (measured r2: 8.7k/s at 4096, 345/s at 20480).
-# Chunked dispatch keeps every call on the good side of the cliff.
+def _verify_chunk() -> int:
+    """Max signatures per ed25519.verify dispatch.
+
+    The jnp ladder's live intermediates spill past ~4k lanes and throughput
+    collapses superlinearly (measured r2: 8.7k/s at 4096, 345/s at 20480);
+    the Pallas ladder (ba_tpu.ops.ladder) has no such cliff and peaks at
+    larger chunks (~16k), where the ~0.2 s fixed dispatch cost amortizes.
+    """
+    env = os.environ.get("BA_TPU_VERIFY_CHUNK")
+    if env:
+        return int(env)
+    from ba_tpu.crypto.ed25519 import _use_pallas
+
+    return 16384 if _use_pallas() else 4096
 
 
 def verify_received(pks, msgs, sigs):
@@ -161,8 +173,8 @@ def verify_received(pks, msgs, sigs):
 
     pks [B, 32], msgs [B, n, MSG_LEN], sigs [B, n, 64] (uint8, any
     array-like).  Flattens to [B*n] and dispatches ``ed25519.verify`` in
-    VERIFY_CHUNK-sized pieces (padding the tail so one compiled kernel
-    serves every call), then reshapes back.
+    chunk-sized pieces (padding the tail so one compiled kernel serves
+    every call), then reshapes back; see ``_verify_chunk`` for sizing.
     """
     import jax
     import jax.numpy as jnp
@@ -180,20 +192,21 @@ def verify_received(pks, msgs, sigs):
     pk_bn = jnp.broadcast_to(pks[:, None, :], (B, n, 32)).reshape(total, 32)
     msgs = msgs.reshape(total, -1)
     sigs = sigs.reshape(total, 64)
-    if total <= VERIFY_CHUNK:
+    chunk = _verify_chunk()
+    if total <= chunk:
         return _verify_jit(pk_bn, msgs, sigs).reshape(B, n)
-    pad = (-total) % VERIFY_CHUNK
+    pad = (-total) % chunk
     if pad:
         pk_bn = jnp.concatenate([pk_bn, jnp.tile(pk_bn[:1], (pad, 1))])
         msgs = jnp.concatenate([msgs, jnp.tile(msgs[:1], (pad, 1))])
         sigs = jnp.concatenate([sigs, jnp.tile(sigs[:1], (pad, 1))])
     oks = [
         _verify_jit(
-            pk_bn[o : o + VERIFY_CHUNK],
-            msgs[o : o + VERIFY_CHUNK],
-            sigs[o : o + VERIFY_CHUNK],
+            pk_bn[o : o + chunk],
+            msgs[o : o + chunk],
+            sigs[o : o + chunk],
         )
-        for o in range(0, total + pad, VERIFY_CHUNK)
+        for o in range(0, total + pad, chunk)
     ]
     return jnp.concatenate(oks)[:total].reshape(B, n)
 

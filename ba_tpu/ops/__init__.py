@@ -1,0 +1,26 @@
+"""Pallas TPU kernels for the framework's hot ops (with jnp fallbacks).
+
+The reference has no native code (/root/reference is pure Python over
+rpyc, SURVEY.md section 2); in this framework the native-code axis is real
+Pallas kernels for the ops that dominate the BASELINE workloads:
+
+- ``ladder``   — the Ed25519 double-and-add scalar-mult ladder, VMEM-
+  resident limb-plane arithmetic (ba_tpu.ops.planes).  Measured r2 on one
+  chip: 1.33M scalar-mults/s at batch 262k vs 18k/s for the jnp matmul-
+  convolution formulation (~74x); end-to-end batched verify went from
+  ~8.7k to ~40k+ verifies/s.  Default on TPU (ed25519._use_pallas).
+- ``majority`` — the fused masked strict-majority reduction (the vote
+  count of ba.py:159-195 and every EIG resolve level).  This op is HBM-
+  bandwidth-bound and XLA's fusion already saturates it (r2 measurement:
+  kernel ties the jnp path at R up to 4.1M rows), so core/eig.py and
+  core/om.py deliberately keep their jnp formulations and no production
+  path routes through the kernel — it is kept as the measured evidence
+  point and as the fusion template (differential tests in test_ops.py).
+- ``planes``   — shape-agnostic limb-plane field/Edwards arithmetic shared
+  by the kernel bodies and their CPU differential anchors.
+"""
+
+from ba_tpu.ops.ladder import scalar_mult as ladder_scalar_mult
+from ba_tpu.ops.majority import masked_majority_rows
+
+__all__ = ["ladder_scalar_mult", "masked_majority_rows"]

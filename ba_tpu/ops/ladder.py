@@ -1,0 +1,123 @@
+"""Pallas TPU kernel: the Ed25519 double-and-add ladder, VMEM-resident.
+
+The hot op of batched signature verification (BASELINE config #3) is
+``[k]P`` over 512 scalar bits — 1024 complete Edwards additions per lane.
+The jnp path (ba_tpu.crypto.ed25519.scalar_mult) expresses each field
+multiply as a [.., 484] x [484, 43] matmul whose 0/1 anti-diagonal matrix
+wastes 43x the necessary MACs, and its lax.scan carry (8 coordinate
+tensors) round-trips HBM every step.  This kernel fixes both:
+
+- limb-major planes (ba_tpu.ops.planes): a field element is 22 separate
+  [8, 128] VMEM tiles, so the schoolbook convolution is exactly 484 vector
+  MACs on the VPU and every limb shift is register renaming;
+- the whole 512-step ladder runs inside one kernel invocation per batch
+  tile: points, temporaries and the bit-packed scalars (16 uint32 words per
+  lane) never leave VMEM.
+
+Layout: batch is padded to 1024-lane tiles shaped [8, 128] (sublane x
+lane); a point is [22, 8g, 128] per coordinate; scalars are packed LSB-
+first into [nbits/32, 8g, 128] int32 words.  Grid = one program per tile.
+
+Differential contract: bit-for-bit equal to ed25519.scalar_mult (and hence
+to the pure-Python oracle).  The assembled kernel is pinned on real TPU
+(BA_TPU_TESTS_ON_TPU=1, test_ladder_pallas_matches_scalar_mult_tpu); plain
+CPU runs cover the shared plane arithmetic and the packing/tiling plumbing
+instead — interpret mode would execute ~5M interpreted vector ops per tile
+and an XLA-CPU jit of the 2-point-add body compiles for >9 minutes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ba_tpu.crypto.field import LIMBS
+from ba_tpu.ops.planes import p_identity, p_point_add, p_point_select
+
+TILE_ROWS = 8
+LANES = 128
+TILE = TILE_ROWS * LANES
+
+
+def _ladder_kernel(nbits, x_ref, y_ref, z_ref, t_ref, bits_ref,
+                   ox_ref, oy_ref, oz_ref, ot_ref):
+    q = tuple(
+        [ref[i] for i in range(LIMBS)]
+        for ref in (x_ref, y_ref, z_ref, t_ref)
+    )
+    zero = jnp.zeros((TILE_ROWS, LANES), jnp.int32)
+    acc = p_identity(zero)
+
+    def body(t, state):
+        acc, q = state
+        word = bits_ref[pl.ds(t >> 5, 1)][0]  # [8, 128]
+        bit = (word >> (t & 31)) & 1
+        added = p_point_add(acc, q)
+        acc = p_point_select(bit == 1, added, acc)
+        q = p_point_add(q, q)
+        return (acc, q)
+
+    acc, _ = jax.lax.fori_loop(0, nbits, body, (acc, q))
+    for out_ref, planes in zip((ox_ref, oy_ref, oz_ref, ot_ref), acc):
+        for i in range(LIMBS):
+            out_ref[i] = planes[i]
+
+
+def _to_tiles(coord: jnp.ndarray, batch_pad: int) -> jnp.ndarray:
+    """[B, 22] -> [22, rows, 128] (zero-padded; zeros are add-safe)."""
+    B = coord.shape[0]
+    coord = jnp.pad(coord, ((0, batch_pad - B), (0, 0)))
+    return jnp.transpose(coord, (1, 0)).reshape(LIMBS, batch_pad // LANES, LANES)
+
+
+def _from_tiles(tiles: jnp.ndarray, B: int) -> jnp.ndarray:
+    return jnp.transpose(tiles.reshape(LIMBS, -1), (1, 0))[:B]
+
+
+def _pack_bits(bits: jnp.ndarray, batch_pad: int) -> jnp.ndarray:
+    """[B, nbits] {0,1} int32 -> [nbits/32, rows, 128] packed words."""
+    B, nbits = bits.shape
+    assert nbits % 32 == 0
+    w = bits.reshape(B, nbits // 32, 32) << jnp.arange(32, dtype=jnp.int32)
+    words = w.sum(axis=-1, dtype=jnp.int32)  # [B, nw]
+    words = jnp.pad(words, ((0, batch_pad - B), (0, 0)))
+    return jnp.transpose(words, (1, 0)).reshape(-1, batch_pad // LANES, LANES)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def scalar_mult(point: tuple, bits: jnp.ndarray, *, interpret: bool = False):
+    """Drop-in Pallas replacement for ``ed25519.scalar_mult``.
+
+    point: (X, Y, Z, T) limb tensors [B, 22]; bits [B, nbits] LSB-first,
+    nbits a static multiple of 32.  Returns the product point, [B, 22] x 4.
+    """
+    B, nbits = bits.shape
+    batch_pad = -(-B // TILE) * TILE
+    grid = batch_pad // TILE
+    coords = [_to_tiles(c, batch_pad) for c in point]
+    words = _pack_bits(bits.astype(jnp.int32), batch_pad)
+
+    plane_spec = pl.BlockSpec(
+        (LIMBS, TILE_ROWS, LANES), lambda i: (0, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    bits_spec = pl.BlockSpec(
+        (nbits // 32, TILE_ROWS, LANES), lambda i: (0, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct(
+        (LIMBS, batch_pad // LANES, LANES), jnp.int32
+    )
+    outs = pl.pallas_call(
+        functools.partial(_ladder_kernel, nbits),
+        grid=(grid,),
+        in_specs=[plane_spec] * 4 + [bits_spec],
+        out_specs=(plane_spec,) * 4,
+        out_shape=(out_shape,) * 4,
+        interpret=interpret,
+    )(*coords, words)
+    return tuple(_from_tiles(o, B) for o in outs)

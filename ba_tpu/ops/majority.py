@@ -1,0 +1,94 @@
+"""Pallas TPU kernel: fused masked strict-majority reduction.
+
+The core vote-counting op of the framework — the tensorised form of the
+reference's O(n^2) poll mesh (/root/reference/ba.py:159-195) and of every
+EIG resolve level (ba_tpu/core/eig.py:98-115): for each row (a receiver,
+or a receiver x path pair), count ATTACK/RETREAT over the valid responders
+and emit the strict majority, falling back to the row's own stored value
+when no responder is eligible.
+
+One kernel pass fuses compare + mask + two reductions + the majority
+select, reading ``answers``/``valid`` exactly once.  Measured r2 on one
+chip (R up to 4.1M rows, K in {4, 10, 128}): XLA's fusion of the jnp
+formulation ties or beats this kernel — the op is HBM-bandwidth-bound and
+already saturated — so core/eig.py and core/om.py intentionally keep the
+jnp path and nothing routes through here in production; the kernel is the
+measured-evidence point for that decision (SURVEY.md section 2's native-
+kernel obligation) and the template for heavier fusions.
+
+Layout: rows tile the sublane axis, responders pad onto the 128-lane axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+
+ROW_TILE = 256
+LANES = 128
+
+
+def _majority_kernel(answers_ref, valid_ref, fallback_ref, out_ref):
+    # Per-row values stay int32 [ROW_TILE, 1] throughout: mixing i1/int8
+    # (32, 128)-tiled vectors into the narrow column hits a Mosaic relayout
+    # bug ("non-singleton logical dimension is replicated"); the int8 cast
+    # happens outside the kernel.
+    a = answers_ref[:].astype(jnp.int32)  # [ROW_TILE, K_pad]
+    v = valid_ref[:].astype(jnp.int32)  # padding lanes already 0
+    att = jnp.sum(jnp.where(a == ATTACK, v, 0), axis=1, keepdims=True)
+    ret = jnp.sum(jnp.where(a == RETREAT, v, 0), axis=1, keepdims=True)
+    maj = jnp.where(
+        att > ret,
+        jnp.int32(ATTACK),
+        jnp.where(ret > att, jnp.int32(RETREAT), jnp.int32(UNDEFINED)),
+    )
+    n_eligible = jnp.sum(v, axis=1, keepdims=True)
+    out_ref[:] = jnp.where(n_eligible > 0, maj, fallback_ref[:])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_majority_rows(
+    answers: jnp.ndarray,
+    valid: jnp.ndarray,
+    fallback: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Strict majority per row: answers/valid [R, K], fallback [R] -> [R].
+
+    Tie -> UNDEFINED; zero valid responders -> the fallback value (the EIG
+    OM(0) base case, eig.py:110-115; pass UNDEFINED to reproduce the plain
+    OM(1) tally, where an empty electorate ties at 0 == 0).  Semantics
+    match core/quorum.strict_majority + the eig_resolve guard exactly
+    (differential-tested in tests/test_ops.py).
+    """
+    R, K = answers.shape
+    r_pad = -(-R // ROW_TILE) * ROW_TILE
+    k_pad = -(-K // LANES) * LANES
+    answers = jnp.pad(answers, ((0, r_pad - R), (0, k_pad - K)))
+    valid = jnp.pad(valid, ((0, r_pad - R), (0, k_pad - K)))  # False pad
+    fallback = jnp.pad(fallback, (0, r_pad - R))[:, None].astype(jnp.int32)
+    grid = r_pad // ROW_TILE
+    out = pl.pallas_call(
+        _majority_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((ROW_TILE, k_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_TILE, k_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((r_pad, 1), jnp.int32),
+        interpret=interpret,
+    )(answers, valid, fallback)
+    return out[:R, 0].astype(COMMAND_DTYPE)

@@ -1,0 +1,130 @@
+"""Limb-plane GF(2^255-19) + Edwards arithmetic: the Pallas kernel's math.
+
+``ba_tpu.crypto.field`` lays a field element out as the trailing axis of a
+[B, 22] tensor — convenient for jnp, but on TPU the 22-limb axis wastes
+vector lanes (22 << 128) and every limb shift is a lane shuffle.  Here the
+SAME math is expressed over a *list of 22 arrays* ("planes"), one per limb:
+the limb axis becomes Python-level structure, so a limb shift is register
+renaming (free), the schoolbook convolution is exactly 484 vector MACs
+(the [484 x 43] matmul form burns 43x that in zeros), and every plane op
+vectorises over whatever shape the planes carry — a [B] vector in plain
+jnp, an [8, 128] VMEM tile inside the Pallas ladder kernel
+(ba_tpu.ops.ladder).  These functions are pure and shape-agnostic, so the
+kernel body and the differential-test fallback share one implementation.
+
+Bounds are inherited verbatim from ba_tpu/crypto/field.py (see carry()'s
+contract there); reference: /root/reference has no crypto — this is the
+north-star signed-message machinery (BASELINE.json config #3).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ba_tpu.crypto.field import BITS, FOLD, LIMBS, P_INT, _np_limbs
+
+# Constant field elements as plain Python-int plane lists: broadcasting
+# int * array keeps them shape-agnostic (and free inside the kernel).
+
+
+def const_planes(v: int) -> list[int]:
+    return [int(x) for x in _np_limbs(v % P_INT)]
+
+
+def p_carry(x: list) -> list:
+    """field.carry() on planes: 5 parallel fold passes, same contract."""
+    for _ in range(5):
+        c = [v >> BITS for v in x]
+        r = [v - (cc << BITS) for v, cc in zip(x, c)]
+        x = [
+            r[k] + (c[k - 1] if k > 0 else c[LIMBS - 1] * FOLD)
+            for k in range(LIMBS)
+        ]
+    return x
+
+
+def p_reduce_wide(w: list) -> list:
+    """field._reduce_wide() on 43 convolution planes -> 22 carried planes."""
+    for _ in range(2):
+        c = [v >> BITS for v in w]
+        r = [v - (cc << BITS) for v, cc in zip(w, c)]
+        w = r + [0]
+        for k in range(len(c)):
+            w[k + 1] = w[k + 1] + c[k]
+    lo = [w[k] + w[LIMBS + k] * FOLD for k in range(LIMBS)]
+    lo[1] = lo[1] + w[2 * LIMBS] * (361 << 6)
+    return p_carry(lo)
+
+
+def p_mul(a: list, b: list) -> list:
+    """Field multiply on planes: the 484-MAC schoolbook convolution."""
+    conv = [0] * (2 * LIMBS - 1)
+    for i in range(LIMBS):
+        ai = a[i]
+        if isinstance(ai, int) and ai == 0:
+            continue
+        for j in range(LIMBS):
+            bj = b[j]
+            if isinstance(bj, int) and bj == 0:
+                continue
+            conv[i + j] = conv[i + j] + ai * bj
+    return p_reduce_wide(conv)
+
+
+def p_add(a: list, b: list) -> list:
+    return [x + y for x, y in zip(a, b)]
+
+
+def p_sub(a: list, b: list) -> list:
+    return [x - y for x, y in zip(a, b)]
+
+
+def p_mul2(a: list) -> list:
+    """mul_small(a, 2): the only small-constant multiply point_add needs."""
+    return p_carry([x * 2 for x in a])
+
+
+def p_select(mask, a: list, b: list) -> list:
+    """Per-element select between two plane lists; mask broadcasts."""
+    return [jnp.where(mask, x, y) for x, y in zip(a, b)]
+
+
+def p_point_select(mask, p: tuple, q: tuple) -> tuple:
+    """Point-level select: (X, Y, Z, T) plane-list tuples."""
+    return tuple(p_select(mask, a, b) for a, b in zip(p, q))
+
+
+# -- Edwards points as 4 plane lists (X, Y, Z, T) -----------------------------
+
+from ba_tpu.crypto.oracle import B_X, B_Y, D, P  # noqa: E402
+
+D2_PLANES = const_planes(2 * D % P)
+BASE_PLANES = (
+    const_planes(B_X),
+    const_planes(B_Y),
+    const_planes(1),
+    const_planes(B_X * B_Y % P),
+)
+
+
+def p_identity(zeros_like) -> tuple:
+    """Identity point planes; ``zeros_like`` is a concrete zero array of the
+    plane shape (kernels pass a VMEM-tile zero, tests a [B] zero)."""
+    z = [zeros_like] * LIMBS
+    one = [zeros_like + 1] + [zeros_like] * (LIMBS - 1)
+    return (z, one, list(one), list(z))
+
+
+def p_point_add(p: tuple, q: tuple) -> tuple:
+    """ed25519.point_add on planes: complete unified addition, 9 muls."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = p_mul(p_sub(y1, x1), p_sub(y2, x2))
+    b = p_mul(p_add(y1, x1), p_add(y2, x2))
+    c = p_mul(p_mul(t1, t2), D2_PLANES)
+    d = p_mul2(p_mul(z1, z2))
+    e = p_sub(b, a)
+    f = p_sub(d, c)
+    g = p_add(d, c)
+    h = p_add(b, a)
+    return (p_mul(e, f), p_mul(g, h), p_mul(f, g), p_mul(e, h))

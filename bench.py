@@ -236,9 +236,12 @@ def bench_sweep10k_signed(jax, jnp, jr):
     sks, pks = commander_keys(batch)
     msgs_t, sigs_t = sign_value_tables(sks, pks)
     setup_sign_s = time.perf_counter() - t0
-    # Warm the verify kernel on a chunk-sized slice so the one-time XLA
-    # compile is not billed as throughput.
-    c = min(batch, 2048)
+    # Warm the verify kernel on an exactly chunk-shaped call so the
+    # one-time XLA/Mosaic compile is not billed as throughput (a different
+    # warmup shape would recompile on the timed call).
+    from ba_tpu.crypto.signed import _verify_chunk
+
+    c = min(batch, _verify_chunk() // 2)
     jax.block_until_ready(verify_received(pks[:c], msgs_t[:c], sigs_t[:c]))
     t0 = time.perf_counter()
     ok = verify_received(pks, msgs_t, sigs_t)  # [B, 2]
@@ -314,10 +317,16 @@ def main() -> None:
 
     trace = (jax.profiler.trace(args.profile) if args.profile
              else contextlib.nullcontext())
+    names = [n.strip() for n in args.configs.split(",") if n.strip()]
+    unknown = [n for n in names if n not in CONFIGS]
+    if not names or unknown:
+        parser.error(
+            f"unknown config(s) {unknown or args.configs!r}; "
+            f"valid: {', '.join(CONFIGS)}"
+        )
     results = {}
     with trace:
-        for name in args.configs.split(","):
-            name = name.strip()
+        for name in names:
             print(f"bench: {name} ...", file=sys.stderr, flush=True)
             results[name] = CONFIGS[name](jax, jnp, jr)
 
