@@ -162,7 +162,10 @@ def _verify_chunk() -> int:
     """
     env = os.environ.get("BA_TPU_VERIFY_CHUNK")
     if env:
-        return int(env)
+        chunk = int(env)
+        if chunk <= 0:
+            raise ValueError(f"BA_TPU_VERIFY_CHUNK must be positive, got {env!r}")
+        return chunk
     from ba_tpu.crypto.ed25519 import _use_pallas
 
     return 16384 if _use_pallas() else 4096

@@ -14,12 +14,13 @@ Here the same roles are played by XLA collectives over ICI/DCN on a
 """
 
 from ba_tpu.parallel.mesh import make_mesh
-from ba_tpu.parallel.sweep import sharded_sweep, make_sweep_state
+from ba_tpu.parallel.sweep import failover_sweep, sharded_sweep, make_sweep_state
 from ba_tpu.parallel.node_parallel import om1_node_sharded
 from ba_tpu.parallel.sm_parallel import sm_node_sharded
 
 __all__ = [
     "make_mesh",
+    "failover_sweep",
     "sharded_sweep",
     "make_sweep_state",
     "om1_node_sharded",

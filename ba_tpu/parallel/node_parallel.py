@@ -6,9 +6,11 @@ n=1024 that is the object that must be sharded, exactly like a sequence-
 parallel attention matrix.  Layout:
 
 - receivers (the asker axis i) shard across the mesh's "node" axis;
-- the round-1 ``received`` row [B, n] is replicated via one ``all_gather``
-  (the TPU analogue of the reference's O(n^2) get_order() RPC mesh,
-  ba.py:169-186 — every chip then answers for its receivers locally);
+- the round-1 ``received`` row [B, n] is *recomputed replicated*: every
+  node shard derives the identical row from a shared per-data-shard PRNG
+  key, so no cross-chip broadcast is needed at all (the reference's O(n^2)
+  get_order() RPC mesh, ba.py:169-186, becomes a local masked select —
+  every chip answers for its own receivers);
 - quorum counts come back with a single ``psum`` over "node"
   (the majority-of-majorities gather, ba.py:197-223).
 

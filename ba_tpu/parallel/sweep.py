@@ -72,6 +72,17 @@ def make_sweep_state(
     )
 
 
+def decision_histogram(decision: jnp.ndarray) -> jnp.ndarray:
+    """[B] decisions -> 3-bin [retreat, attack, undefined] counts."""
+    return jnp.stack(
+        [
+            jnp.sum(decision == RETREAT),
+            jnp.sum(decision == ATTACK),
+            jnp.sum(decision == UNDEFINED),
+        ]
+    )
+
+
 def agreement_step(keys: jax.Array, state: SimState, m: int = 1):
     """One agreement round per instance with per-instance PRNG keys.
 
@@ -90,19 +101,68 @@ def agreement_step(keys: jax.Array, state: SimState, m: int = 1):
     )
     n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
     decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
-    histogram = jnp.stack(
-        [
-            jnp.sum(decision == RETREAT),
-            jnp.sum(decision == ATTACK),
-            jnp.sum(decision == UNDEFINED),
-        ]
-    )
+    histogram = decision_histogram(decision)
     return {
         "majorities": majorities,
         "decision": decision,
         "needed": needed,
         "total": total,
         "histogram": histogram,
+    }
+
+
+def failover_sweep(
+    key: jax.Array,
+    state: SimState,
+    kill_schedule: jnp.ndarray,
+    m: int = 1,
+):
+    """Multi-round sweep with on-device leader failover: the tensor-scale
+    detect -> elect -> continue loop of the reference's run thread
+    (ba.py:306-314, ping failure -> elect -> next round).
+
+    ``kill_schedule`` [R, B, n] bool: who dies before each of the R rounds
+    (crash faults, the batched ``g-kill`` ba.py:415-425).  Per scan step,
+    entirely on device — zero host round-trips between rounds:
+
+    1. apply the kills to the alive mask;
+    2. instances whose leader died re-elect by lowest alive id
+       (``elect_lowest_id``, the argmin form of ba.py:126-157) — survivors
+       keep their leader ("election is for life", ba.py:124-125);
+    3. run the agreement round and record the decision histogram.
+
+    Returns dict with ``leaders`` [R, B] (leader after each round's
+    election), ``decisions`` [R, B] int8, ``histograms`` [R, 3], and the
+    final SimState.  Jittable; shard the batch axis for multi-chip use
+    (sharded_sweep's layout applies unchanged).
+    """
+    from ba_tpu.core.election import elect_lowest_id
+
+    R = kill_schedule.shape[0]
+
+    def step(carry, inp):
+        leader, alive = carry
+        k, kill = inp
+        alive = alive & ~kill
+        leader_dead = ~jnp.take_along_axis(alive, leader[:, None], axis=1)[:, 0]
+        elected = elect_lowest_id(state.ids, alive)
+        leader = jnp.where(leader_dead, elected, leader)
+        st = SimState(state.order, leader, state.faulty, alive, state.ids)
+        majorities = om1_round(k, st) if m == 1 else eig_round(k, st, m)
+        n_a, n_r, n_u = majority_counts(majorities, alive)
+        decision, needed, total = quorum_decision(n_a, n_r, n_u)
+        return (leader, alive), (leader, decision, decision_histogram(decision))
+
+    keys = jr.split(key, R)
+    (leader, alive), (leaders, decisions, hists) = jax.lax.scan(
+        step, (state.leader, state.alive), (keys, kill_schedule)
+    )
+    final = SimState(state.order, leader, state.faulty, alive, state.ids)
+    return {
+        "leaders": leaders,
+        "decisions": decisions,
+        "histograms": hists,
+        "final_state": final,
     }
 
 

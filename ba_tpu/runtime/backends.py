@@ -105,8 +105,8 @@ class JaxBackend:
         self.m = m
         self.protocol = protocol
         self.signed = signed
-        self._compiled = {}  # capacity -> jitted fn
-        self._signed_compiled = {}  # capacity -> (jitted r1, jitted post-sign)
+        self._compiled = None  # jitted step (jit re-specializes per capacity)
+        self._signed_compiled = None  # (jitted r1, jitted post-sign) pair
         self._keys = None  # cached (sks, pks) for the B=1 commander
 
     @staticmethod
@@ -116,8 +116,8 @@ class JaxBackend:
             cap *= 2
         return cap
 
-    def _fn(self, capacity: int):
-        if capacity not in self._compiled:
+    def _fn(self):
+        if self._compiled is None:
             import jax
 
             from ba_tpu.core.eig import eig_round
@@ -134,8 +134,8 @@ class JaxBackend:
                     return om1_round(key, state)
                 return eig_round(key, state, m)
 
-            self._compiled[capacity] = jax.jit(step)
-        return self._compiled[capacity]
+            self._compiled = jax.jit(step)
+        return self._compiled
 
     def _make_state(self, generals, leader_idx, order_code):
         import jax.numpy as jnp
@@ -162,14 +162,14 @@ class JaxBackend:
             ids=jnp.asarray(ids),
         )
 
-    def _signed_fns(self, capacity: int):
-        """Jitted (round-1 broadcast, post-sign SM) pair per capacity.
+    def _signed_fns(self):
+        """Jitted (round-1 broadcast, post-sign SM) pair.
 
         The host Ed25519 signer sits between the two device programs, so
-        the signed path is split there; everything on device is compiled
-        once per capacity, like the unsigned ``_fn`` cache.
+        the signed path is split there; jax.jit re-specializes each per
+        roster capacity on its own.
         """
-        if capacity not in self._signed_compiled:
+        if self._signed_compiled is None:
             import jax
 
             from ba_tpu.core.om import round1_broadcast
@@ -182,11 +182,8 @@ class JaxBackend:
                     key, state, m, sig_valid=sig_valid, received=received
                 )
 
-            self._signed_compiled[capacity] = (
-                jax.jit(round1_broadcast),
-                jax.jit(post),
-            )
-        return self._signed_compiled[capacity]
+            self._signed_compiled = (jax.jit(round1_broadcast), jax.jit(post))
+        return self._signed_compiled
 
     def _run_signed(self, state, seed):
         import jax.random as jr
@@ -201,7 +198,7 @@ class JaxBackend:
         if self._keys is None:
             self._keys = commander_keys(1, seed=0)
         sks, pks = self._keys
-        r1, post = self._signed_fns(state.n)
+        r1, post = self._signed_fns()
         k1, k2 = jr.split(jr.key(seed))
         received = r1(k1, state)
         msgs, sigs = sign_received(sks, pks, np.asarray(received))
@@ -216,5 +213,5 @@ class JaxBackend:
         if self.signed:
             maj = self._run_signed(state, seed)
         else:
-            maj = self._fn(state.n)(jr.key(seed), state)
+            maj = self._fn()(jr.key(seed), state)
         return [int(v) for v in maj[0, :n]]

@@ -21,9 +21,11 @@ replacement for the reference's thread-per-general runtime (ba.py:66-122,
 from __future__ import annotations
 
 import dataclasses
+import time
 
 from ba_tpu.core.quorum import quorum_threshold_py
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED, COMMAND_NAMES, command_from_name
+from ba_tpu.utils import metrics
 
 BASE_PORT = 18812  # rpyc's default port, kept for display parity (ba.py:355)
 
@@ -151,9 +153,12 @@ class Cluster:
         leader_idx = next(
             i for i, g in enumerate(self.generals) if g.id == self.leader_id
         )
+        t0 = time.perf_counter()
         majorities = self.backend.run_round(
             self.generals, leader_idx, order_code, self._round_seed()
         )
+        round_elapsed = time.perf_counter() - t0
+        round_idx = self._round
         self._round += 1
 
         per_general = []
@@ -185,6 +190,23 @@ class Cluster:
             decision = "attack"
         else:
             decision = "undefined"
+        metrics.emit(
+            {
+                "event": "agreement_round",
+                "round": round_idx,
+                "n": len(self.generals),
+                "leader_id": self.leader_id,
+                "order": command,
+                "decision": decision,
+                "n_attack": n_attack,
+                "n_retreat": n_retreat,
+                "n_undefined": n_undefined,
+                "needed": needed,
+                "total": total,
+                "nr_faulty": nr_faulty,
+                "round_elapsed_s": round(round_elapsed, 6),
+            }
+        )
         return RoundResult(
             per_general=per_general,
             nr_faulty=nr_faulty,

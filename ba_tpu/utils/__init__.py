@@ -1,5 +1,6 @@
 """Host-side utilities that must not depend on the rest of ba_tpu."""
 
 from ba_tpu.utils.platform import force_virtual_cpu_devices
+from ba_tpu.utils.metrics import MetricsSink
 
-__all__ = ["force_virtual_cpu_devices"]
+__all__ = ["force_virtual_cpu_devices", "MetricsSink"]

@@ -1,0 +1,62 @@
+"""Structured metrics: JSON-lines observability for agreement rounds.
+
+The reference's only observability is bare ``print()`` to stdout with
+exceptions swallowed (/root/reference/ba.py:255,389; SURVEY.md section 6
+rules the new framework must do far better).  Here every agreement round
+can emit one machine-readable JSON line — decision, vote counts, quorum
+threshold, fault count, wall time — without touching the REPL's
+byte-identical stdout contract (metrics go to a file or stderr).
+
+Enable with ``BA_TPU_METRICS=<path>`` (append) or ``BA_TPU_METRICS=-``
+(stderr); disabled (zero overhead beyond one dict build) otherwise.
+Device-side sweeps keep their metrics as tensors (``failover_sweep`` /
+``sharded_sweep`` return per-round decision histograms); this sink is the
+host-side shell's counterpart.  ``bench.py --profile DIR`` adds the
+jax.profiler trace for kernel-level timing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+class MetricsSink:
+    """Append-mode JSON-lines emitter; a falsy target disables it."""
+
+    def __init__(self, target: str | None = None):
+        self.target = (
+            target if target is not None else os.environ.get("BA_TPU_METRICS")
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.target)
+
+    def emit(self, record: dict) -> None:
+        if not self.target:
+            return
+        record.setdefault("ts", round(time.time(), 3))
+        line = json.dumps(record)
+        if self.target == "-":
+            print(line, file=sys.stderr, flush=True)
+        else:
+            with open(self.target, "a") as fh:
+                fh.write(line + "\n")
+
+
+_default: MetricsSink | None = None
+
+
+def default_sink() -> MetricsSink:
+    """Process-wide sink configured from the environment (lazily)."""
+    global _default
+    if _default is None:
+        _default = MetricsSink()
+    return _default
+
+
+def emit(record: dict) -> None:
+    default_sink().emit(record)

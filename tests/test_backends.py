@@ -7,6 +7,7 @@ deterministic case is the parity argument for the tensorised core.
 
 import pytest
 
+from ba_tpu.core.types import ATTACK
 from ba_tpu.runtime.backends import JaxBackend, PyBackend
 from ba_tpu.runtime.cluster import Cluster
 from ba_tpu.runtime.repl import handle_command
@@ -66,11 +67,17 @@ def test_faulty_leader_agreement_property():
 def test_jax_backend_capacity_reuse():
     # g-add within the padded capacity must not recompile; crossing a
     # power-of-two boundary compiles exactly one new program.
+    # Padding is what prevents recompiles: jax.jit re-traces only on new
+    # shapes (its public contract), so equal padded state shapes across
+    # g-add within a power-of-two boundary mean one compiled program.
     backend = JaxBackend(platform="cpu")
     cluster = Cluster(3, backend, seed=0)
     drive(cluster, ["actual-order attack"])
-    assert set(backend._compiled) == {4}
+    assert backend._capacity(3) == 4
+    shape3 = backend._make_state(cluster.generals, 0, ATTACK).faulty.shape
     drive(cluster, ["g-add 1", "actual-order attack"])
-    assert set(backend._compiled) == {4}
+    shape4 = backend._make_state(cluster.generals, 0, ATTACK).faulty.shape
+    assert shape3 == shape4 == (1, 4)  # same program serves both rosters
     drive(cluster, ["g-add 1", "actual-order attack"])
-    assert set(backend._compiled) == {4, 8}
+    shape5 = backend._make_state(cluster.generals, 0, ATTACK).faulty.shape
+    assert shape5 == (1, 8)  # crossing the boundary pads to the next pow2

@@ -1,0 +1,57 @@
+"""Observability: structured JSON-lines metrics from the agreement round.
+
+SURVEY.md section 6: the reference has print()-only observability
+(ba.py:255,389); the framework must do far better.  These pin the metrics
+contract: one parseable line per ``actual-order``, with decision, vote
+counts, quorum threshold, fault count, and wall time — and zero lines
+(plus unchanged REPL output) when the sink is disabled.
+"""
+
+import json
+
+from ba_tpu.runtime.backends import PyBackend
+from ba_tpu.runtime.cluster import Cluster
+from ba_tpu.utils import metrics
+
+
+def _with_sink(monkeypatch, target):
+    monkeypatch.setattr(metrics, "_default", metrics.MetricsSink(target))
+
+
+def test_round_emits_one_json_line(tmp_path, monkeypatch):
+    path = tmp_path / "metrics.jsonl"
+    _with_sink(monkeypatch, str(path))
+    cluster = Cluster(4, PyBackend(), seed=0)
+    cluster.set_faulty(2, True)
+    res = cluster.actual_order("attack")
+    lines = path.read_text().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["event"] == "agreement_round"
+    assert rec["round"] == 0 and rec["n"] == 4 and rec["leader_id"] == 1
+    assert rec["decision"] == res.decision
+    assert rec["n_attack"] == res.n_attack
+    assert rec["needed"] == res.needed and rec["total"] == res.total
+    assert rec["nr_faulty"] == 1
+    assert rec["round_elapsed_s"] >= 0 and "ts" in rec
+
+    cluster.actual_order("retreat")
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2 and json.loads(lines[1])["round"] == 1
+
+
+def test_disabled_sink_writes_nothing(tmp_path, monkeypatch):
+    _with_sink(monkeypatch, None)
+    monkeypatch.delenv("BA_TPU_METRICS", raising=False)
+    cluster = Cluster(3, PyBackend(), seed=1)
+    assert cluster.actual_order("retreat") is not None
+    assert not list(tmp_path.iterdir())
+
+
+def test_sink_env_configuration(tmp_path, monkeypatch):
+    path = tmp_path / "env.jsonl"
+    monkeypatch.setenv("BA_TPU_METRICS", str(path))
+    sink = metrics.MetricsSink()
+    assert sink.enabled
+    sink.emit({"event": "x"})
+    assert json.loads(path.read_text())["event"] == "x"

@@ -96,3 +96,68 @@ def test_graft_entry_dryrun(eight_devices):
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+# -- on-device leader failover (ba.py:306-314 at tensor scale) ----------------
+
+
+def test_failover_sweep_reelects_per_instance():
+    from ba_tpu.parallel import failover_sweep
+
+    B, n, R = 4, 6, 3
+    state = make_state(B, n, order=ATTACK)
+    kills = jnp.zeros((R, B, n), bool)
+    # Round 1: kill the leader (idx 0) in instances 0 and 2 only.
+    kills = kills.at[1, [0, 2], 0].set(True)
+    # Round 2: kill general 1 in instance 0 -> its leadership moves on;
+    # instance 2 keeps leader 1 ("election is for life", ba.py:124-125).
+    kills = kills.at[2, 0, 1].set(True)
+    out = jax.jit(lambda k, s, ks: failover_sweep(k, s, ks))(
+        jr.key(0), state, kills
+    )
+    leaders = np.asarray(out["leaders"])  # [R, B]
+    assert leaders[0].tolist() == [0, 0, 0, 0]
+    assert leaders[1].tolist() == [1, 0, 1, 0]
+    assert leaders[2].tolist() == [2, 0, 1, 0]
+    # Honest clusters keep deciding the order; totals track the kills.
+    decisions = np.asarray(out["decisions"])
+    assert (decisions == ATTACK).all()
+    final_alive = np.asarray(out["final_state"].alive)
+    assert final_alive.sum(axis=1).tolist() == [4, 6, 5, 6]
+
+
+def test_failover_sweep_om2_and_faulty():
+    from ba_tpu.parallel import failover_sweep
+
+    B, n, R = 8, 7, 2
+    faulty = jnp.zeros((B, n), bool).at[:, 3].set(True)
+    state = make_state(B, n, order=RETREAT, faulty=faulty)
+    kills = jnp.zeros((R, B, n), bool).at[1, :, 0].set(True)
+    out = failover_sweep(jr.key(1), state, kills, m=2)
+    leaders = np.asarray(out["leaders"])
+    assert (leaders[0] == 0).all() and (leaders[1] == 1).all()
+    # OM(2) with 1 traitor among 6 alive: validity holds post-failover.
+    assert (np.asarray(out["decisions"])[1] == RETREAT).all()
+    hists = np.asarray(out["histograms"])
+    assert hists.shape == (R, 3) and (hists.sum(axis=1) == B).all()
+
+
+def test_failover_sweep_sharded(mesh8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ba_tpu.parallel import failover_sweep
+
+    B, n, R = 16, 8, 2
+    state = make_state(B, n, order=ATTACK)
+    state = jax.tree.map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh8, P("data", *([None] * (x.ndim - 1))))
+        ),
+        state,
+    )
+    kills = jnp.zeros((R, B, n), bool).at[1, :, 0].set(True)
+    out = jax.jit(lambda k, s, ks: failover_sweep(k, s, ks))(
+        jr.key(2), state, kills
+    )
+    assert (np.asarray(out["leaders"])[1] == 1).all()
+    assert (np.asarray(out["decisions"]) == ATTACK).all()
