@@ -172,7 +172,14 @@ def decompress(by: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
     v = F.carry(F.add(F.mul(yy, _D), jnp.broadcast_to(_ONE, yy.shape)))
     v3 = F.mul(F.square(v), v)
     v7 = F.mul(F.square(v3), v)
-    t = F.pow_const(F.mul(u, v7), (P - 5) // 8)
+    if _use_pallas():
+        from ba_tpu.ops.powchain import pow_planes
+
+        uv7 = F.mul(u, v7)  # kernel tiling is 2-D; keep [...] batch dims
+        flat = uv7.reshape(-1, F.LIMBS)
+        t = pow_planes(flat, (P - 5) // 8).reshape(uv7.shape)
+    else:
+        t = F.pow_const(F.mul(u, v7), (P - 5) // 8)
     x = F.mul(F.mul(u, v3), t)
     vxx = F.mul(v, F.square(x))
     root1 = F.eq(vxx, u)

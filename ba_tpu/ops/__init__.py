@@ -7,8 +7,11 @@ Pallas kernels for the ops that dominate the BASELINE workloads:
 - ``ladder``   — the Ed25519 double-and-add scalar-mult ladder, VMEM-
   resident limb-plane arithmetic (ba_tpu.ops.planes).  Measured r2 on one
   chip: 1.33M scalar-mults/s at batch 262k vs 18k/s for the jnp matmul-
-  convolution formulation (~74x); end-to-end batched verify went from
-  ~8.7k to ~40k+ verifies/s.  Default on TPU (ed25519._use_pallas).
+  convolution formulation (~74x).  Default on TPU (ed25519._use_pallas).
+- ``powchain`` — fixed-exponent square-and-multiply for decompression's
+  (p-5)/8 modular square root, same plane recipe (2.4x the jnp chain).
+  With both kernels, end-to-end batched verify went from ~8.7k (r1) to
+  ~119k verifies/s at 64k-signature chunks.
 - ``majority`` — the fused masked strict-majority reduction (the vote
   count of ba.py:159-195 and every EIG resolve level).  This op is HBM-
   bandwidth-bound and XLA's fusion already saturates it (r2 measurement:

@@ -1,0 +1,86 @@
+"""Pallas TPU kernel: fixed-exponent field exponentiation (square chains).
+
+Second of the verify bottlenecks after the scalar-mult ladder: point
+decompression (RFC 8032 5.1.3, ba_tpu/crypto/ed25519.decompress) computes
+the modular square root via ``(u v^7) ^ ((p-5)/8)`` — a 252-step
+square-and-multiply over GF(2^255-19), ~380 field muls per lane that the
+jnp path runs as matmul convolutions with HBM round-trips between steps
+(~half of decompress's ~70 ms at 16k lanes, measured r2).  Same recipe as
+ops/ladder.py: limb-plane arithmetic (ops/planes.py) VMEM-resident across
+the whole chain, exponent bits packed into SMEM words, one grid program
+per 1024-lane tile.
+
+The exponent is a static Python int (the kernel is specialized per
+exponent, like ``field.pow_const``); the chain is LSB-first
+square-and-multiply with a branch-free select, matching pow_const's
+semantics bit for bit (differential tests in tests/test_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ba_tpu.crypto.field import LIMBS
+from ba_tpu.ops.ladder import LANES, TILE, TILE_ROWS, _from_tiles, _to_tiles
+from ba_tpu.ops.planes import const_planes, p_carry, p_mul, p_select
+
+_ONE_PLANES = const_planes(1)
+
+
+def _pow_kernel(nbits, a_ref, words_ref, out_ref):
+    base = p_carry([a_ref[i] for i in range(LIMBS)])
+    shape = (TILE_ROWS, LANES)
+    result = [jnp.full(shape, c, jnp.int32) for c in _ONE_PLANES]
+
+    def body(t, state):
+        result, base = state
+        word = words_ref[t >> 5, 0]
+        bit = (word >> (t & 31)) & 1
+        result = p_select(bit == 1, p_mul(result, base), result)
+        return (result, p_mul(base, base))
+
+    result, _ = jax.lax.fori_loop(0, nbits, body, (result, base))
+    for i in range(LIMBS):
+        out_ref[i] = result[i]
+
+
+@functools.partial(jax.jit, static_argnames=("e", "interpret"))
+def pow_planes(a: jnp.ndarray, e: int, *, interpret: bool = False):
+    """Drop-in Pallas replacement for ``field.pow_const``: a[B, 22] ** e.
+
+    ``e`` is static; output is in carried form like pow_const's.
+    """
+    B = a.shape[0]
+    nbits = max(e.bit_length(), 1)
+    nw = -(-nbits // 32)
+    words = np.zeros((nw, 1), np.uint32)
+    for i in range(nbits):
+        if (e >> i) & 1:
+            words[i // 32, 0] |= np.uint32(1 << (i % 32))
+    words = words.view(np.int32)
+    batch_pad = -(-B // TILE) * TILE
+    grid = batch_pad // TILE
+    tiles = _to_tiles(a, batch_pad)
+    out = pl.pallas_call(
+        functools.partial(_pow_kernel, nbits),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((LIMBS, TILE_ROWS, LANES), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nw, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((LIMBS, TILE_ROWS, LANES), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (LIMBS, batch_pad // LANES, LANES), jnp.int32
+        ),
+        interpret=interpret,
+    )(tiles, jnp.asarray(words))
+    return _from_tiles(out, B)

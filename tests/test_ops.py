@@ -109,6 +109,39 @@ def test_ladder_pallas_matches_scalar_mult_tpu():
         )
 
 
+# -- fixed-exponent pow chain -------------------------------------------------
+
+
+def test_pow_planes_small_exponent_interpret():
+    # Small exponent keeps interpret mode tractable on CPU (6 steps); the
+    # packing/SMEM-word/select plumbing is identical at any size.
+    from ba_tpu.ops.powchain import pow_planes
+
+    rng = np.random.default_rng(5)
+    a = jnp.asarray(rng.integers(-8000, 8000, (8, F.LIMBS)), jnp.int32)
+    for e in (1, 2, 37):
+        got = pow_planes(a, e, interpret=not _on_tpu())
+        ref = F.pow_const(a, e)
+        np.testing.assert_array_equal(
+            np.asarray(F.canonical(got)), np.asarray(F.canonical(ref))
+        )
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="Mosaic kernel needs real TPU")
+def test_pow_planes_sqrt_exponent_tpu():
+    from ba_tpu.crypto.oracle import P
+    from ba_tpu.ops.powchain import pow_planes
+
+    rng = np.random.default_rng(6)
+    a = jnp.asarray(rng.integers(0, 4096, (1024, F.LIMBS)), jnp.int32)
+    e = (P - 5) // 8
+    got = pow_planes(a, e)
+    ref = jax.jit(lambda x: F.pow_const(x, e))(a)
+    np.testing.assert_array_equal(
+        np.asarray(F.canonical(got)), np.asarray(F.canonical(ref))
+    )
+
+
 # -- masked majority reduce ---------------------------------------------------
 
 
