@@ -24,8 +24,6 @@ north-star addition that makes oral messages *signed* messages.
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
 import jax
@@ -36,18 +34,7 @@ from ba_tpu.crypto.oracle import B_X, B_Y, D, L, P, SQRT_M1
 from ba_tpu.crypto.sha512 import sha512
 
 
-def _use_pallas() -> bool:
-    """Route the scalar-mult ladder through the Pallas kernel?
-
-    BA_TPU_PALLAS=1 forces it, =0 disables, default ("auto") enables it on
-    real TPU only — the kernel is TPU-codegen (Mosaic); CPU tests exercise
-    it explicitly via interpret mode (tests/test_ops.py).  Read at trace
-    time, so flip it before the first jit of verify().
-    """
-    v = os.environ.get("BA_TPU_PALLAS", "auto")
-    if v in ("0", "1"):
-        return v == "1"
-    return jax.devices()[0].platform == "tpu"
+from ba_tpu.utils.platform import use_pallas as _use_pallas  # shared flag
 
 # -- constants ----------------------------------------------------------------
 

@@ -214,25 +214,38 @@ def sha512(msg: jnp.ndarray) -> jnp.ndarray:
     wh = words[..., 0]
     wl = words[..., 1]
 
-    state = [
-        (
-            jnp.broadcast_to(jnp.uint32(int(_IH[i])), (B,)),
-            jnp.broadcast_to(jnp.uint32(int(_IL[i])), (B,)),
+    from ba_tpu.utils.platform import use_pallas
+
+    if use_pallas():
+        # One Mosaic kernel per call: 80 unrolled rounds, window shifts as
+        # register renaming (ba_tpu.ops.sha512_kernel shares these round
+        # functions, so the math exists once).
+        from ba_tpu.ops.sha512_kernel import sha512_blocks
+
+        words16 = sha512_blocks(wh, wl, n_blocks)  # [B, 16] (hi, lo) pairs
+    else:
+        state = [
+            (
+                jnp.broadcast_to(jnp.uint32(int(_IH[i])), (B,)),
+                jnp.broadcast_to(jnp.uint32(int(_IL[i])), (B,)),
+            )
+            for i in range(8)
+        ]
+        for blk in range(n_blocks):
+            state = _compress(state, wh[:, blk], wl[:, blk])
+        words16 = jnp.stack(
+            [part for pair in state for part in pair], axis=1
         )
-        for i in range(8)
-    ]
-    for blk in range(n_blocks):
-        state = _compress(state, wh[:, blk], wl[:, blk])
 
     out = []
-    for sh, sl in state:
-        for word in (sh, sl):
-            out.extend(
-                [
-                    (word >> 24) & 0xFF,
-                    (word >> 16) & 0xFF,
-                    (word >> 8) & 0xFF,
-                    word & 0xFF,
-                ]
-            )
+    for i in range(16):
+        word = words16[:, i]
+        out.extend(
+            [
+                (word >> 24) & 0xFF,
+                (word >> 16) & 0xFF,
+                (word >> 8) & 0xFF,
+                word & 0xFF,
+            ]
+        )
     return jnp.stack(out, axis=1).astype(jnp.uint8)

@@ -1,0 +1,127 @@
+"""Pallas TPU kernel: the SHA-512 compression function, fully unrolled.
+
+Third verify bottleneck (after the scalar-mult ladder and the sqrt pow
+chain): the challenge hash h = SHA-512(R || A || M) costs ~23 ms at 16k
+lanes on the jnp path — whose lax.scan shifts a 16-word sliding window
+with two [16, B] concatenates per round (~160 MB of shuffling per block).
+In a kernel the 80 rounds unroll statically, so the message-schedule
+window is Python-level register renaming, the round constants are
+immediate scalars, and the whole block transform stays in VMEM.
+
+64-bit words live as (hi, lo) uint32 plane pairs exactly as in
+ba_tpu/crypto/sha512.py — the round functions are imported from there, so
+kernel and jnp path share one implementation of the SHA-512 math and the
+differential contract is plumbing-only (tests/test_ops.py pins both).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ba_tpu.crypto.sha512 import (
+    _IH,
+    _IL,
+    _KH,
+    _KL,
+    _add64,
+    _add64_many,
+    _big_sigma0,
+    _big_sigma1,
+    _small_sigma0,
+    _small_sigma1,
+)
+from ba_tpu.ops.ladder import LANES, TILE, TILE_ROWS
+
+ROWS = TILE_ROWS
+
+
+def _to_word_tiles(x: jnp.ndarray, batch_pad: int) -> jnp.ndarray:
+    """[B, n_blocks, 16] words -> word-major [nw, rows, 128] tiles."""
+    B = x.shape[0]
+    x = x.reshape(B, -1)
+    x = jnp.pad(x, ((0, batch_pad - B), (0, 0)))
+    return jnp.transpose(x, (1, 0)).reshape(x.shape[1], batch_pad // LANES, LANES)
+
+
+def _from_word_tiles(tiles: jnp.ndarray, B: int) -> jnp.ndarray:
+    """Inverse of ``_to_word_tiles`` (flattened word axis): -> [B, nw]."""
+    nw = tiles.shape[0]
+    return jnp.transpose(tiles.reshape(nw, -1), (1, 0))[:B]
+
+
+def _sha_kernel(n_blocks, wh_ref, wl_ref, out_ref):
+    shape = (ROWS, LANES)
+    state = [
+        (
+            jnp.full(shape, jnp.uint32(int(_IH[i]))),
+            jnp.full(shape, jnp.uint32(int(_IL[i]))),
+        )
+        for i in range(8)
+    ]
+    for blk in range(n_blocks):
+        w = [
+            (wh_ref[blk * 16 + i], wl_ref[blk * 16 + i]) for i in range(16)
+        ]
+        regs = list(state)
+        for t in range(80):
+            if t < 16:
+                wt = w[t]
+            else:
+                s0 = _small_sigma0(*w[t - 15])
+                s1 = _small_sigma1(*w[t - 2])
+                wt = _add64_many(s1, w[t - 7], s0, w[t - 16])
+                w.append(wt)
+            a, b, c, d, e, f, g, h = regs
+            S1 = _big_sigma1(*e)
+            ch = (e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1])
+            k = (jnp.uint32(int(_KH[t])), jnp.uint32(int(_KL[t])))
+            t1 = _add64_many(h, S1, ch, k, wt)
+            S0 = _big_sigma0(*a)
+            maj = (
+                (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+                (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+            )
+            t2 = _add64(S0[0], S0[1], maj[0], maj[1])
+            regs = [
+                _add64(t1[0], t1[1], t2[0], t2[1]),
+                a, b, c,
+                _add64(d[0], d[1], t1[0], t1[1]),
+                e, f, g,
+            ]
+        state = [
+            _add64(sh, sl, nh, nl)
+            for (sh, sl), (nh, nl) in zip(state, regs)
+        ]
+    for i, (sh, sl) in enumerate(state):
+        out_ref[2 * i] = sh
+        out_ref[2 * i + 1] = sl
+
+
+@functools.partial(jax.jit, static_argnames=("n_blocks", "interpret"))
+def sha512_blocks(wh: jnp.ndarray, wl: jnp.ndarray, n_blocks: int,
+                  *, interpret: bool = False) -> jnp.ndarray:
+    """Compress padded blocks: wh/wl [B, n_blocks, 16] uint32 (big-endian
+    word halves) -> 16 uint32 state words [B, 16] ((hi, lo) interleaved).
+    """
+    B = wh.shape[0]
+    batch_pad = -(-B // TILE) * TILE
+    nw = n_blocks * 16
+
+    spec = lambda k: pl.BlockSpec((k, ROWS, LANES), lambda i: (0, i, 0),
+                                  memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_sha_kernel, n_blocks),
+        grid=(batch_pad // TILE,),
+        in_specs=[spec(nw), spec(nw)],
+        out_specs=spec(16),
+        out_shape=jax.ShapeDtypeStruct(
+            (16, batch_pad // LANES, LANES), jnp.uint32
+        ),
+        interpret=interpret,
+    )(_to_word_tiles(wh, batch_pad), _to_word_tiles(wl, batch_pad))
+    return _from_word_tiles(out, B)

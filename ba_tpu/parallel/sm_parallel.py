@@ -52,6 +52,7 @@ def sm_node_sharded(
     *,
     received: jnp.ndarray | None = None,
     sig_valid: jnp.ndarray | None = None,
+    withhold: jnp.ndarray | None = None,
     collapsed: bool = True,
 ):
     """SM(m) agreement with generals sharded over the "node" mesh axis.
@@ -60,19 +61,25 @@ def sm_node_sharded(
     the node-axis size.  ``received``/``sig_valid`` (optional [B, n]) pin
     the round-1 values and their Ed25519 validity mask, exactly as in
     ``sm_round``.  ``collapsed`` selects the O(n)-per-round fair-coin relay;
-    ``collapsed=False`` runs the exact per-(receiver, sender) coin model.
+    ``collapsed=False`` runs the exact per-(receiver, sender) coin model,
+    optionally under a pinned adversary schedule ``withhold``
+    ([m, B, n, n, 2] bool, receiver axis sharded over "node" — same
+    semantics as ``sm_relay_rounds``).
     Returns the ``om1_agreement``-style dict with ``majorities`` sharded
     [B, n] and replicated quorum outputs.
     """
     B, n = state.faulty.shape
     n_node = mesh.shape["node"]
     assert n % n_node == 0, f"n={n} must divide node axis {n_node}"
+    if withhold is not None and collapsed:
+        raise ValueError("collapsed relay cannot honor a withhold schedule")
     if received is None:
         # Round 1 off-device-mesh: shared code path with sm_round, entering
         # the shard_map node-replicated (O(B*n), not worth sharding).
         k1, key = jr.split(key)
         received = round1_broadcast(k1, state)
     has_sig = sig_valid is not None
+    has_withhold = withhold is not None
 
     def shard_fn(key, order, leader, faulty, alive, rcv, *extra):
         node_idx = jax.lax.axis_index("node")
@@ -93,8 +100,10 @@ def sm_node_sharded(
         # This chip's generals' V-sets after the signed round-1 push.
         seen_l = jnp.stack([rcv_l == RETREAT, rcv_l == ATTACK], axis=-1)
         seen_l = seen_l & alive_l[..., None]
+        extra = list(extra)
         if has_sig:
-            seen_l = seen_l & local(extra[0])[..., None]
+            seen_l = seen_l & local(extra.pop(0))[..., None]
+        wh_l = extra.pop(0) if has_withhold else None  # [m, b, n_local, n, 2]
 
         # Relay coins: distinct stream per (data, node) shard, disjoint from
         # the round-1 stream (which folds in data_idx alone).
@@ -122,9 +131,12 @@ def sm_node_sharded(
                 seen_g = jax.lax.all_gather(seen_l, "node", axis=1, tiled=True)
                 held_honest = jnp.any(seen_g & honest[..., None], axis=1)
                 chain_ok = (r < t)[:, None] | held_honest  # [b, 2]
-                coins = jr.bernoulli(
-                    jr.fold_in(k_relay, r), 0.5, (b, n_local, n, 2)
-                )
+                if wh_l is not None:
+                    coins = ~wh_l[r - 1]
+                else:
+                    coins = jr.bernoulli(
+                        jr.fold_in(k_relay, r), 0.5, (b, n_local, n, 2)
+                    )
                 faulty_sends = (
                     seen_g[:, None, :, :]
                     & coins
@@ -150,7 +162,7 @@ def sm_node_sharded(
         decision, needed, total = quorum_decision(att, ret, und)
         return maj, decision, needed, total, att, ret, und
 
-    cache_key = (mesh, n, m, collapsed, has_sig)
+    cache_key = (mesh, n, m, collapsed, has_sig, has_withhold)
     if cache_key not in _COMPILED:
         in_specs = [
             P(),  # key (replicated)
@@ -162,6 +174,10 @@ def sm_node_sharded(
         ]
         if has_sig:
             in_specs.append(P("data", None))
+        if has_withhold:
+            # [m, B, receiver, sender, value]: receivers shard with their
+            # owning chips, senders/values replicated.
+            in_specs.append(P(None, "data", "node", None, None))
         f = jax.shard_map(
             shard_fn,
             mesh=mesh,
@@ -180,6 +196,8 @@ def sm_node_sharded(
     args = [key, state.order, state.leader, state.faulty, state.alive, received]
     if has_sig:
         args.append(sig_valid)
+    if has_withhold:
+        args.append(withhold)
     maj, decision, needed, total, att, ret, und = _COMPILED[cache_key](*args)
     return {
         "majorities": maj,

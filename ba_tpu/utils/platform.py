@@ -21,6 +21,22 @@ import re
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
+def use_pallas() -> bool:
+    """Route hot crypto ops through the Pallas kernels (ba_tpu.ops)?
+
+    BA_TPU_PALLAS=1 forces them, =0 disables, default ("auto") enables on
+    real TPU only — the kernels are TPU-codegen (Mosaic); CPU tests
+    exercise them explicitly via interpret mode.  Read at trace time, so
+    flip it before the first jit of the caller.
+    """
+    v = os.environ.get("BA_TPU_PALLAS", "auto")
+    if v in ("0", "1"):
+        return v == "1"
+    import jax
+
+    return jax.devices()[0].platform == "tpu"
+
+
 def force_virtual_cpu_devices(n: int = 8) -> None:
     """Ensure >= n virtual CPU devices and select the CPU platform.
 

@@ -346,6 +346,8 @@ def main() -> None:
         ),
         "platform": jax.devices()[0].platform,
         "hbm_peak_gbps_assumed": HBM_PEAK_GBPS,
+        "variance_note": "shared TPU service: +-2x run-to-run on identical "
+                         "code (min-of-3 per config already applied)",
         "configs": results,
     }
     if "sweep10k_signed" in results:

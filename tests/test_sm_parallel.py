@@ -145,6 +145,40 @@ def test_sharded_sig_valid_recovered_by_relay(mesh):
     assert np.all(np.asarray(out["majorities"]) == RETREAT)
 
 
+def test_sharded_withhold_matches_unsharded_exactly(mesh):
+    # A pinned adversary schedule removes all randomness from the relay,
+    # so the sharded exact mode must reproduce the unsharded sm_round
+    # bit-for-bit under the same (received, withhold).
+    B, n, m = 8, 8, 2
+    faulty = jnp.zeros((B, n), bool).at[:, [0, 3]].set(True)
+    state = make_state(B, n, order=ATTACK, faulty=faulty)
+    rng = np.random.default_rng(12)
+    received = jnp.asarray(rng.integers(0, 2, (B, n)), jnp.int8)
+    withhold = jnp.asarray(rng.random((m, B, n, n, 2)) < 0.5)
+    want = sm_round(jr.key(0), state, m, withhold=withhold, received=received)
+    got = sm_node_sharded(
+        mesh, jr.key(0), state, m,
+        received=received, withhold=withhold, collapsed=False,
+    )
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got["majorities"]))
+
+
+def test_sharded_chain_bound_blocks_late_reveal(mesh):
+    # The coalition-only late-reveal guard (sm.py chain bound) must survive
+    # sharding: a faulty commander's unrevealed signed ATTACK stays
+    # unrevealable when t = 1 (mirrors the unsharded test in test_sm.py).
+    B, n, m = 4, 8, 2
+    received = jnp.full((B, n), RETREAT, jnp.int8).at[:, 0].set(ATTACK)
+    faulty = jnp.zeros((B, n), bool).at[:, 0].set(True)
+    state = make_state(B, n, order=ATTACK, faulty=faulty)
+    withhold = jnp.zeros((m, B, n, n, 2), bool)  # coalition sends eagerly
+    out = sm_node_sharded(
+        mesh, jr.key(0), state, m,
+        received=received, withhold=withhold, collapsed=False,
+    )
+    assert np.all(np.asarray(out["majorities"])[:, 1:] == RETREAT)
+
+
 def test_signed_sharded_end_to_end(mesh):
     # The full signed pipeline (host sign -> device Ed25519 verify -> node-
     # sharded relay) with one corrupted signature: the victim recovers via
