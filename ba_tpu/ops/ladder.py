@@ -67,15 +67,17 @@ def _ladder_kernel(nbits, x_ref, y_ref, z_ref, t_ref, bits_ref,
             out_ref[i] = planes[i]
 
 
-def _to_tiles(coord: jnp.ndarray, batch_pad: int) -> jnp.ndarray:
-    """[B, 22] -> [22, rows, 128] (zero-padded; zeros are add-safe)."""
-    B = coord.shape[0]
-    coord = jnp.pad(coord, ((0, batch_pad - B), (0, 0)))
-    return jnp.transpose(coord, (1, 0)).reshape(LIMBS, batch_pad // LANES, LANES)
+def _to_tiles(x: jnp.ndarray, batch_pad: int) -> jnp.ndarray:
+    """[B, k] -> plane-major [k, rows, 128] (zero-padded; zeros are
+    add-safe).  Shared tile-layout contract for every ops kernel."""
+    B, k = x.shape
+    x = jnp.pad(x, ((0, batch_pad - B), (0, 0)))
+    return jnp.transpose(x, (1, 0)).reshape(k, batch_pad // LANES, LANES)
 
 
 def _from_tiles(tiles: jnp.ndarray, B: int) -> jnp.ndarray:
-    return jnp.transpose(tiles.reshape(LIMBS, -1), (1, 0))[:B]
+    """Inverse of ``_to_tiles``: [k, rows, 128] -> [B, k]."""
+    return jnp.transpose(tiles.reshape(tiles.shape[0], -1), (1, 0))[:B]
 
 
 def _pack_bits(bits: jnp.ndarray, batch_pad: int) -> jnp.ndarray:

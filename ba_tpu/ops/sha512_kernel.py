@@ -35,23 +35,9 @@ from ba_tpu.crypto.sha512 import (
     _small_sigma0,
     _small_sigma1,
 )
-from ba_tpu.ops.ladder import LANES, TILE, TILE_ROWS
+from ba_tpu.ops.ladder import LANES, TILE, TILE_ROWS, _from_tiles, _to_tiles
 
 ROWS = TILE_ROWS
-
-
-def _to_word_tiles(x: jnp.ndarray, batch_pad: int) -> jnp.ndarray:
-    """[B, n_blocks, 16] words -> word-major [nw, rows, 128] tiles."""
-    B = x.shape[0]
-    x = x.reshape(B, -1)
-    x = jnp.pad(x, ((0, batch_pad - B), (0, 0)))
-    return jnp.transpose(x, (1, 0)).reshape(x.shape[1], batch_pad // LANES, LANES)
-
-
-def _from_word_tiles(tiles: jnp.ndarray, B: int) -> jnp.ndarray:
-    """Inverse of ``_to_word_tiles`` (flattened word axis): -> [B, nw]."""
-    nw = tiles.shape[0]
-    return jnp.transpose(tiles.reshape(nw, -1), (1, 0))[:B]
 
 
 def _sha_kernel(n_blocks, wh_ref, wl_ref, out_ref):
@@ -123,5 +109,8 @@ def sha512_blocks(wh: jnp.ndarray, wl: jnp.ndarray, n_blocks: int,
             (16, batch_pad // LANES, LANES), jnp.uint32
         ),
         interpret=interpret,
-    )(_to_word_tiles(wh, batch_pad), _to_word_tiles(wl, batch_pad))
-    return _from_word_tiles(out, B)
+    )(
+        _to_tiles(wh.reshape(B, nw), batch_pad),
+        _to_tiles(wl.reshape(B, nw), batch_pad),
+    )
+    return _from_tiles(out, B)

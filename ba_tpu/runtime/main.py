@@ -49,6 +49,13 @@ def build_cluster(argv=None):
         help="sm only: real Ed25519 sign/verify per round (host sign, "
         "batched device verify)",
     )
+    parser.add_argument(
+        "--state",
+        default=None,
+        metavar="FILE",
+        help="checkpoint file: restored at startup when it exists, saved "
+        "on Exit (the reference loses all state on exit; SURVEY.md sec. 6)",
+    )
     args = parser.parse_args(argv)
 
     from ba_tpu.runtime.cluster import Cluster
@@ -71,14 +78,26 @@ def build_cluster(argv=None):
             protocol=args.protocol,
             signed=args.signed,
         )
-    return Cluster(args.n, backend, seed=args.seed)
+    cluster = Cluster(args.n, backend, seed=args.seed)
+    if args.state:
+        import os
+
+        if os.path.exists(args.state):
+            from ba_tpu.utils.snapshot import restore_cluster
+
+            restore_cluster(args.state, cluster)
+    return cluster, args.state
 
 
 def main(argv=None) -> int:
-    cluster = build_cluster(argv)
+    cluster, state_path = build_cluster(argv)
     from ba_tpu.runtime.repl import run_repl
 
     run_repl(cluster, sys.stdin, print)
+    if state_path:
+        from ba_tpu.utils.snapshot import save_cluster
+
+        save_cluster(state_path, cluster)
     return 0
 
 

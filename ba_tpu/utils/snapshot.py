@@ -1,0 +1,137 @@
+"""Checkpoint / resume: durable snapshots of cluster and sweep state.
+
+The reference keeps everything in memory and loses it on exit
+(SURVEY.md section 6: checkpoint/resume "Absent"; state is cleared between
+rounds, ba.py:291-293).  This framework makes both of its state shapes
+durable:
+
+- the interactive cluster (roster ids/ports/fault flags, leader, round
+  counter) serializes to JSON — ``python -m ba_tpu.runtime.main N
+  --state FILE`` restores it at startup and saves on ``Exit``;
+- batched ``SimState`` tensors (and any dict of arrays a sweep produces)
+  serialize to ``.npz`` for long sweep campaigns.
+
+Plain JSON/NPZ rather than orbax: the state is kilobytes of host-side
+metadata plus dense arrays with no sharding to preserve (re-sharding on
+load is one device_put), so the dependency would buy nothing.
+
+All writes are atomic (temp file + ``os.replace``): a crash mid-save — the
+exact event checkpointing exists to survive — must never corrupt the only
+good copy.  Cluster snapshots also record the backend configuration
+(protocol / m / signed / backend class) and ``restore_cluster`` refuses a
+mismatch, so a resumed campaign cannot silently continue under different
+protocol semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def _atomic_write(path: str, write_fn) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def save_sim_state(path: str, state, **extra_arrays) -> None:
+    """SimState (+ any extra named arrays) -> one .npz file."""
+
+    def write(tmp):
+        with open(tmp, "wb") as fh:
+            np.savez(
+                fh,
+                order=np.asarray(state.order),
+                leader=np.asarray(state.leader),
+                faulty=np.asarray(state.faulty),
+                alive=np.asarray(state.alive),
+                ids=np.asarray(state.ids),
+                **{k: np.asarray(v) for k, v in extra_arrays.items()},
+            )
+
+    _atomic_write(path, write)
+
+
+def load_sim_state(path: str):
+    """.npz -> (SimState, dict of extra arrays) on the default device."""
+    import jax.numpy as jnp
+
+    from ba_tpu.core.state import SimState
+
+    with np.load(path) as data:
+        fields = {k: data[k] for k in data.files}
+    state = SimState(
+        order=jnp.asarray(fields.pop("order")),
+        leader=jnp.asarray(fields.pop("leader")),
+        faulty=jnp.asarray(fields.pop("faulty")),
+        alive=jnp.asarray(fields.pop("alive")),
+        ids=jnp.asarray(fields.pop("ids")),
+    )
+    return state, fields
+
+
+def _backend_config(cluster) -> dict:
+    """Protocol-defining backend attributes (class + flags when present)."""
+    b = cluster.backend
+    return {
+        "backend": type(b).__name__,
+        "protocol": getattr(b, "protocol", "om"),
+        "m": getattr(b, "m", 1),
+        "signed": getattr(b, "signed", False),
+    }
+
+
+def save_cluster(path: str, cluster) -> None:
+    """Interactive Cluster -> JSON (roster, leader, round counter, seed,
+    backend configuration)."""
+    doc = {
+        "version": 1,
+        "seed": cluster.seed,
+        "round": cluster._round,
+        "next_id": cluster._next_id,
+        "leader_id": cluster.leader_id,
+        "config": _backend_config(cluster),
+        "generals": [
+            {"id": g.id, "port": g.port, "faulty": g.faulty, "alive": g.alive}
+            for g in cluster.generals
+        ],
+    }
+    def write(tmp):
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+
+    _atomic_write(path, write)
+
+
+def restore_cluster(path: str, cluster) -> None:
+    """Load a JSON snapshot into an existing Cluster (backend unchanged).
+
+    Refuses a snapshot whose recorded backend configuration differs from
+    the running cluster's — a resumed campaign must not silently switch
+    protocol, recursion depth, signing, or engine.
+    """
+    from ba_tpu.runtime.cluster import General
+
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("version") != 1:
+        raise ValueError(f"unknown cluster snapshot version in {path!r}")
+    want = doc.get("config")
+    have = _backend_config(cluster)
+    if want is not None and want != have:
+        raise ValueError(
+            f"snapshot {path!r} was taken with backend config {want}, "
+            f"but this run uses {have}; relaunch with matching flags"
+        )
+    cluster.seed = doc["seed"]
+    cluster._round = doc["round"]
+    cluster._next_id = doc["next_id"]
+    cluster.leader_id = doc["leader_id"]
+    cluster.generals = [General(**g) for g in doc["generals"]]

@@ -81,3 +81,39 @@ def test_jax_backend_capacity_reuse():
     drive(cluster, ["g-add 1", "actual-order attack"])
     shape5 = backend._make_state(cluster.generals, 0, ATTACK).faulty.shape
     assert shape5 == (1, 8)  # crossing the boundary pads to the next pow2
+
+
+# -- SM / signed protocols through the full REPL shell ------------------------
+
+
+def test_sm_backend_repl_honest():
+    # --protocol sm: honest commander -> signatures make agreement exact,
+    # REPL output must match the OM backend on deterministic scripts.
+    script = ["actual-order attack", "g-kill 2", "actual-order retreat"]
+    out_sm = drive(Cluster(5, JaxBackend(platform="cpu", protocol="sm", m=1), seed=7), script)
+    out_om = drive(Cluster(5, JaxBackend(platform="cpu"), seed=7), script)
+    assert out_sm == out_om
+
+
+def test_sm_backend_repl_faulty_commander():
+    # Faulty commander with t = m = 1: honest lieutenants agree (IC1), so
+    # the quorum line reports a decisive 3-of-4... or undefined if the
+    # coalition equivocated; either way all lieutenant rows must agree.
+    cluster = Cluster(4, JaxBackend(platform="cpu", protocol="sm", m=1), seed=3)
+    out = drive(cluster, ["g-state 1 faulty", "actual-order attack"])
+    rows = [l for l in out if l.startswith("G") and "majority" in l]
+    lieutenant_maj = {r.split("majority=")[1].split(",")[0] for r in rows[1:]}
+    assert len(lieutenant_maj) == 1  # IC1 at the REPL surface
+
+
+def test_signed_backend_repl_end_to_end():
+    # --protocol sm --signed: full host-sign -> device-verify round from
+    # the REPL shell (n=4 keeps the CPU jnp verify affordable).
+    cluster = Cluster(
+        4, JaxBackend(platform="cpu", protocol="sm", m=1, signed=True), seed=1
+    )
+    out = drive(cluster, ["actual-order retreat"])
+    assert out[-1] == (
+        "Execute order: retreat! Non-faulty nodes in the system - "
+        "3 out of 4 quorum suggests retreat"
+    )

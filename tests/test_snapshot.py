@@ -1,0 +1,104 @@
+"""Checkpoint/resume: the durability the reference lacks (SURVEY.md §6).
+
+Pins both snapshot shapes — batched SimState tensors to .npz, the
+interactive cluster to JSON — and the CLI ``--state FILE`` contract:
+restore at startup, save on Exit, REPL semantics (ids, leadership,
+fault flags, per-round seeds) indistinguishable from a never-stopped run.
+"""
+
+import io
+
+import numpy as np
+
+import jax.numpy as jnp
+import jax.random as jr
+
+from ba_tpu.core import ATTACK, make_state, om1_agreement
+from ba_tpu.runtime.backends import PyBackend
+from ba_tpu.runtime.cluster import Cluster
+from ba_tpu.utils.snapshot import (
+    load_sim_state,
+    restore_cluster,
+    save_cluster,
+    save_sim_state,
+)
+
+
+def test_sim_state_npz_roundtrip(tmp_path):
+    faulty = jnp.zeros((8, 6), bool).at[:, 2].set(True)
+    state = make_state(8, 6, order=ATTACK, faulty=faulty)
+    decisions = np.arange(8, dtype=np.int8)
+    path = str(tmp_path / "sweep.npz")
+    save_sim_state(path, state, decisions=decisions)
+    back, extra = load_sim_state(path)
+    for field in ("order", "leader", "faulty", "alive", "ids"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(back, field)), np.asarray(getattr(state, field))
+        )
+    np.testing.assert_array_equal(extra["decisions"], decisions)
+    # The restored state is live: a round runs on it unchanged.
+    out = om1_agreement(jr.key(0), back)
+    assert np.all(np.asarray(out["decision"]) == ATTACK)
+
+
+def test_cluster_json_roundtrip(tmp_path):
+    c1 = Cluster(5, PyBackend(), seed=3)
+    c1.set_faulty(2, True)
+    c1.kill(1)  # leadership moves to 2
+    c1.actual_order("attack")  # advances the round counter
+    path = str(tmp_path / "cluster.json")
+    save_cluster(path, c1)
+
+    c2 = Cluster(1, PyBackend(), seed=0)
+    restore_cluster(path, c2)
+    assert [g.id for g in c2.generals] == [g.id for g in c1.generals]
+    assert [g.faulty for g in c2.generals] == [g.faulty for g in c1.generals]
+    assert c2.leader_id == c1.leader_id == 2
+    assert c2._round == c1._round == 1
+    assert c2._next_id == c1._next_id
+    # Resumed run behaves exactly like the uninterrupted one: same seeds,
+    # same roster -> byte-identical round results.
+    r1 = c1.actual_order("retreat")
+    r2 = c2.actual_order("retreat")
+    assert r1 == r2
+
+
+def test_restore_refuses_backend_config_mismatch(tmp_path):
+    import pytest
+
+    from ba_tpu.runtime.backends import JaxBackend
+
+    c1 = Cluster(4, JaxBackend(platform="cpu", protocol="sm", m=2), seed=0)
+    path = str(tmp_path / "sm.json")
+    save_cluster(path, c1)
+    c2 = Cluster(4, PyBackend(), seed=0)
+    with pytest.raises(ValueError, match="backend config"):
+        restore_cluster(path, c2)
+
+
+def test_save_is_atomic_no_tmp_left(tmp_path):
+    c = Cluster(3, PyBackend(), seed=0)
+    path = tmp_path / "c.json"
+    save_cluster(str(path), c)
+    save_cluster(str(path), c)  # overwrite goes through os.replace
+    assert [p.name for p in tmp_path.iterdir()] == ["c.json"]
+
+
+def test_cli_state_flag_restores_roster(tmp_path):
+    from ba_tpu.runtime.main import build_cluster, main
+    import sys
+
+    path = str(tmp_path / "state.json")
+    stdin = sys.stdin
+    try:
+        sys.stdin = io.StringIO("g-kill 1\ng-add 1\nExit\n")
+        main(["3", "--backend", "py", "--state", path])
+    finally:
+        sys.stdin = stdin
+    # Fresh process: restored roster is G2, G3, G4 with leader 2 and the
+    # next id continuing from 5, not a fresh 3-general cluster.
+    cluster, state_path = build_cluster(["3", "--backend", "py", "--state", path])
+    assert state_path == path
+    assert [g.id for g in cluster.generals] == [2, 3, 4]
+    assert cluster.leader_id == 2
+    assert cluster._next_id == 5
