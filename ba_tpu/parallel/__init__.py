@@ -16,6 +16,7 @@ Here the same roles are played by XLA collectives over ICI/DCN on a
 from ba_tpu.parallel.mesh import make_mesh
 from ba_tpu.parallel.sweep import failover_sweep, sharded_sweep, make_sweep_state
 from ba_tpu.parallel.node_parallel import om1_node_sharded
+from ba_tpu.parallel.eig_parallel import eig_node_sharded
 from ba_tpu.parallel.sm_parallel import sm_node_sharded
 
 __all__ = [
@@ -24,5 +25,6 @@ __all__ = [
     "sharded_sweep",
     "make_sweep_state",
     "om1_node_sharded",
+    "eig_node_sharded",
     "sm_node_sharded",
 ]

@@ -1,0 +1,155 @@
+"""Node-axis sharded OM(m)/EIG: the dense message tree across chips.
+
+Completes the node-parallel family (OM(1): node_parallel, SM(m):
+sm_parallel) for the recursive oral-message protocol.  The EIG tree's
+biggest object — level m, [B, n, n^m] int8 (ba_tpu/core/eig.py) — shards
+its *receiver* axis over the mesh's "node" axis, so per-chip memory is
+O(B * n^(m+1) / n_node + B * n^m):
+
+- send phase: each relay level needs every general's previous-level copies
+  (receiver i hears "j said V_l[j, p]"), so each of the m levels re-
+  assembles the previous level with one ``all_gather`` over "node" —
+  O(B * n^l) ICI bytes, a factor n smaller than the level being built;
+- resolve phase: path majorities are per-receiver independent (the
+  eligibility masks are replicated), so the whole bottom-up fold is local;
+- quorum: the usual single ``psum`` (ba.py:197-223).
+
+Faulty-relay semantics match core/eig.py exactly: an independent coin per
+(receiver, path) message, self-messages stay honest, ties -> UNDEFINED,
+empty electorates fall back to the stored copy (OM(0) base case).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ba_tpu.core.eig import _in_path_mask
+from ba_tpu.core.om import round1_broadcast
+from ba_tpu.core.quorum import quorum_decision, strict_majority
+from ba_tpu.core.state import SimState
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+
+_COMPILED: dict = {}
+
+
+def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
+    """OM(m) agreement with the EIG tree's receiver axis sharded.
+
+    state: SimState with batch B (sharded over "data") and n divisible by
+    the node-axis size; m >= 1 static.  Returns the ``om1_agreement``-style
+    dict with ``majorities`` sharded [B, n] and replicated quorum outputs.
+    """
+    B, n = state.faulty.shape
+    n_node = mesh.shape["node"]
+    assert n % n_node == 0, f"node axis {n_node} must divide n={n}"
+    k1, key = jr.split(key)
+    received = round1_broadcast(k1, state)  # [B, n], node-replicated
+
+    def shard_fn(key, order, leader, faulty, alive, rcv):
+        node_idx = jax.lax.axis_index("node")
+        data_idx = jax.lax.axis_index("data")
+        b = order.shape[0]
+        n_local = n // n_node
+        i_global = node_idx * n_local + jnp.arange(n_local)
+        local = lambda x: jnp.take(x, i_global, axis=1)
+        k_shard = jr.fold_in(key, node_idx + n_node * data_idx)
+
+        # Send phase: levels_local[l] is [b, n_local, n^l] — this chip's
+        # receivers' copies; prev_global is the full previous level.
+        levels_local = [local(rcv)[..., None]]  # [b, n_local, 1]
+        prev_global = rcv[..., None]  # [b, n, 1]
+        self_honest = i_global[None, :, None] == jnp.arange(n)[None, None, :]
+        for level in range(m):
+            p_sz = n**level
+            coins = jr.randint(
+                jr.fold_in(k_shard, level), (b, n_local, p_sz, n), 0, 2,
+                dtype=COMMAND_DTYPE,
+            )
+            # relayed[b, i, p, j] = V_l[b, j, p] for this chip's receivers.
+            relayed = jnp.transpose(prev_global, (0, 2, 1))[:, None, :, :]
+            relayed = jnp.broadcast_to(relayed, (b, n_local, p_sz, n))
+            lying = (
+                faulty[:, None, None, :] & ~self_honest[:, :, None, :]
+            )
+            nxt = jnp.where(lying, coins, relayed).reshape(
+                b, n_local, p_sz * n
+            )
+            levels_local.append(nxt)
+            if level < m - 1:
+                prev_global = jax.lax.all_gather(
+                    nxt, "node", axis=1, tiled=True
+                )
+
+        # Resolve phase (local): bottom-up masked strict majorities,
+        # mirroring core/eig.eig_resolve line for line on the local slice.
+        is_leader = jnp.arange(n)[None, :] == leader[:, None]  # [b, n]
+        resolved = levels_local[m]
+        for level in range(m - 1, -1, -1):
+            p_sz = n**level
+            children = resolved.reshape(b, n_local, p_sz, n)
+            in_path = jnp.asarray(_in_path_mask(n, level))  # [p_sz, n]
+            valid = (
+                alive[:, None, None, :]
+                & ~is_leader[:, None, None, :]
+                & ~in_path[None, None, :, :]
+            )
+            n_attack = jnp.sum((children == ATTACK) & valid, axis=-1)
+            n_retreat = jnp.sum((children == RETREAT) & valid, axis=-1)
+            resolved = strict_majority(n_attack, n_retreat)
+            n_eligible = jnp.sum(valid, axis=-1)
+            resolved = jnp.where(
+                n_eligible > 0,
+                resolved,
+                levels_local[level].reshape(b, n_local, p_sz),
+            )
+        maj = resolved.reshape(b, n_local)
+        is_leader_l = i_global[None, :] == leader[:, None]
+        maj = jnp.where(is_leader_l, order[:, None], maj)
+
+        alive_l = local(alive)
+        att = jnp.sum((maj == ATTACK) & alive_l, axis=-1)
+        ret = jnp.sum((maj == RETREAT) & alive_l, axis=-1)
+        und = jnp.sum((maj == UNDEFINED) & alive_l, axis=-1)
+        att, ret, und = jax.lax.psum((att, ret, und), "node")
+        decision, needed, total = quorum_decision(att, ret, und)
+        return maj, decision, needed, total, att, ret, und
+
+    cache_key = (mesh, n, m)
+    if cache_key not in _COMPILED:
+        f = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(
+                P(),
+                P("data"),
+                P("data"),
+                P("data", None),
+                P("data", None),
+                P("data", None),
+            ),
+            out_specs=(
+                P("data", "node"),  # majorities
+                P("data"),  # decision
+                P("data"),  # needed
+                P("data"),  # total
+                P("data"),  # n_attack
+                P("data"),  # n_retreat
+                P("data"),  # n_undefined
+            ),
+        )
+        _COMPILED[cache_key] = jax.jit(f)
+    maj, decision, needed, total, att, ret, und = _COMPILED[cache_key](
+        key, state.order, state.leader, state.faulty, state.alive, received
+    )
+    return {
+        "majorities": maj,
+        "decision": decision,
+        "needed": needed,
+        "total": total,
+        "n_attack": att,
+        "n_retreat": ret,
+        "n_undefined": und,
+    }

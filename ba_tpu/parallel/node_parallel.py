@@ -44,7 +44,7 @@ def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
     """
     B, n = state.faulty.shape
     n_node = mesh.shape["node"]
-    assert n % n_node == 0, f"n={n} must divide node axis {n_node}"
+    assert n % n_node == 0, f"node axis {n_node} must divide n={n}"
 
     def shard_fn(key, order, leader, faulty, alive):
         # Shapes in here are per-shard: order/leader [b], faulty/alive

@@ -70,7 +70,7 @@ def sm_node_sharded(
     """
     B, n = state.faulty.shape
     n_node = mesh.shape["node"]
-    assert n % n_node == 0, f"n={n} must divide node axis {n_node}"
+    assert n % n_node == 0, f"node axis {n_node} must divide n={n}"
     if withhold is not None and collapsed:
         raise ValueError("collapsed relay cannot honor a withhold schedule")
     if received is None:

@@ -93,11 +93,15 @@ def main(argv=None) -> int:
     cluster, state_path = build_cluster(argv)
     from ba_tpu.runtime.repl import run_repl
 
-    run_repl(cluster, sys.stdin, print)
-    if state_path:
-        from ba_tpu.utils.snapshot import save_cluster
+    try:
+        run_repl(cluster, sys.stdin, print)
+    finally:
+        # Save even on abnormal exit (Ctrl-C, backend error): surviving
+        # crashes is the point of checkpointing (ba_tpu.utils.snapshot).
+        if state_path:
+            from ba_tpu.utils.snapshot import save_cluster
 
-        save_cluster(state_path, cluster)
+            save_cluster(state_path, cluster)
     return 0
 
 

@@ -161,3 +161,50 @@ def test_failover_sweep_sharded(mesh8):
     )
     assert (np.asarray(out["leaders"])[1] == 1).all()
     assert (np.asarray(out["decisions"]) == ATTACK).all()
+
+
+# -- node-sharded OM(m)/EIG ----------------------------------------------------
+
+
+def test_eig_node_sharded_honest_matches_unsharded(mesh42):
+    from ba_tpu.core import eig_agreement
+    from ba_tpu.parallel import eig_node_sharded
+
+    # Honest cluster: OM(2) is deterministic, sharded == unsharded exactly.
+    state = make_state(8, 8, order=ATTACK)
+    want = eig_agreement(jr.key(0), state, 2)
+    got = eig_node_sharded(mesh42, jr.key(0), state, 2)
+    for k in ("majorities", "decision", "needed", "total"):
+        np.testing.assert_array_equal(np.asarray(want[k]), np.asarray(got[k]))
+
+
+def test_eig_node_sharded_ic_with_traitors(mesh42):
+    from ba_tpu.parallel import eig_node_sharded
+
+    # OM(2), t=2 (commander + one lieutenant), n=8 > 3m+... honest
+    # lieutenants must agree (IC1) and quorum counts must be consistent.
+    B = 256
+    faulty = jnp.zeros((B, 8), bool).at[:, [0, 3]].set(True)
+    state = make_state(B, 8, order=RETREAT, faulty=faulty)
+    out = eig_node_sharded(mesh42, jr.key(1), state, 2)
+    maj = np.asarray(out["majorities"])
+    honest = np.ones((B, 8), bool)
+    honest[:, [0, 3]] = False
+    lo = np.where(honest, maj, 127).min(axis=1)
+    hi = np.where(honest, maj, -1).max(axis=1)
+    assert (lo == hi).all(), "IC1 violated on the sharded EIG path"
+    for k, code in (("n_attack", ATTACK), ("n_retreat", RETREAT)):
+        assert np.array_equal(np.asarray(out[k]), (maj == code).sum(axis=1))
+
+
+def test_eig_node_sharded_dead_general(mesh42):
+    from ba_tpu.parallel import eig_node_sharded
+
+    alive = jnp.ones((4, 8), bool).at[:, 5].set(False)
+    state = make_state(4, 8, order=ATTACK, alive=alive)
+    out = eig_node_sharded(mesh42, jr.key(2), state, 2)
+    maj = np.asarray(out["majorities"])
+    live = [i for i in range(8) if i != 5]
+    assert (maj[:, live] == ATTACK).all()
+    assert (np.asarray(out["total"]) == 7).all()
+    assert (np.asarray(out["decision"]) == ATTACK).all()
