@@ -6,11 +6,21 @@ Here the same roles are played by XLA collectives over ICI/DCN on a
 ``jax.sharding.Mesh``:
 
 - instance axis ("data"): embarrassingly-parallel consensus instances —
-  the 10k-instance sweep of BASELINE.json config #5 (ba_tpu.parallel.sweep);
+  the 10k-instance sweep of BASELINE.json config #5 (``sweep``), plus the
+  multi-round ``failover_sweep`` with on-device leader re-election;
 - node axis ("node"): generals of ONE large cluster sharded across chips,
   with ``all_gather``/``psum`` replacing the O(n^2) RPC mesh — the
-  sequence-parallelism analogue for n=1024-scale clusters
-  (ba_tpu.parallel.node_parallel).
+  sequence-parallelism analogue for n=1024-scale clusters, covering all
+  three protocols: OM(1) (``node_parallel``), the recursive OM(m) EIG
+  tree (``eig_parallel``), and SM(m) signed messages (``sm_parallel``).
+
+Multi-host: every path here is plain ``shard_map``/``NamedSharding`` over
+whatever mesh the caller builds, so scaling past one host is the standard
+JAX recipe — ``jax.distributed.initialize()`` then ``make_mesh`` over the
+global device list; XLA routes the same psum/all_gather collectives over
+ICI within a slice and DCN across slices.  Lay the "data" axis across
+hosts (its per-round traffic is a 3-int psum) and keep "node" within a
+slice (its all_gathers want ICI bandwidth).
 """
 
 from ba_tpu.parallel.mesh import make_mesh

@@ -139,18 +139,28 @@ def bench_sm1_n64_signed(jax, jnp, jr):
     state = make_state(batch, n, order=ATTACK, faulty=faulty)
 
     # (a) the raw batched-verify kernel: every general checks its copy.
+    # Inputs VARY per timed call: the tunnel backend memoizes repeat
+    # dispatches of byte-identical buffers, which fakes absurd throughput
+    # (measured r2: 20k verifies "in 2.6 ms").  Three distinct signed
+    # broadcasts, all valid, cycled across iterations.
     sks, pks = commander_keys(batch)
-    from ba_tpu.core.om import round1_broadcast
 
-    received = round1_broadcast(jr.key(2), state)
-    msgs, sigs = sign_received(sks, pks, np.asarray(received))
     nv = batch * n
     pk_flat = jnp.asarray(np.repeat(pks, n, axis=0))
-    margs = (pk_flat, jnp.asarray(msgs).reshape(nv, -1),
-             jnp.asarray(sigs).reshape(nv, 64))
-    vjit = jax.jit(verify)
+    rng = np.random.default_rng(2)
     v_iters = 3
-    v_elapsed = _timed(lambda *a: vjit(*a), lambda i: margs, v_iters)
+    variants = []
+    for v in range(1 + 3 * v_iters):  # one per dispatch: warmup + reps*iters
+        received = rng.integers(0, 2, (batch, n))  # distinct, all validly signed
+        msgs, sigs = sign_received(sks, pks, received)
+        variants.append(
+            (pk_flat, jnp.asarray(msgs).reshape(nv, -1),
+             jnp.asarray(sigs).reshape(nv, 64))
+        )
+    vjit = jax.jit(verify)
+    v_elapsed = _timed(
+        lambda *a: vjit(*a), lambda i: variants[i % len(variants)], v_iters
+    )
     verifies_per_sec = nv * v_iters / v_elapsed
 
     # (b) the full signed agreement round on-device (verify mask reused —
@@ -236,13 +246,13 @@ def bench_sweep10k_signed(jax, jnp, jr):
     sks, pks = commander_keys(batch)
     msgs_t, sigs_t = sign_value_tables(sks, pks)
     setup_sign_s = time.perf_counter() - t0
-    # Warm the verify kernel on an exactly chunk-shaped call so the
-    # one-time XLA/Mosaic compile is not billed as throughput (a different
-    # warmup shape would recompile on the timed call).
-    from ba_tpu.crypto.signed import _verify_chunk
-
-    c = min(batch, _verify_chunk() // 2)
-    jax.block_until_ready(verify_received(pks[:c], msgs_t[:c], sigs_t[:c]))
+    # Warm the verify kernel on a same-shape but different-content call:
+    # shape-identical so the one-time XLA/Mosaic compile is not billed as
+    # throughput, content-distinct because the tunnel backend memoizes
+    # repeat dispatches of byte-identical buffers (see bench_sm1 note).
+    warm_sigs = sigs_t.copy()
+    warm_sigs[..., 0] ^= 0xFF
+    jax.block_until_ready(verify_received(pks, msgs_t, warm_sigs))
     t0 = time.perf_counter()
     ok = verify_received(pks, msgs_t, sigs_t)  # [B, 2]
     ok = jax.block_until_ready(ok)
@@ -346,8 +356,11 @@ def main() -> None:
         ),
         "platform": jax.devices()[0].platform,
         "hbm_peak_gbps_assumed": HBM_PEAK_GBPS,
-        "variance_note": "shared TPU service: +-2x run-to-run on identical "
-                         "code (min-of-3 per config already applied)",
+        "variance_note": "shared TPU service: ~2x run-to-run noise on "
+                         "seconds-long workloads and up to ~30x on sub-ms "
+                         "dispatch-bound steps (sweep10k measured 0.2ms to "
+                         "6ms/step across windows on identical code); "
+                         "min-of-3 per config already applied",
         "configs": results,
     }
     if "sweep10k_signed" in results:
