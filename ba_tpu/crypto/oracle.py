@@ -116,7 +116,12 @@ def sign(sk: bytes, pk: bytes, msg: bytes) -> bytes:
 
 def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
     """Check [S]B == R + [h]A (RFC 8032 5.1.7, no cofactor multiplication —
-    the same equation the batched device kernel evaluates)."""
+    the same equation the batched device kernel evaluates).
+
+    h is reduced mod L before the multiply, matching ref10/libsodium (and
+    the device path, ba_tpu.crypto.scalar.reduce_mod_l).  For honest keys
+    the reduction is invisible — A and R have order L — it only pins down
+    the accept set for adversarial points with a torsion component."""
     if len(sig) != 64 or len(pk) != 32:
         return False
     try:
@@ -127,7 +132,7 @@ def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
     s = int.from_bytes(sig[32:], "little")
     if s >= L:
         return False
-    h = _hint(sig[:32] + pk + msg)
+    h = _hint(sig[:32] + pk + msg) % L
     left = scalarmult(BASE, s)
     right = edwards_add(R, scalarmult(A, h))
     return left == right

@@ -1,0 +1,153 @@
+"""Batched reduction of 512-bit scalars mod L (the Ed25519 group order).
+
+Verification needs ``h = SHA-512(R || A || M)`` as a *scalar* multiplier of
+A.  Round 1 of this framework fed the full 512-bit digest to the ladder
+("256 extra steps beat implementing mod-L"), which made the double-scalar
+ladder 512 steps long.  This module makes the opposite trade: reducing h
+mod L on device costs a handful of small convolutions (~60 vector ops on
+<=51-limb axes), and in exchange the [h]A ladder halves to 256 steps —
+the single hottest loop of the whole crypto path (ba_tpu/ops/ladder.py).
+Reducing mod L is also what ref10/libsodium-style implementations do, so
+the accept set matches standard verifiers even for adversarial keys whose
+torsion component would otherwise see ``h`` and ``h mod L`` differently.
+
+Representation: little-endian 8-bit limbs in int32 lanes (a *different*
+radix from ba_tpu.crypto.field's 12-bit mod-p limbs — this is mod-L integer
+arithmetic, not field arithmetic).  8-bit limbs keep every convolution term
+comfortably inside int32: the largest fold below peaks at ~2.1e6.
+
+Algorithm (all shapes static, fully jittable):
+
+    L = 2^252 + delta,  delta < 2^125,  so  2^256 === -16*delta  (mod L)
+
+    three folds at the 2^256 limb boundary shrink 512 -> ~258 bits, then
+    one exact fold at 2^252 plus a single conditional subtract lands in
+    [0, L).  Bounds are tracked limb-wise in each step's comment.
+
+The reference (/root/reference/ba.py) has no crypto; this backs the signed
+SM(m) north star (BASELINE.json config #3).  Differential contract:
+``int.from_bytes(reduce_mod_l(h), 'little') == int.from_bytes(h) % L``
+for every input — tested against Python bigints in tests/test_crypto.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ba_tpu.crypto.oracle import L
+
+DELTA = L - 2**252  # 125 bits
+C16 = 16 * DELTA  # 2^256 mod-L fold constant, 129 bits
+
+
+def _const_limbs(v: int, n: int) -> np.ndarray:
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        out[i] = v & 0xFF
+        v >>= 8
+    assert v == 0, "constant does not fit"
+    return out
+
+
+_C16 = _const_limbs(C16, 17)
+_DELTA = _const_limbs(DELTA, 16)
+_L32 = _const_limbs(L, 32)
+
+
+def _mul_const(a: jnp.ndarray, c: np.ndarray) -> jnp.ndarray:
+    """[..., n] int32 times a static limb constant -> [..., n+m-1]."""
+    n, m = a.shape[-1], len(c)
+    out = jnp.zeros((*a.shape[:-1], n + m - 1), jnp.int32)
+    for j, cj in enumerate(c):
+        if cj:
+            out = out.at[..., j : j + n].add(a * int(cj))
+    return out
+
+
+def _carry(v: jnp.ndarray, passes: int, extra: int) -> jnp.ndarray:
+    """Parallel signed base-256 carry passes (value-preserving, no wrap).
+
+    ``extra`` fresh top limbs give transient carries headroom; callers size
+    it so the top limb can never carry out (asserted by the bit bounds in
+    reduce_mod_l's comments — inputs here peak at ~2.1e6 per limb, so three
+    passes settle limbs into [-1, 256] with carries shrinking 256x each
+    pass: 2.1e6 -> 8.2e3 -> 33 -> 1).
+    """
+    if extra:
+        pad = jnp.zeros((*v.shape[:-1], extra), jnp.int32)
+        v = jnp.concatenate([v, pad], axis=-1)
+    zero1 = jnp.zeros((*v.shape[:-1], 1), jnp.int32)
+    for _ in range(passes):
+        c = v >> 8  # arithmetic shift: exact floor for negatives
+        r = v - (c << 8)
+        v = r + jnp.concatenate([zero1, c[..., :-1]], axis=-1)
+    return v
+
+
+def _exact_chain(v: jnp.ndarray) -> jnp.ndarray:
+    """Sequential exact carry chain: signed limbs encoding a NON-NEGATIVE
+    value that fits the limb count -> canonical base-256 limbs in [0, 256).
+    Trace-time Python loop over a static <=40-limb axis."""
+    c = jnp.zeros(v.shape[:-1], jnp.int32)
+    outs = []
+    for i in range(v.shape[-1]):
+        x = v[..., i] + c
+        outs.append(x & 0xFF)
+        c = x >> 8
+    return jnp.stack(outs, axis=-1)
+
+
+def _fold_256(v: jnp.ndarray, keep: int) -> jnp.ndarray:
+    """One 2^256-boundary fold: v === v[:32] - v[32:] * C16 (mod L)."""
+    lo, hi = v[..., :32], v[..., 32:]
+    prod = _mul_const(hi, _C16)
+    n = max(32, prod.shape[-1], keep)
+    lo = jnp.pad(lo, [(0, 0)] * (lo.ndim - 1) + [(0, n - 32)])
+    prod = jnp.pad(prod, [(0, 0)] * (prod.ndim - 1) + [(0, n - prod.shape[-1])])
+    return lo - prod
+
+
+def reduce_mod_l(h_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Batched ``h mod L``: uint8 [..., 64] little-endian -> uint8 [..., 32].
+
+    Fully static-shape jnp; safe under jit/vmap.  See module docstring for
+    the fold plan; per-step bounds:
+    """
+    v = h_bytes.astype(jnp.int32)  # 64 limbs in [0, 256); value < 2^512
+    # Fold 1: hi has 32 limbs -> conv terms <= 32*255*255 ~ 2.08e6 (int32-
+    # safe); value lands in (-2^385, 2^257).
+    v = _fold_256(v, keep=48)
+    v = _carry(v, passes=3, extra=3)  # 51 limbs, each in [-1, 256]
+    # Fold 2: hi is 19 limbs (|value| < 2^130); terms <= 19*256*255 ~ 1.24e6;
+    # value lands in (-2^259, 2^257 + 2^259).
+    v = _fold_256(v, keep=35)
+    v = _carry(v, passes=3, extra=2)  # 37 limbs, each in [-1, 256]
+    # Fold 3: hi is 5 limbs (|value| < 18); value lands in (-2^135, 2^257).
+    v = _fold_256(v, keep=33)
+    v = _carry(v, passes=2, extra=1)  # 34 limbs
+    # Make non-negative: + L (> 2^135) keeps value < 2^257 + L < 2^258.
+    v = v.at[..., :32].add(jnp.asarray(_L32))
+    v = _carry(v, passes=2, extra=1)
+    v = _exact_chain(v)  # canonical limbs, value in (0, 2^258)
+    # Exact fold at 2^252: hi < 64, so hi*delta < 2^131.
+    hi = (v[..., 31] >> 4) + v[..., 32] * 16 + v[..., 33] * (16 * 256)
+    lo = v[..., :32].at[..., 31].set(v[..., 31] & 0xF)
+    prod = _mul_const(hi[..., None], _DELTA)  # 16 limbs, terms <= 64*255
+    v = lo.at[..., :16].add(-prod)  # value in (-2^131, 2^252)
+    # + L once -> (0, 2L); then one conditional subtract of L -> [0, L).
+    v = v + jnp.asarray(_L32)
+    # Value < 2L < 2^254 fits 32 limbs; the extra limb only absorbs the
+    # parallel passes' transient carries and is provably 0 after the chain.
+    v = _exact_chain(_carry(v, passes=2, extra=1))[..., :32]
+    borrow = jnp.zeros(v.shape[:-1], jnp.int32)
+    diffs = []
+    for i in range(32):
+        x = v[..., i] - int(_L32[i]) + borrow
+        diffs.append(x & 0xFF)
+        borrow = x >> 8
+    ge = borrow >= 0  # no final borrow <=> v >= L
+    diff = jnp.stack(diffs, axis=-1)
+    v = jnp.where(ge[..., None], diff, v)
+    return v.astype(jnp.uint8)

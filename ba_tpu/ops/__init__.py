@@ -8,10 +8,17 @@ Pallas kernels for the ops that dominate the BASELINE workloads:
   resident limb-plane arithmetic (ba_tpu.ops.planes).  Measured r2 on one
   chip: 1.33M scalar-mults/s at batch 262k vs 18k/s for the jnp matmul-
   convolution formulation (~74x).  Default on TPU (ed25519._use_pallas).
+  Verification runs it for [h]A only, over the mod-L-reduced 256-bit
+  digest (ba_tpu.crypto.scalar).
+- ``treeadd``  — 64-way Edwards point-add tree (two 8-to-1 VMEM levels)
+  folding the gathered fixed-base window points of [S]B; replaces a
+  second ladder entirely (64k lanes: 159 ms vs 729 ms for the jnp scan).
 - ``powchain`` — fixed-exponent square-and-multiply for decompression's
   (p-5)/8 modular square root, same plane recipe (2.4x the jnp chain).
-  With both kernels, end-to-end batched verify went from ~8.7k (r1) to
-  ~119k verifies/s at 64k-signature chunks.
+- ``sha512_kernel`` — the unrolled 80-round SHA-512 compression for the
+  verify digest h = SHA-512(R || A || M).
+  All four together: end-to-end batched verify went from ~8.7k (r1) to
+  ~226k verifies/s at 64k-signature chunks (measured r2).
 - ``majority`` — the fused masked strict-majority reduction (the vote
   count of ba.py:159-195 and every EIG resolve level).  This op is HBM-
   bandwidth-bound and XLA's fusion already saturates it (r2 measurement:

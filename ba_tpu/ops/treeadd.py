@@ -1,0 +1,110 @@
+"""Pallas TPU kernel: 64-way Edwards point-add tree, VMEM-resident.
+
+The fixed-base half of signature verification ([S]B, ba_tpu/crypto/
+ed25519.fixed_base_mult) gathers one precomputed window point per 4-bit
+digit — 64 points per lane — and folds them with 63 complete additions.
+The jnp scan form pays the [484 x 43] matmul waste per field mul and
+round-trips HBM every step (measured r2: 729 ms for 64k lanes — 4x the
+entire 256-step Pallas ladder).  Here the fold runs as two grid levels of
+an 8-to-1 in-VMEM reduction:
+
+    64 windows --(kernel, grid j=0..7: 7 adds)--> 8 partials --(kernel)--> 1
+
+so each program holds 8 input points + temporaries (~3 MB VMEM), the tree's
+intermediate levels never touch HBM, and total traffic is 73 points/lane
+read + 9 written vs the scan's 128 round-trips.
+
+Layout per coordinate: [W, 22, rows, 128] limb planes (the shared
+[8, 128]-tile contract of ba_tpu.ops.ladder); the gather that produces the
+input stays in XLA — on TPU a 1024-row table take lowers to an MXU one-hot
+dot and costs ~0.1 ms for 64k lanes (measured r2), so only the point
+arithmetic needs a kernel.
+
+Differential contract: the same group element as folding the entries with
+ed25519.point_add (projective representations differ by the fold order;
+compared via point_eq).  Like the ladder, the assembled kernel is pinned
+on real TPU (BA_TPU_TESTS_ON_TPU=1): the 7-add body hits the same XLA-CPU
+compile blowup interpret mode rides on (>9 min for a 2-add body); CPU runs
+cover the tile layout and the tree's pairing order instead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ba_tpu.crypto.field import LIMBS
+from ba_tpu.ops.ladder import TILE, TILE_ROWS, LANES, _from_tiles
+from ba_tpu.ops.planes import p_point_add
+
+WINDOWS = 64
+_GROUP = 8  # points reduced per program; two levels cover 64
+
+
+def _tree8_kernel(x_ref, y_ref, z_ref, t_ref, ox_ref, oy_ref, oz_ref, ot_ref):
+    pts = [
+        tuple([ref[w, i] for i in range(LIMBS)] for ref in (x_ref, y_ref, z_ref, t_ref))
+        for w in range(_GROUP)
+    ]
+    while len(pts) > 1:
+        pts = [p_point_add(pts[k], pts[k + 1]) for k in range(0, len(pts), 2)]
+    for out_ref, planes in zip((ox_ref, oy_ref, oz_ref, ot_ref), pts[0]):
+        for i in range(LIMBS):
+            out_ref[0, i] = planes[i]
+
+
+def _level(coords: list, n_in: int, grid_tiles: int, interpret: bool) -> list:
+    """One 8-to-1 reduction level: [n_in, 22, rows, 128] -> [n_in//8, ...]."""
+    n_out = n_in // _GROUP
+    in_spec = pl.BlockSpec(
+        (_GROUP, LIMBS, TILE_ROWS, LANES),
+        lambda i, j: (j, 0, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out_spec = pl.BlockSpec(
+        (1, LIMBS, TILE_ROWS, LANES),
+        lambda i, j: (j, 0, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    rows = coords[0].shape[2]
+    out_shape = jax.ShapeDtypeStruct((n_out, LIMBS, rows, LANES), jnp.int32)
+    return list(
+        pl.pallas_call(
+            _tree8_kernel,
+            grid=(grid_tiles, n_out),
+            in_specs=[in_spec] * 4,
+            out_specs=(out_spec,) * 4,
+            out_shape=(out_shape,) * 4,
+            interpret=interpret,
+        )(*coords)
+    )
+
+
+def entries_to_planes(entries: jnp.ndarray, batch_pad: int) -> list:
+    """[B, W, 4, 22] -> per-coordinate [W, 22, rows, 128] plane tiles
+    (zero-padded lanes; zeros are add-safe and discarded on unpad)."""
+    B, W = entries.shape[:2]
+    e = jnp.pad(entries, ((0, batch_pad - B), (0, 0), (0, 0), (0, 0)))
+    e = jnp.transpose(e, (2, 1, 3, 0))  # [4, W, 22, batch_pad]
+    return [e[c].reshape(W, LIMBS, batch_pad // LANES, LANES) for c in range(4)]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def tree_point_add(entries: jnp.ndarray, *, interpret: bool = False) -> tuple:
+    """Fold 64 points per lane: entries [B, 64, 4, 22] int32 (carried-form
+    limbs; gathered table rows are canonical, which is stricter) -> Point
+    tuple of [B, 22] arrays, equal to left-fold/any-order point_add of the
+    64 entries (the complete addition law is associative on the group).
+    """
+    B, W = entries.shape[:2]
+    assert W == WINDOWS, f"tree_point_add is specialized to 64 windows, got {W}"
+    batch_pad = -(-B // TILE) * TILE
+    coords = entries_to_planes(entries, batch_pad)
+    grid_tiles = batch_pad // TILE
+    coords = _level(coords, WINDOWS, grid_tiles, interpret)
+    coords = _level(coords, _GROUP, grid_tiles, interpret)
+    return tuple(_from_tiles(c[0], B) for c in coords)

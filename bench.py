@@ -138,28 +138,42 @@ def bench_sm1_n64_signed(jax, jnp, jr):
     faulty = jnp.zeros((batch, n), bool).at[:, 1].set(True)
     state = make_state(batch, n, order=ATTACK, faulty=faulty)
 
-    # (a) the raw batched-verify kernel: every general checks its copy.
-    # Inputs VARY per timed call: the tunnel backend memoizes repeat
-    # dispatches of byte-identical buffers, which fakes absurd throughput
-    # (measured r2: 20k verifies "in 2.6 ms").  Three distinct signed
-    # broadcasts, all valid, cycled across iterations.
+    # (a) the raw batched-verify kernel at the chunk-optimal lane count
+    # (ba_tpu.crypto.signed._verify_chunk): per-dispatch tunnel latency is
+    # tens of ms, so small batches measure latency, not the kernel.  The
+    # valid signed broadcast tiles up to the verify batch.  Inputs VARY per
+    # timed call: the tunnel backend memoizes repeat dispatches of byte-
+    # identical buffers, which fakes absurd throughput (measured r2: 20k
+    # verifies "in 2.6 ms").  Distinct signed broadcasts per dispatch, all
+    # valid, cycled across iterations.
     sks, pks = commander_keys(batch)
 
-    nv = batch * n
-    pk_flat = jnp.asarray(np.repeat(pks, n, axis=0))
+    from ba_tpu.crypto.signed import _verify_chunk
+
+    # Default to the production chunk size (64k pallas / 4k jnp — the jnp
+    # ladder collapses past ~4k lanes); BA_TPU_BENCH_VERIFY_BATCH overrides.
+    nv = int(os.environ.get("BA_TPU_BENCH_VERIFY_BATCH", 0)) or _verify_chunk()
+    tile = -(-nv // (batch * n))
+    pk_flat = jnp.asarray(
+        np.tile(np.repeat(pks, n, axis=0), (tile, 1))[:nv]
+    )
     rng = np.random.default_rng(2)
-    v_iters = 3
+    v_iters, v_reps = 3, 3
     variants = []
-    for v in range(1 + 3 * v_iters):  # one per dispatch: warmup + reps*iters
+    for v in range(1 + v_reps * v_iters):  # one per dispatch: warmup + reps*iters
         received = rng.integers(0, 2, (batch, n))  # distinct, all validly signed
         msgs, sigs = sign_received(sks, pks, received)
         variants.append(
-            (pk_flat, jnp.asarray(msgs).reshape(nv, -1),
-             jnp.asarray(sigs).reshape(nv, 64))
+            (pk_flat,
+             jnp.asarray(np.tile(msgs.reshape(batch * n, -1), (tile, 1))[:nv]),
+             jnp.asarray(np.tile(sigs.reshape(batch * n, 64), (tile, 1))[:nv]))
         )
     vjit = jax.jit(verify)
+    first = jax.block_until_ready(vjit(*variants[0]))
+    assert bool(jnp.all(first)), "bench signatures must all verify"
     v_elapsed = _timed(
-        lambda *a: vjit(*a), lambda i: variants[i % len(variants)], v_iters
+        lambda *a: vjit(*a), lambda i: variants[i % len(variants)],
+        v_iters, reps=v_reps,
     )
     verifies_per_sec = nv * v_iters / v_elapsed
 
@@ -177,10 +191,11 @@ def bench_sm1_n64_signed(jax, jnp, jr):
     elapsed = _timed(
         step, lambda i: (jr.fold_in(key, i), state, sig_valid), iters
     )
-    # ~1.2M int32 multiplies per verify: ~4000 field muls (two 256-bit
-    # scalar mults in extended coords) x ~300 ops each (10x10 limb
-    # products + carries).
-    est_mults = 1.2e6
+    # ~1.7M int32 multiplies per verify: ~5.7k field muls — 256-step
+    # double-and-add-always [h]A ladder (4.6k), 63-add fixed-base [S]B
+    # tree (0.6k), 2 decompressions (0.5k) — x ~300 multiplies each
+    # (22x22 limb products + carry/fold passes).
+    est_mults = 1.7e6
     return {
         "rounds_per_sec": round(batch * iters / elapsed, 1),
         "ed25519_verifies_per_sec": round(verifies_per_sec, 1),

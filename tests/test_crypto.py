@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ba_tpu.crypto import ed25519, field as F, oracle
+from ba_tpu.crypto.scalar import reduce_mod_l
 from ba_tpu.crypto.sha512 import sha512
 
 P = F.P_INT
@@ -137,6 +138,44 @@ def test_sha512_matches_hashlib(length):
     for b in range(4):
         exp = np.frombuffer(hashlib.sha512(msgs[b].tobytes()).digest(), np.uint8)
         assert (got[b] == exp).all()
+
+
+# -- mod-L scalar reduction vs Python bigints ---------------------------------
+
+
+def test_reduce_mod_l_matches_bigints():
+    L = oracle.L
+    q = 2**512 // L
+    rng = np.random.default_rng(11)
+    vals = [0, 1, L - 1, L, L + 1, 2**252, 2**256, q * L - 1, q * L, 2**512 - 1]
+    vals += [int.from_bytes(rng.bytes(64), "little") for _ in range(64)]
+    by = jnp.asarray(
+        np.stack([np.frombuffer(v.to_bytes(64, "little"), np.uint8) for v in vals])
+    )
+    out = np.asarray(jax.jit(reduce_mod_l)(by))
+    got = [int.from_bytes(out[i].tobytes(), "little") for i in range(len(vals))]
+    assert got == [v % L for v in vals]
+
+
+# -- fixed-base window table vs ladder and oracle -----------------------------
+
+
+def test_fixed_base_matches_ladder_and_oracle():
+    L = oracle.L
+    rng = np.random.default_rng(12)
+    ss = [0, 1, 2, 15, 16, L - 1]
+    ss += [int.from_bytes(rng.bytes(32), "little") % L for _ in range(6)]
+    enc = jnp.asarray(
+        np.stack([np.frombuffer(s.to_bytes(32, "little"), np.uint8) for s in ss])
+    )
+    got = ed25519.fixed_base_mult(enc)
+    exp = ed25519.scalar_mult_base(F.bytes_to_bits(enc))
+    assert np.asarray(ed25519.point_eq(got, exp)).all()
+    comp = np.asarray(ed25519.compress(got))
+    for i, s in enumerate(ss):
+        assert comp[i].tobytes() == oracle.encode_point(
+            oracle.scalarmult(oracle.BASE, s)
+        )
 
 
 # -- batched Ed25519 verify vs oracle ----------------------------------------

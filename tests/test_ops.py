@@ -142,6 +142,63 @@ def test_pow_planes_sqrt_exponent_tpu():
     )
 
 
+# -- fixed-base point-add tree ------------------------------------------------
+
+
+def _random_entries(B, seed):
+    """[B, 64, 4, 22] of varied valid curve points (multiples of the base)."""
+    rng = np.random.default_rng(seed)
+    bits = jnp.asarray(rng.integers(0, 2, (B * 64, 16)), jnp.int32)
+    pts = E.scalar_mult(E.base_point((B * 64,)), bits)
+    return jnp.stack([c.reshape(B, 64, F.LIMBS) for c in pts], axis=2)
+
+
+def _fold_ref(entries):
+    acc = E.identity((entries.shape[0],))
+    for w in range(64):
+        acc = E.point_add(acc, tuple(entries[:, w, c] for c in range(4)))
+    return acc
+
+
+def test_treeadd_entries_layout_roundtrip():
+    from ba_tpu.ops import treeadd
+
+    B = 1000  # non-multiple of the 1024-lane tile: exercises pad + unpad
+    entries = _random_entries(B, 9)
+    pad = -(-B // ladder.TILE) * ladder.TILE
+    coords = treeadd.entries_to_planes(entries, pad)
+    for c in range(4):
+        assert coords[c].shape == (64, F.LIMBS, pad // ladder.LANES, ladder.LANES)
+        for w in (0, 13, 63):
+            back = ladder._from_tiles(coords[c][w], B)
+            np.testing.assert_array_equal(
+                np.asarray(back), np.asarray(entries[:, w, c])
+            )
+
+
+def test_treeadd_pairing_order_matches_left_fold():
+    # The kernel folds ((p0+p1)+(p2+p3))+... — same group element as the
+    # left fold; pinned here at the jnp level with the tested point_add so
+    # the TPU run only has to vouch for the Mosaic lowering.
+    B = 16
+    entries = _random_entries(B, 11)
+    pts = [tuple(entries[:, w, c] for c in range(4)) for w in range(64)]
+    while len(pts) > 1:
+        pts = [E.point_add(pts[k], pts[k + 1]) for k in range(0, len(pts), 2)]
+    assert np.asarray(E.point_eq(pts[0], _fold_ref(entries))).all()
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="Mosaic kernel needs real TPU")
+def test_treeadd_pallas_tpu_multi_tile():
+    from ba_tpu.ops.treeadd import tree_point_add
+
+    B = 1100  # non-multiple of the tile: padding + 2 grid tiles
+    entries = _random_entries(B, 10)
+    got = tree_point_add(entries)
+    ref = _fold_ref(entries)
+    assert np.asarray(E.point_eq(got, ref)).all()
+
+
 # -- sha512 kernel ------------------------------------------------------------
 
 
