@@ -13,11 +13,13 @@ Split of labor:
 
 - Signing is host-side: commanders are few (one per instance) and sign at
   most two distinct values each, so signing is O(B) signs off the hot
-  path.  The signer is the native Ed25519 from the baked-in
-  ``cryptography`` wheel when importable (~30k signs/s) with the
-  pure-Python ``ba_tpu.crypto.oracle`` as both fallback and ground truth —
-  Ed25519 is deterministic, so the two produce identical bytes
-  (tests/test_sm.py pins this).
+  path.  Batch signing prefers the framework's own C++ library
+  (``ba_tpu.native``, one OpenMP'd C call per batch — ~44k signs/s/core
+  vs ~10k through per-call ``cryptography``); per-call signing uses the
+  baked-in ``cryptography`` wheel when importable; the pure-Python
+  ``ba_tpu.crypto.oracle`` is the universal fallback and ground truth.
+  Ed25519 is deterministic, so all three produce identical bytes
+  (tests/test_sm.py and tests/test_native.py pin this).
 - Verification is device-side (``ba_tpu.crypto.ed25519.verify``): B x n
   checks per round, the batched hot op (BASELINE config #3).  For
   sweep-scale work the per-(instance, value) signature tables let the
@@ -76,20 +78,29 @@ def host_sign(sk: bytes, pk: bytes, msg: bytes) -> bytes:
     return oracle.sign(sk, pk, msg)
 
 
+def _native_or_none():
+    """The ba_tpu.native C++ library, or None (no compiler / disabled)."""
+    from ba_tpu import native
+
+    return native if native.available() else None
+
+
 def commander_keys(batch: int, seed: int = 0) -> tuple[list[bytes], np.ndarray]:
     """Deterministic per-instance commander keypairs.
 
     Returns (secret keys as a list of 32-byte strings, public keys as a
     uint8 [B, 32] array ready for the device verifier).  The sk derivation
-    matches ``oracle.keypair`` exactly; pk computation uses the native
-    signer when available.
+    matches ``oracle.keypair`` exactly; pk computation uses the C++ batch
+    path when available, else the per-call native signer.
     """
-    sks, pks = [], []
-    for b in range(batch):
-        sk = oracle.secret_from_seed(f"{seed}:{b}".encode())
-        sks.append(sk)
-        pks.append(np.frombuffer(host_publickey(sk), np.uint8))
-    return sks, np.stack(pks)
+    sks = [oracle.secret_from_seed(f"{seed}:{b}".encode()) for b in range(batch)]
+    nat = _native_or_none()
+    if nat is not None:
+        sk_arr = np.stack([np.frombuffer(s, np.uint8) for s in sks])
+        return sks, nat.publickey_batch(sk_arr)
+    return sks, np.stack(
+        [np.frombuffer(host_publickey(sk), np.uint8) for sk in sks]
+    )
 
 
 def order_message(instance: int, value: int) -> bytes:
@@ -111,13 +122,24 @@ def sign_value_tables(
     """
     B = len(sks)
     msgs = np.zeros((B, n_values, MSG_LEN), np.uint8)
+    for b in range(B):
+        for v in range(n_values):
+            msgs[b, v] = np.frombuffer(order_message(b, v), np.uint8)
+    nat = _native_or_none()
+    if nat is not None:
+        sk_arr = np.repeat(
+            np.stack([np.frombuffer(s, np.uint8) for s in sks]), n_values, axis=0
+        )
+        pk_arr = np.repeat(np.asarray(pks, np.uint8), n_values, axis=0)
+        sigs = nat.sign_batch(sk_arr, pk_arr, msgs.reshape(B * n_values, MSG_LEN))
+        return msgs, sigs.reshape(B, n_values, 64)
     sigs = np.zeros((B, n_values, 64), np.uint8)
     for b, sk in enumerate(sks):
         pk = pks[b].tobytes()
         for v in range(n_values):
-            msg = order_message(b, v)
-            msgs[b, v] = np.frombuffer(msg, np.uint8)
-            sigs[b, v] = np.frombuffer(host_sign(sk, pk, msg), np.uint8)
+            sigs[b, v] = np.frombuffer(
+                host_sign(sk, pk, msgs[b, v].tobytes()), np.uint8
+            )
     return msgs, sigs
 
 
