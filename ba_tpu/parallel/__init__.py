@@ -16,14 +16,14 @@ Here the same roles are played by XLA collectives over ICI/DCN on a
 
 Multi-host: every path here is plain ``shard_map``/``NamedSharding`` over
 whatever mesh the caller builds, so scaling past one host is the standard
-JAX recipe — ``jax.distributed.initialize()`` then ``make_mesh`` over the
-global device list; XLA routes the same psum/all_gather collectives over
-ICI within a slice and DCN across slices.  Lay the "data" axis across
-hosts (its per-round traffic is a 3-int psum) and keep "node" within a
-slice (its all_gathers want ICI bandwidth).
+JAX recipe, packaged in ``multihost``: ``init_distributed()`` (the join
+protocol) then ``make_global_mesh()`` — the "data" axis spans hosts (its
+per-round traffic is a 3-int psum, DCN-tolerant) and "node" stays inside
+a slice (its all_gathers want ICI bandwidth).
 """
 
 from ba_tpu.parallel.mesh import make_mesh
+from ba_tpu.parallel.multihost import init_distributed, make_global_mesh
 from ba_tpu.parallel.sweep import failover_sweep, sharded_sweep, make_sweep_state
 from ba_tpu.parallel.node_parallel import om1_node_sharded
 from ba_tpu.parallel.eig_parallel import eig_node_sharded
@@ -31,6 +31,8 @@ from ba_tpu.parallel.sm_parallel import sm_node_sharded
 
 __all__ = [
     "make_mesh",
+    "init_distributed",
+    "make_global_mesh",
     "failover_sweep",
     "sharded_sweep",
     "make_sweep_state",

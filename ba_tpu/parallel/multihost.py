@@ -1,0 +1,95 @@
+"""Multi-host (multi-process) scaling: DCN x ICI global meshes.
+
+The reference "scales" by spawning more general-threads in one OS process
+(ba.py:427-437); its distributed backend is RPyC over localhost TCP.  This
+framework's equivalent at real scale is a JAX global mesh spanning hosts:
+every process owns one slice's chips, XLA collectives ride ICI inside a
+slice and DCN between slices, and the same ``shard_map`` programs
+(ba_tpu.parallel.sweep / node_parallel / sm_parallel / eig_parallel) run
+unchanged — sharding is declarative, so "multi-host" is a mesh-shape
+question, not a programming-model question (the How-to-Scale-Your-Model
+recipe: pick a mesh, annotate shardings, let XLA insert collectives).
+
+Axis policy: the instance/"data" axis maps to the DCN (inter-host)
+dimension — independent consensus instances never communicate, so DCN
+latency is invisible — and the "node" axis (generals of one big cluster,
+whose all-to-all/psum traffic is hot) stays inside a slice on ICI.  This
+mirrors the classic DP-outer / MP-inner layout.
+
+Single-process fallback keeps every helper usable (and testable) on one
+host with virtual CPU devices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ba_tpu.parallel.mesh import make_mesh
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Join (or skip) the multi-process JAX runtime; returns process count.
+
+    Thin wrapper over ``jax.distributed.initialize`` — the framework's
+    analogue of the reference's join protocol (discover_leader,
+    ba.py:86-102): the coordinator is the "leader", every process dials
+    it, and the global device view appears.  With no arguments (or in a
+    single-process run) it is a no-op returning 1, so library code can
+    call it unconditionally.
+    """
+    if coordinator_address is None and num_processes in (None, 1):
+        return 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count()
+
+
+def make_global_mesh(
+    node_devices_per_host: int = 1,
+    axis_names: tuple[str, str] = ("data", "node"),
+) -> Mesh:
+    """A (data, node) mesh over ALL processes' devices.
+
+    The "node" axis is kept inside a host/slice (contiguous local devices,
+    ICI); the "data" axis spans hosts (DCN) x the remaining local devices.
+    With one process this degenerates to ``make_mesh`` over the local
+    devices, so sweep/test code is identical either way.
+
+    ``node_devices_per_host`` must divide each host's local device count.
+    """
+    devs = jax.devices()  # global, grouped by process
+    counts: dict[int, int] = {}
+    for d in devs:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    per_host = set(counts.values()) or {len(devs)}
+    if len(per_host) != 1:
+        raise ValueError(
+            f"heterogeneous hosts unsupported: device counts {sorted(per_host)}"
+        )
+    n_local = per_host.pop()
+    if node_devices_per_host > n_local or n_local % node_devices_per_host:
+        raise ValueError(
+            f"node_devices_per_host={node_devices_per_host} must divide "
+            f"local device count {n_local}"
+        )
+    n_proc = max(len(counts), 1)
+    data = n_proc * (n_local // node_devices_per_host)
+    arr = np.empty((data, node_devices_per_host), dtype=object)
+    # Keep each host's devices contiguous along "node": sort by
+    # (process, local ordinal) — jax.devices() is already in that order.
+    for i, d in enumerate(devs):
+        arr[i // node_devices_per_host, i % node_devices_per_host] = d
+    return Mesh(arr, axis_names)
+
+
+__all__ = ["init_distributed", "make_global_mesh", "make_mesh"]

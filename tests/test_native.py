@@ -115,6 +115,29 @@ def test_scalar_reduce_via_sign_diversity():
         assert native.sign(sk, pk, msg) == oracle.sign(sk, pk, msg)
 
 
+def test_verify_received_native_matches_jnp(monkeypatch):
+    # The CPU fast path and the jnp kernel path must produce the same
+    # [B, n] mask, incl. rejected corruptions.
+    from ba_tpu.crypto.signed import (
+        commander_keys,
+        sign_received,
+        verify_received,
+    )
+
+    rng = np.random.default_rng(4)
+    B, n = 4, 6
+    sks, pks = commander_keys(B, seed=9)
+    received = rng.integers(0, 2, (B, n))
+    corrupt = rng.random((B, n)) < 0.3
+    msgs, sigs = sign_received(sks, pks, received, corrupt)
+    monkeypatch.setenv("BA_TPU_VERIFY_NATIVE", "1")
+    got_native = np.asarray(verify_received(pks, msgs, sigs))
+    monkeypatch.setenv("BA_TPU_VERIFY_NATIVE", "0")
+    got_jnp = np.asarray(verify_received(pks, msgs, sigs))
+    np.testing.assert_array_equal(got_native, got_jnp)
+    np.testing.assert_array_equal(got_native, ~corrupt)
+
+
 def test_signed_host_paths_agree():
     # commander_keys / sign_value_tables must produce identical bytes
     # whichever host signer (native / cryptography / oracle) is active.

@@ -208,3 +208,29 @@ def test_eig_node_sharded_dead_general(mesh42):
     assert (maj[:, live] == ATTACK).all()
     assert (np.asarray(out["total"]) == 7).all()
     assert (np.asarray(out["decision"]) == ATTACK).all()
+
+
+# -- multi-host mesh helpers (single-process degenerate form) -----------------
+
+
+def test_init_distributed_noop_single_process():
+    from ba_tpu.parallel.multihost import init_distributed
+
+    assert init_distributed() == 1
+
+
+def test_global_mesh_runs_sweeps(eight_devices):
+    from ba_tpu.parallel.multihost import make_global_mesh
+
+    mesh = make_global_mesh(node_devices_per_host=2)
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("data", "node")
+    # The mesh must be usable by both parallelism families unchanged.
+    state = make_sweep_state(jr.key(0), 16, 8)
+    out = sharded_sweep(mesh, jr.key(1), state, m=1)
+    assert int(np.asarray(out["histogram"]).sum()) == 16
+    big = make_state(8, 8, order=ATTACK)
+    out2 = om1_node_sharded(mesh, jr.key(2), big)
+    assert (np.asarray(out2["majorities"]) == ATTACK).all()
+    with pytest.raises(ValueError):
+        make_global_mesh(node_devices_per_host=3)
