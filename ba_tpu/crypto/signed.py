@@ -220,6 +220,11 @@ def verify_received(pks, msgs, sigs):
     )
     if use_native:
         nat = _native_or_none()
+        if nat is None and mode == "1":
+            raise RuntimeError(
+                "BA_TPU_VERIFY_NATIVE=1 but the native library is "
+                "unavailable (no compiler?)"
+            )
         if nat is not None:
             pks_np = np.asarray(pks, np.uint8)
             msgs_np = np.asarray(msgs, np.uint8)

@@ -4,21 +4,24 @@ The reference has no native code (/root/reference is pure Python over
 rpyc, SURVEY.md section 2); in this framework the native-code axis is real
 Pallas kernels for the ops that dominate the BASELINE workloads:
 
-- ``ladder``   — the Ed25519 double-and-add scalar-mult ladder, VMEM-
-  resident limb-plane arithmetic (ba_tpu.ops.planes).  Measured r2 on one
-  chip: 1.33M scalar-mults/s at batch 262k vs 18k/s for the jnp matmul-
-  convolution formulation (~74x).  Default on TPU (ed25519._use_pallas).
-  Verification runs it for [h]A only, over the mod-L-reduced 256-bit
-  digest (ba_tpu.crypto.scalar).
+- ``ladder``   — Ed25519 scalar-mult, VMEM-resident limb-plane arithmetic
+  (ba_tpu.ops.planes), two variants: the double-and-add-always
+  ``scalar_mult`` (bit-exact vs the jnp path; 1.33M scalar-mults/s at
+  batch 262k vs 18k/s for the jnp matmul-convolution formulation, ~74x)
+  and the 4-bit-window ``window_mult`` (5 adds per 4 bits via an
+  in-VMEM 16-entry table; ~1.25x the plain ladder, same group element
+  modulo projective representation).  Verification runs ``window_mult``
+  for [h]A over the mod-L-reduced 256-bit digest (ba_tpu.crypto.scalar).
 - ``treeadd``  — 64-way Edwards point-add tree (two 8-to-1 VMEM levels)
-  folding the gathered fixed-base window points of [S]B; replaces a
-  second ladder entirely (64k lanes: 159 ms vs 729 ms for the jnp scan).
+  folding the fixed-base window points of [S]B, gathered by two exact
+  int8 one-hot MXU einsums; replaces a second ladder entirely (64k
+  lanes: ~91 ms vs 729 ms for the jnp scan).
 - ``powchain`` — fixed-exponent square-and-multiply for decompression's
   (p-5)/8 modular square root, same plane recipe (2.4x the jnp chain).
 - ``sha512_kernel`` — the unrolled 80-round SHA-512 compression for the
   verify digest h = SHA-512(R || A || M).
-  All four together: end-to-end batched verify went from ~8.7k (r1) to
-  ~226k verifies/s at 64k-signature chunks (measured r2).
+  All together: end-to-end batched verify went from ~8.7k (r1) to ~270k
+  verifies/s at 64k-signature chunks (measured r2, host-fetch-timed).
 - ``majority`` — the fused masked strict-majority reduction (the vote
   count of ba.py:159-195 and every EIG resolve level).  This op is HBM-
   bandwidth-bound and XLA's fusion already saturates it (r2 measurement:

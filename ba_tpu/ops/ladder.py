@@ -67,6 +67,42 @@ def _ladder_kernel(nbits, x_ref, y_ref, z_ref, t_ref, bits_ref,
             out_ref[i] = planes[i]
 
 
+def _window_kernel(nwin, x_ref, y_ref, z_ref, t_ref, bits_ref,
+                   ox_ref, oy_ref, oz_ref, ot_ref):
+    """4-bit-window scalar mult: acc = 16*acc + T[digit_w], MSB-first.
+
+    Builds the 16-entry multiples table of the per-lane point in VMEM
+    (15 additions), then runs nwin windows of 4 doublings + one 16-way
+    masked table select + one addition — 5 complete adds per 4 bits
+    instead of the plain ladder's 8, for ~1.5x at the cost of ~5.6 MB of
+    VMEM table.  Same packed-words bit layout as the plain ladder.
+    """
+    p = tuple(
+        [ref[i] for i in range(LIMBS)]
+        for ref in (x_ref, y_ref, z_ref, t_ref)
+    )
+    zero = jnp.zeros((TILE_ROWS, LANES), jnp.int32)
+    table = [p_identity(zero), p]
+    for j in range(2, 16):
+        table.append(p_point_add(table[j - 1], p))
+
+    def body(t, acc):
+        w = nwin - 1 - t  # MSB-first
+        for _ in range(4):
+            acc = p_point_add(acc, acc)
+        word = bits_ref[pl.ds(w >> 3, 1)][0]  # [8, 128]
+        digit = (word >> (4 * (w & 7))) & 15
+        entry = table[0]
+        for j in range(1, 16):
+            entry = p_point_select(digit == j, table[j], entry)
+        return p_point_add(acc, entry)
+
+    acc = jax.lax.fori_loop(0, nwin, body, p_identity(zero))
+    for out_ref, planes in zip((ox_ref, oy_ref, oz_ref, ot_ref), acc):
+        for i in range(LIMBS):
+            out_ref[i] = planes[i]
+
+
 def _to_tiles(x: jnp.ndarray, batch_pad: int) -> jnp.ndarray:
     """[B, k] -> plane-major [k, rows, 128] (zero-padded; zeros are
     add-safe).  Shared tile-layout contract for every ops kernel."""
@@ -116,6 +152,43 @@ def scalar_mult(point: tuple, bits: jnp.ndarray, *, interpret: bool = False):
     )
     outs = pl.pallas_call(
         functools.partial(_ladder_kernel, nbits),
+        grid=(grid,),
+        in_specs=[plane_spec] * 4 + [bits_spec],
+        out_specs=(plane_spec,) * 4,
+        out_shape=(out_shape,) * 4,
+        interpret=interpret,
+    )(*coords, words)
+    return tuple(_from_tiles(o, B) for o in outs)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def window_mult(point: tuple, bits: jnp.ndarray, *, interpret: bool = False):
+    """[k]P via the 4-bit-window kernel — same contract as ``scalar_mult``
+    but ~1.5x faster (5 adds per 4 bits instead of 8); the result is the
+    same group element with a different projective representation (the
+    fold order differs), so compare via point_eq, not limbs.  nbits must
+    be a multiple of 32 (nibble windows ride the same packed words).
+    """
+    B, nbits = bits.shape
+    assert nbits % 32 == 0
+    batch_pad = -(-B // TILE) * TILE
+    grid = batch_pad // TILE
+    coords = [_to_tiles(c, batch_pad) for c in point]
+    words = _pack_bits(bits.astype(jnp.int32), batch_pad)
+
+    plane_spec = pl.BlockSpec(
+        (LIMBS, TILE_ROWS, LANES), lambda i: (0, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    bits_spec = pl.BlockSpec(
+        (nbits // 32, TILE_ROWS, LANES), lambda i: (0, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct(
+        (LIMBS, batch_pad // LANES, LANES), jnp.int32
+    )
+    outs = pl.pallas_call(
+        functools.partial(_window_kernel, nbits // 4),
         grid=(grid,),
         in_specs=[plane_spec] * 4 + [bits_spec],
         out_specs=(plane_spec,) * 4,

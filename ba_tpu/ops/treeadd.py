@@ -93,6 +93,15 @@ def entries_to_planes(entries: jnp.ndarray, batch_pad: int) -> list:
     return [e[c].reshape(W, LIMBS, batch_pad // LANES, LANES) for c in range(4)]
 
 
+def fold64_planes(coords: list, B: int, interpret: bool = False) -> tuple:
+    """Fold plane-major entries [64, 22, rows, 128] x 4 -> Point [B, 22] x 4
+    via the two 8-to-1 kernel levels."""
+    grid_tiles = (coords[0].shape[2] * LANES) // TILE
+    coords = _level(coords, WINDOWS, grid_tiles, interpret)
+    coords = _level(coords, _GROUP, grid_tiles, interpret)
+    return tuple(_from_tiles(c[0], B) for c in coords)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def tree_point_add(entries: jnp.ndarray, *, interpret: bool = False) -> tuple:
     """Fold 64 points per lane: entries [B, 64, 4, 22] int32 (carried-form
@@ -104,7 +113,4 @@ def tree_point_add(entries: jnp.ndarray, *, interpret: bool = False) -> tuple:
     assert W == WINDOWS, f"tree_point_add is specialized to 64 windows, got {W}"
     batch_pad = -(-B // TILE) * TILE
     coords = entries_to_planes(entries, batch_pad)
-    grid_tiles = batch_pad // TILE
-    coords = _level(coords, WINDOWS, grid_tiles, interpret)
-    coords = _level(coords, _GROUP, grid_tiles, interpret)
-    return tuple(_from_tiles(c[0], B) for c in coords)
+    return fold64_planes(coords, B, interpret)

@@ -96,6 +96,20 @@ def test_tile_layout_roundtrip():
 
 
 @pytest.mark.skipif(not _on_tpu(), reason="Mosaic kernel needs real TPU")
+def test_window_mult_matches_scalar_mult_tpu():
+    # The 4-bit-window kernel returns the same group element as the plain
+    # ladder (different projective representation -> point_eq).
+    B = 1024
+    rng = np.random.default_rng(13)
+    pbits = jnp.asarray(rng.integers(0, 2, (B, 16)), jnp.int32)
+    pt = E.scalar_mult(E.base_point((B,)), pbits)
+    kbits = jnp.asarray(rng.integers(0, 2, (B, 256)), jnp.int32)
+    ref = ladder.scalar_mult(pt, kbits)
+    got = ladder.window_mult(pt, kbits)
+    assert np.asarray(E.point_eq(got, ref)).all()
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="Mosaic kernel needs real TPU")
 def test_ladder_pallas_matches_scalar_mult_tpu():
     B = 1024
     rng = np.random.default_rng(3)
