@@ -37,13 +37,14 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from ba_tpu.core.om import round1_broadcast
+from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.quorum import majority_counts, quorum_decision, strict_majority
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT
 
 
 def _coin(key: jax.Array, shape) -> jnp.ndarray:
-    return jr.randint(key, shape, 0, 2, dtype=COMMAND_DTYPE)
+    return coin_bits(key, shape)
 
 
 def _in_path_mask(n: int, level: int) -> np.ndarray:

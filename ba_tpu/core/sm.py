@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from ba_tpu.core.om import round1_broadcast
+from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.quorum import majority_counts, quorum_decision
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
@@ -88,7 +89,7 @@ def sm_relay_rounds(
     honest = state.alive & ~state.faulty  # [B, n]
     for r in range(1, m + 1):  # relay round r: chains have r+1 signers
         if withhold is None:
-            coins = jr.bernoulli(jr.fold_in(key, r), 0.5, (B, n, n, 2))
+            coins = coin_bits(jr.fold_in(key, r), (B, n, n, 2), bool)
         else:
             coins = ~withhold[r - 1]
         # Who was held by some honest general *before* this round: those

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ba_tpu.core.eig import _in_path_mask
 from ba_tpu.core.om import round1_broadcast
 from ba_tpu.core.quorum import quorum_decision, strict_majority
+from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
 
@@ -64,9 +65,8 @@ def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
         self_honest = i_global[None, :, None] == jnp.arange(n)[None, None, :]
         for level in range(m):
             p_sz = n**level
-            coins = jr.randint(
-                jr.fold_in(k_shard, level), (b, n_local, p_sz, n), 0, 2,
-                dtype=COMMAND_DTYPE,
+            coins = coin_bits(
+                jr.fold_in(k_shard, level), (b, n_local, p_sz, n)
             )
             # relayed[b, i, p, j] = V_l[b, j, p] for this chip's receivers.
             relayed = jnp.transpose(prev_global, (0, 2, 1))[:, None, :, :]

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import jax.random as jr
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.quorum import quorum_decision, strict_majority
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
@@ -59,7 +60,7 @@ def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
         # derives the identical received row, no broadcast needed beyond
         # the scalar order. Coins keyed per data shard only.
         k_r1 = jr.fold_in(key, data_idx)
-        coins1 = jr.randint(k_r1, (b, n), 0, 2, dtype=COMMAND_DTYPE)
+        coins1 = coin_bits(k_r1, (b, n))
         leader_faulty = jnp.take_along_axis(faulty, leader[:, None], axis=1)
         received = jnp.where(leader_faulty, coins1, order[:, None])
         is_leader_j = jnp.arange(n)[None, :] == leader[:, None]  # [b, n]
@@ -69,7 +70,7 @@ def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
         # Fresh coins per (receiver, responder) pair, keyed per (data,
         # node) shard so draws are distinct across chips.
         k_r2 = jr.fold_in(jr.fold_in(key, node_idx + 1000), data_idx)
-        coins2 = jr.randint(k_r2, (b, n_local, n), 0, 2, dtype=COMMAND_DTYPE)
+        coins2 = coin_bits(k_r2, (b, n_local, n))
         answers = jnp.where(faulty[:, None, :], coins2, received[:, None, :])
         own = i_global[None, :, None] == jnp.arange(n)[None, None, :]
         answers = jnp.where(own, received[:, None, :], answers)

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ba_tpu.core.om import round1_broadcast
 from ba_tpu.core.quorum import quorum_decision
 from ba_tpu.core.sm import choice_from_seen
+from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
 
@@ -134,8 +135,8 @@ def sm_node_sharded(
                 if wh_l is not None:
                     coins = ~wh_l[r - 1]
                 else:
-                    coins = jr.bernoulli(
-                        jr.fold_in(k_relay, r), 0.5, (b, n_local, n, 2)
+                    coins = coin_bits(
+                        jr.fold_in(k_relay, r), (b, n_local, n, 2), bool
                     )
                 faulty_sends = (
                     seen_g[:, None, :, :]

@@ -333,7 +333,10 @@ CONFIGS = {
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--profile", metavar="DIR", default=None,
-                        help="write a jax.profiler trace to DIR")
+                        help="write a jax.profiler trace to DIR (works on "
+                             "local backends, e.g. BA_TPU_BENCH_PLATFORM=cpu "
+                             "or directly-attached TPU; the shared TPU-tunnel "
+                             "backend does not serve the profiler and hangs)")
     parser.add_argument("--configs", default=os.environ.get(
         "BA_TPU_BENCH_CONFIGS", ",".join(CONFIGS)),
         help="comma-separated subset of: " + ",".join(CONFIGS))

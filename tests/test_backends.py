@@ -27,7 +27,11 @@ SCRIPTS = [
     ["g-state 3 faulty", "actual-order attack"],
     ["g-kill 2", "actual-order retreat"],
     ["g-kill 1", "g-add 1", "actual-order attack", "List"],
-    ["g-state 2 faulty", "g-state 4 faulty", "actual-order retreat"],
+    # Two traitors need n=7 to be outcome-deterministic: each honest
+    # lieutenant then tallies 4 fixed votes vs 2 coins.  (At n=5 the 2-2
+    # tie is reachable, so 5-general 2-traitor scripts are coin-sensitive
+    # — they only ever passed by RNG-stream luck.)
+    ["g-add 2", "g-state 2 faulty", "g-state 4 faulty", "actual-order retreat"],
     ["actual-order charge"],
 ]
 
