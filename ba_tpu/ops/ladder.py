@@ -74,8 +74,8 @@ def _window_kernel(nwin, x_ref, y_ref, z_ref, t_ref, bits_ref,
     Builds the 16-entry multiples table of the per-lane point in VMEM
     (14 additions), then runs nwin windows of 4 doublings + one 16-way
     masked table select + one addition — 5 complete adds per 4 bits
-    instead of the plain ladder's 8, for ~1.5x at the cost of ~5.6 MB of
-    VMEM table.  Same packed-words bit layout as the plain ladder.
+    instead of the plain ladder's 8; ~1.25x measured (the 16-way select
+    costs real vector work) at ~5.6 MB of VMEM table.  Same packed-words bit layout as the plain ladder.
     """
     p = tuple(
         [ref[i] for i in range(LIMBS)]

@@ -49,6 +49,19 @@ def force_virtual_cpu_devices(n: int = 8) -> None:
     """
     if os.environ.get("BA_TPU_TESTS_ON_TPU") == "1":
         return
+    _provision_virtual_cpu_flag(n)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _provision_virtual_cpu_flag(n: int) -> None:
+    """Append/upgrade the host-device-count XLA flag (no platform switch).
+
+    Safe to run unconditionally before backend init: the flag only affects
+    the CPU platform, so a process that ends up on TPU ignores it.
+    """
     flags = os.environ.get("XLA_FLAGS", "")
     pat = re.escape(_COUNT_FLAG) + r"=(\d+)"
     m = re.search(pat, flags)
@@ -58,6 +71,23 @@ def force_virtual_cpu_devices(n: int = 8) -> None:
         flags = re.sub(pat, f"{_COUNT_FLAG}={n}", flags)
     os.environ["XLA_FLAGS"] = flags
 
-    import jax
 
-    jax.config.update("jax_platforms", "cpu")
+def select_example_platform(n: int = 8) -> None:
+    """The examples' platform policy (shared so init order lives here once).
+
+    ``BA_TPU_EXAMPLE_PLATFORM=cpu`` forces the n-device virtual CPU mesh;
+    ``=tpu`` (or anything else explicit) leaves the default backend alone.
+    Unset ("auto"): provision the virtual-CPU device-count flag BEFORE the
+    first backend query — it must precede XLA init to take effect — then
+    keep a real TPU if that is the default backend, else the process lands
+    on the (now n-device) CPU backend with no further switching needed.
+    """
+    mode = os.environ.get("BA_TPU_EXAMPLE_PLATFORM", "auto")
+    if mode == "cpu":
+        force_virtual_cpu_devices(n)
+        return
+    if mode == "auto":
+        _provision_virtual_cpu_flag(n)
+        import jax
+
+        jax.default_backend()  # first init happens with the flag in place

@@ -1,0 +1,38 @@
+"""Smoke tests: every examples/ script runs green as a subprocess.
+
+Each example asserts its own invariants; here we only require exit 0 on
+the virtual-CPU path with small sizes, so the examples can never rot.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = sorted(
+    (pathlib.Path(__file__).parent.parent / "examples").glob("*.py")
+)
+
+
+@pytest.mark.parametrize("script", EXAMPLES, ids=[p.stem for p in EXAMPLES])
+def test_example_runs(script):
+    # Force the CPU path regardless of a present TPU: examples must be
+    # runnable on any machine, and the smoke test must not contend for
+    # the chip.
+    env = dict(
+        os.environ,
+        BA_TPU_EXAMPLE_PLATFORM="cpu",
+        SWEEP_BATCH="256",
+        SWEEP_CAP="16",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=str(script.parent.parent),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
