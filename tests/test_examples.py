@@ -27,6 +27,10 @@ def test_example_runs(script):
         SWEEP_BATCH="256",
         SWEEP_CAP="16",
     )
+    # An inherited BA_TPU_TESTS_ON_TPU=1 would make force_virtual_cpu_devices
+    # a no-op and put the example subprocesses on the real chip, racing the
+    # main pytest process for it — the explicit cpu request must win here.
+    env.pop("BA_TPU_TESTS_ON_TPU", None)
     proc = subprocess.run(
         [sys.executable, str(script)],
         capture_output=True,

@@ -141,6 +141,45 @@ def test_pow_planes_small_exponent_interpret():
         )
 
 
+def test_sqrt_chain_algebra_matches_pow_const():
+    # The addition-chain tower, instantiated with plain field ops on CPU:
+    # pins the chain's algebra (z^(2^252-3)) without Mosaic.
+    from ba_tpu.crypto.oracle import P
+    from ba_tpu.ops.powchain import sqrt_chain
+
+    rng = np.random.default_rng(15)
+    a = jnp.asarray(rng.integers(0, 4096, (4, F.LIMBS)), jnp.int32)
+
+    def sq_n(x, n):
+        for _ in range(n):
+            x = F.square(x)
+        return x
+
+    got = sqrt_chain(a, F.mul, sq_n)
+    ref = F.pow_const(a, (P - 5) // 8)
+    np.testing.assert_array_equal(
+        np.asarray(F.canonical(got)), np.asarray(F.canonical(ref))
+    )
+
+
+def test_pow_planes_sqrt_chain_kernel_interpret():
+    # The production (p-5)/8 routing swaps in the addition-chain kernel;
+    # cover the kernel plumbing (fori_loop squaring runs, limb writeback)
+    # off-TPU via interpret mode — ~90 s, the price of not shipping a
+    # TPU-only path untested (the algebra twin above is instant but does
+    # not execute the kernel).
+    from ba_tpu.crypto.oracle import P
+    from ba_tpu.ops.powchain import pow_planes
+
+    rng = np.random.default_rng(16)
+    a = jnp.asarray(rng.integers(0, 4096, (8, F.LIMBS)), jnp.int32)
+    got = pow_planes(a, (P - 5) // 8, interpret=not _on_tpu())
+    ref = F.pow_const(a, (P - 5) // 8)
+    np.testing.assert_array_equal(
+        np.asarray(F.canonical(got)), np.asarray(F.canonical(ref))
+    )
+
+
 @pytest.mark.skipif(not _on_tpu(), reason="Mosaic kernel needs real TPU")
 def test_pow_planes_sqrt_exponent_tpu():
     from ba_tpu.crypto.oracle import P
