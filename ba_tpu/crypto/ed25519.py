@@ -292,12 +292,14 @@ def verify(pk: jnp.ndarray, msg: jnp.ndarray, sig: jnp.ndarray) -> jnp.ndarray:
     ok_a, ok_r = oks[:B], oks[B:]
     ok_s = _lt_const(s_enc, L)
     h_bytes = sha512(jnp.concatenate([r_enc, pk, msg], axis=-1))
-    h_bits = F.bytes_to_bits(reduce_mod_l(h_bytes))  # [B, 256]
     if _use_pallas():
         from ba_tpu.ops.ladder import window_mult
+        from ba_tpu.ops.modl import reduce_mod_l_planes
 
+        h_bits = F.bytes_to_bits(reduce_mod_l_planes(h_bytes))
         ha = window_mult(a_pt, h_bits)
     else:
+        h_bits = F.bytes_to_bits(reduce_mod_l(h_bytes))  # [B, 256]
         ha = scalar_mult(a_pt, h_bits)
     left = fixed_base_mult(s_enc)
     right = point_add(r_pt, ha)

@@ -16,8 +16,13 @@ Pallas kernels for the ops that dominate the BASELINE workloads:
   folding the fixed-base window points of [S]B, gathered by two exact
   int8 one-hot MXU einsums; replaces a second ladder entirely (64k
   lanes: ~91 ms vs 729 ms for the jnp scan).
-- ``powchain`` — fixed-exponent square-and-multiply for decompression's
-  (p-5)/8 modular square root, same plane recipe (2.4x the jnp chain).
+- ``powchain`` — fixed-exponent exponentiation for decompression's
+  (p-5)/8 modular square root: a 262-mul addition chain for that
+  exponent (~1.9x less work than square-and-multiply), the generic
+  bit-chain otherwise.
+- ``modl``     — the 512-bit mod-L scalar reduction on byte-limb planes;
+  the jnp formulation costs ~110 ms at 64k lanes from XLA materialising
+  ~100 small intermediates, the kernel only the real 96 bytes/lane.
 - ``sha512_kernel`` — the unrolled 80-round SHA-512 compression for the
   verify digest h = SHA-512(R || A || M).
   All together: end-to-end batched verify went from ~8.7k (r1) to ~270k

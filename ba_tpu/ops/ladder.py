@@ -116,6 +116,21 @@ def _from_tiles(tiles: jnp.ndarray, B: int) -> jnp.ndarray:
     return jnp.transpose(tiles.reshape(tiles.shape[0], -1), (1, 0))[:B]
 
 
+def plane_spec(k: int) -> pl.BlockSpec:
+    """The shared per-tile BlockSpec for k-limb plane tensors
+    [k, rows, 128]: one 1024-lane tile per grid step, all limbs resident
+    in VMEM.  Every ops kernel wrapper must build its specs through this
+    helper so the tile-layout contract lives in one place."""
+    return pl.BlockSpec(
+        (k, TILE_ROWS, LANES), lambda i: (0, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def plane_out_shape(k: int, batch_pad: int) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct((k, batch_pad // LANES, LANES), jnp.int32)
+
+
 def _pack_bits(bits: jnp.ndarray, batch_pad: int) -> jnp.ndarray:
     """[B, nbits] {0,1} int32 -> [nbits/32, rows, 128] packed words."""
     B, nbits = bits.shape
@@ -136,24 +151,12 @@ def _mult_call(kernel_fn, point: tuple, bits: jnp.ndarray, interpret: bool):
     grid = batch_pad // TILE
     coords = [_to_tiles(c, batch_pad) for c in point]
     words = _pack_bits(bits.astype(jnp.int32), batch_pad)
-
-    plane_spec = pl.BlockSpec(
-        (LIMBS, TILE_ROWS, LANES), lambda i: (0, i, 0),
-        memory_space=pltpu.VMEM,
-    )
-    bits_spec = pl.BlockSpec(
-        (nbits // 32, TILE_ROWS, LANES), lambda i: (0, i, 0),
-        memory_space=pltpu.VMEM,
-    )
-    out_shape = jax.ShapeDtypeStruct(
-        (LIMBS, batch_pad // LANES, LANES), jnp.int32
-    )
     outs = pl.pallas_call(
         kernel_fn,
         grid=(grid,),
-        in_specs=[plane_spec] * 4 + [bits_spec],
-        out_specs=(plane_spec,) * 4,
-        out_shape=(out_shape,) * 4,
+        in_specs=[plane_spec(LIMBS)] * 4 + [plane_spec(nbits // 32)],
+        out_specs=(plane_spec(LIMBS),) * 4,
+        out_shape=(plane_out_shape(LIMBS, batch_pad),) * 4,
         interpret=interpret,
     )(*coords, words)
     return tuple(_from_tiles(o, B) for o in outs)

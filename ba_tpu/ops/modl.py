@@ -1,0 +1,116 @@
+"""Pallas TPU kernel: batched 512-bit reduction mod L (the group order).
+
+The jnp formulation (ba_tpu/crypto/scalar.py) is ~100 small ops over
+[B, ~50] byte-limb tensors; XLA materialises most of the intermediates,
+so at 64k lanes it costs ~110 ms for what is ~6 MB of real input/output
+(measured r2) — pure fusion pathology.  Here the whole fold plan runs on
+byte-limb planes in VMEM: one [8, 128] tile per limb, every fold constant
+a Python-int immediate, ~2k vector ops per tile, traffic exactly the 64
+input and 32 output bytes per lane.
+
+Algorithm: identical to scalar.py (2^256 === -16*delta folds, one exact
+2^252 fold, one conditional subtract), but with the C port's carry style
+(ba_tpu/native/ed25519.cpp sc_carry): a single sequential pass whose
+final carry lands in a signed top limb — exact for negative values, and
+sequential chains are free inside a kernel where "limbs" are vector
+registers.
+
+Differential contract: byte-identical to scalar.reduce_mod_l for every
+input (interpret-mode + real-TPU tests in tests/test_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ba_tpu.crypto.scalar import _C16, _DELTA, _L32
+from ba_tpu.ops.ladder import (
+    LANES, TILE, TILE_ROWS, _from_tiles, _to_tiles, plane_out_shape,
+    plane_spec,
+)
+
+_C16_I = [int(b) for b in _C16]
+_DELTA_I = [int(b) for b in _DELTA]
+_L32_I = [int(b) for b in _L32]
+
+
+def _fold256(v: list) -> list:
+    """value === lo - hi * C16 (mod L); consumes limbs 32+ entirely."""
+    hi = v[32:]
+    out = v[:32] + [0] * max(0, 16 + len(hi) - 32)
+    for j, cj in enumerate(_C16_I):
+        if not cj:
+            continue
+        for i, h in enumerate(hi):
+            out[j + i] = out[j + i] - cj * h
+    return out
+
+
+def _carry_seq(v: list) -> list:
+    """One exact sequential base-256 pass; signed carry into the top limb."""
+    c = 0
+    out = list(v)
+    for i in range(len(out) - 1):
+        x = out[i] + c
+        c = x >> 8
+        out[i] = x - (c << 8)
+    out[-1] = out[-1] + c
+    return out
+
+
+def _modl_kernel(h_ref, out_ref):
+    v = [h_ref[i] for i in range(64)]
+    v = _carry_seq(_fold256(v) + [0])   # 49 limbs; |value| < 2^385
+    v = _carry_seq(_fold256(v) + [0])   # 34 limbs; |value| < 2^260
+    v = _fold256(v)                     # 32 limbs touched; |value| < 2^258
+    # Make nonnegative (+4L > the worst negative) and normalise.
+    v = v + [0, 0]
+    for i, li in enumerate(_L32_I):
+        v[i] = v[i] + 4 * li
+    v = _carry_seq(v)                   # 34 limbs, value in (0, 2^259)
+    # Exact fold at 2^252: hi <= 143.
+    hi = (v[31] >> 4) + (v[32] << 4) + (v[33] << 12)
+    v[31] = v[31] & 0xF
+    v = v[:32]
+    for j, dj in enumerate(_DELTA_I):
+        if dj:
+            v[j] = v[j] - hi * dj
+    # + L once -> (0, 2L); carry; one conditional subtract of L.
+    for i, li in enumerate(_L32_I):
+        v[i] = v[i] + li
+    v = _carry_seq(v + [0])             # 33 limbs, top == 0
+    borrow = jnp.zeros((TILE_ROWS, LANES), jnp.int32)
+    diffs = []
+    for i in range(33):
+        li = _L32_I[i] if i < 32 else 0
+        x = v[i] - li + borrow
+        borrow = x >> 8
+        diffs.append(x - (borrow << 8))
+    ge = borrow >= 0  # no final borrow <=> value >= L
+    for i in range(32):
+        out_ref[i] = jnp.where(ge, diffs[i], v[i])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def reduce_mod_l_planes(
+    h_bytes: jnp.ndarray, *, interpret: bool = False
+) -> jnp.ndarray:
+    """Drop-in Pallas replacement for ``scalar.reduce_mod_l``:
+    uint8 [B, 64] -> uint8 [B, 32]."""
+    B = h_bytes.shape[0]
+    batch_pad = -(-B // TILE) * TILE
+    tiles = _to_tiles(h_bytes.astype(jnp.int32), batch_pad)
+    out = pl.pallas_call(
+        _modl_kernel,
+        grid=(batch_pad // TILE,),
+        in_specs=[plane_spec(64)],
+        out_specs=plane_spec(32),
+        out_shape=plane_out_shape(32, batch_pad),
+        interpret=interpret,
+    )(tiles)
+    return _from_tiles(out, B).astype(jnp.uint8)

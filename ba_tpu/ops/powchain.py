@@ -18,8 +18,8 @@ semantics bit for bit (differential tests in tests/test_ops.py).
 The one exponent verification actually uses, (p-5)/8 = 2^252 - 3, is
 nearly all ones, so square-and-multiply burns ~504 field muls per lane.
 For it the kernel runs an addition chain instead (the classic
-2^k-1 tower: 1,2,4,5,10,20,40,50,100,200,250): 291 squarings + 12
-multiplies = 303 muls, ~1.7x less work, with the squaring runs as
+2^k-1 tower: 1,2,4,5,10,20,40,50,100,200,250): 251 squarings + 11
+multiplies = 262 muls, ~1.9x less work, with the squaring runs as
 fori_loops so the kernel trace stays small.  The chain is shared with a
 pure-jnp twin (``sqrt_chain``) so its algebra is testable on CPU without
 Mosaic.
@@ -36,7 +36,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ba_tpu.crypto.field import LIMBS
-from ba_tpu.ops.ladder import LANES, TILE, TILE_ROWS, _from_tiles, _to_tiles
+from ba_tpu.ops.ladder import (
+    LANES, TILE, TILE_ROWS, _from_tiles, _to_tiles, plane_out_shape,
+    plane_spec,
+)
 from ba_tpu.ops.planes import const_planes, p_carry, p_mul, p_select
 
 _ONE_PLANES = const_planes(1)
@@ -104,27 +107,20 @@ def pow_planes(a: jnp.ndarray, e: int, *, interpret: bool = False):
 
     ``e`` is static; output is in carried form like pow_const's.  The
     decompression exponent (p-5)/8 routes through the addition-chain
-    kernel (~1.7x less work); every other exponent runs the generic
+    kernel (~1.9x less work); every other exponent runs the generic
     bit-chain.
     """
     B = a.shape[0]
     batch_pad = -(-B // TILE) * TILE
     grid = batch_pad // TILE
     tiles = _to_tiles(a, batch_pad)
-    plane_spec = pl.BlockSpec(
-        (LIMBS, TILE_ROWS, LANES), lambda i: (0, i, 0),
-        memory_space=pltpu.VMEM,
-    )
-    out_shape = jax.ShapeDtypeStruct(
-        (LIMBS, batch_pad // LANES, LANES), jnp.int32
-    )
     if e == _SQRT_EXP:
         out = pl.pallas_call(
             _sqrt_chain_kernel,
             grid=(grid,),
-            in_specs=[plane_spec],
-            out_specs=plane_spec,
-            out_shape=out_shape,
+            in_specs=[plane_spec(LIMBS)],
+            out_specs=plane_spec(LIMBS),
+            out_shape=plane_out_shape(LIMBS, batch_pad),
             interpret=interpret,
         )(tiles)
         return _from_tiles(out, B)
@@ -139,12 +135,12 @@ def pow_planes(a: jnp.ndarray, e: int, *, interpret: bool = False):
         functools.partial(_pow_kernel, nbits),
         grid=(grid,),
         in_specs=[
-            plane_spec,
+            plane_spec(LIMBS),
             pl.BlockSpec((nw, 1), lambda i: (0, 0),
                          memory_space=pltpu.SMEM),
         ],
-        out_specs=plane_spec,
-        out_shape=out_shape,
+        out_specs=plane_spec(LIMBS),
+        out_shape=plane_out_shape(LIMBS, batch_pad),
         interpret=interpret,
     )(tiles, jnp.asarray(words))
     return _from_tiles(out, B)

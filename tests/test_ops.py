@@ -252,6 +252,28 @@ def test_treeadd_pallas_tpu_multi_tile():
     assert np.asarray(E.point_eq(got, ref)).all()
 
 
+# -- mod-L reduction kernel ---------------------------------------------------
+
+
+def test_modl_kernel_matches_jnp():
+    # Interpret mode is cheap here (~2k vector ops); edges + random vs the
+    # bigint-pinned jnp reduction.
+    from ba_tpu.crypto.oracle import L
+    from ba_tpu.crypto.scalar import reduce_mod_l
+    from ba_tpu.ops.modl import reduce_mod_l_planes
+
+    rng = np.random.default_rng(17)
+    q = 2**512 // L
+    vals = [0, 1, L - 1, L, L + 1, 2**252, 2**256, q * L - 1, q * L, 2**512 - 1]
+    vals += [int.from_bytes(rng.bytes(64), "little") for _ in range(54)]
+    by = jnp.asarray(
+        np.stack([np.frombuffer(v.to_bytes(64, "little"), np.uint8) for v in vals])
+    )
+    a = np.asarray(jax.jit(reduce_mod_l)(by))
+    b = np.asarray(reduce_mod_l_planes(by, interpret=not _on_tpu()))
+    np.testing.assert_array_equal(a, b)
+
+
 # -- sha512 kernel ------------------------------------------------------------
 
 
