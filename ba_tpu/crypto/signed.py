@@ -177,11 +177,12 @@ def sign_received(
 def _verify_chunk() -> int:
     """Max signatures per ed25519.verify dispatch.
 
-    The jnp ladder's live intermediates spill past ~4k lanes and throughput
-    collapses superlinearly (measured r2: 8.7k/s at 4096, 345/s at 20480);
-    the Pallas ladder + pow-chain kernels (ba_tpu.ops) have no such cliff
-    and keep scaling through 64k-signature chunks (~119k verifies/s
-    measured r2), where the fixed dispatch cost amortizes.
+    The jnp ladder's live intermediates spill past ~4k lanes and
+    throughput collapses superlinearly (r2, like-for-like timings: ~25x
+    slower per signature at 20480 lanes than at 4096); the Pallas kernel
+    set (ba_tpu.ops) has no such cliff and keeps scaling through
+    64k-signature chunks (~270-360k verifies/s, host-fetch-timed r2),
+    where the fixed dispatch cost amortizes.
     """
     env = os.environ.get("BA_TPU_VERIFY_CHUNK")
     if env:

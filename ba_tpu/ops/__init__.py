@@ -6,8 +6,10 @@ Pallas kernels for the ops that dominate the BASELINE workloads:
 
 - ``ladder``   — Ed25519 scalar-mult, VMEM-resident limb-plane arithmetic
   (ba_tpu.ops.planes), two variants: the double-and-add-always
-  ``scalar_mult`` (bit-exact vs the jnp path; 1.33M scalar-mults/s at
-  batch 262k vs 18k/s for the jnp matmul-convolution formulation, ~74x)
+  ``scalar_mult`` (bit-exact vs the jnp path; host-fetch-timed r2:
+  ~367k 256-bit scalar-mults/s at 64k lanes vs ~22k/s for the jnp
+  matmul-convolution formulation at its best chunk size — ~17x, and the
+  jnp path additionally collapses at larger batches)
   and the 4-bit-window ``window_mult`` (5 adds per 4 bits via an
   in-VMEM 16-entry table; ~1.25x the plain ladder, same group element
   modulo projective representation).  Verification runs ``window_mult``

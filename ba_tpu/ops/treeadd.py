@@ -4,7 +4,8 @@ The fixed-base half of signature verification ([S]B, ba_tpu/crypto/
 ed25519.fixed_base_mult) gathers one precomputed window point per 4-bit
 digit — 64 points per lane — and folds them with 63 complete additions.
 The jnp scan form pays the [484 x 43] matmul waste per field mul and
-round-trips HBM every step (measured r2: 729 ms for 64k lanes — 4x the
+round-trips HBM every step (r2, like-for-like stage timings: 729 ms
+for 64k lanes — 4x the
 entire 256-step Pallas ladder).  Here the fold runs as two grid levels of
 an 8-to-1 in-VMEM reduction:
 
