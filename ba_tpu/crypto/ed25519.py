@@ -247,24 +247,25 @@ def decompress(by: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
     masked = by.at[..., 31].set(by[..., 31] & 0x7F)
     ok = _lt_const(masked, P)
     y = F.from_bytes(masked)
-    yy = F.square(y)
-    u = F.sub(yy, jnp.broadcast_to(_ONE, yy.shape))
-    v = F.carry(F.add(F.mul(yy, _D), jnp.broadcast_to(_ONE, yy.shape)))
-    v3 = F.mul(F.square(v), v)
-    v7 = F.mul(F.square(v3), v)
-    if _use_pallas():
-        from ba_tpu.ops.powchain import pow_planes
+    if _use_pallas() and by.ndim == 2:
+        # The whole field chain (incl. the (p-5)/8 addition chain) in one
+        # VMEM program; only the root choice stays here.
+        from ba_tpu.ops.decompress import decompress_core
 
-        uv7 = F.mul(u, v7)  # kernel tiling is 2-D; keep [...] batch dims
-        flat = uv7.reshape(-1, F.LIMBS)
-        t = pow_planes(flat, (P - 5) // 8).reshape(uv7.shape)
+        x, x_alt, vxx, u = decompress_core(y)
     else:
+        yy = F.square(y)
+        u = F.sub(yy, jnp.broadcast_to(_ONE, yy.shape))
+        v = F.carry(F.add(F.mul(yy, _D), jnp.broadcast_to(_ONE, yy.shape)))
+        v3 = F.mul(F.square(v), v)
+        v7 = F.mul(F.square(v3), v)
         t = F.pow_const(F.mul(u, v7), (P - 5) // 8)
-    x = F.mul(F.mul(u, v3), t)
-    vxx = F.mul(v, F.square(x))
+        x = F.mul(F.mul(u, v3), t)
+        x_alt = F.mul(x, _SQRT_M1)
+        vxx = F.mul(v, F.square(x))
     root1 = F.eq(vxx, u)
     root2 = F.eq(vxx, F.sub(F.zeros(u.shape[:-1]), u))
-    x = jnp.where(root2[..., None], F.mul(x, _SQRT_M1), x)
+    x = jnp.where(root2[..., None], x_alt, x)
     ok = ok & (root1 | root2)
     xc = F.canonical(x)
     x_zero = F.is_zero(xc)

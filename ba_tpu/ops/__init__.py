@@ -25,10 +25,15 @@ Pallas kernels for the ops that dominate the BASELINE workloads:
 - ``modl``     — the 512-bit mod-L scalar reduction on byte-limb planes;
   the jnp formulation costs ~110 ms at 64k lanes from XLA materialising
   ~100 small intermediates, the kernel only the real 96 bytes/lane.
+- ``decompress`` — the whole RFC 8032 decompression field chain (u, v,
+  the uv^3/uv^7 candidates, the root check products) fused around the
+  addition chain in one VMEM program; HBM sees only y in and the root
+  candidates out.
 - ``sha512_kernel`` — the unrolled 80-round SHA-512 compression for the
   verify digest h = SHA-512(R || A || M).
-  All together: end-to-end batched verify went from ~8.7k (r1) to ~270k
-  verifies/s at 64k-signature chunks (measured r2, host-fetch-timed).
+  All together: end-to-end batched verify went from ~8.7k (r1) to ~310k
+  verifies/s serialized / ~410k pipelined at 64k-signature chunks
+  (measured r2, host-fetch-timed).
 - ``majority`` — the fused masked strict-majority reduction (the vote
   count of ba.py:159-195 and every EIG resolve level).  This op is HBM-
   bandwidth-bound and XLA's fusion already saturates it (r2 measurement:

@@ -1,0 +1,82 @@
+"""Pallas TPU kernel: the field-arithmetic core of point decompression.
+
+RFC 8032 5.1.3 (ba_tpu/crypto/ed25519.decompress) needs ~10 field muls
+around the (p-5)/8 square-root chain: u = y^2-1, v = d y^2+1, the
+uv^3/uv^7 candidates, and the v x^2 root check.  Run as jnp matmul-form
+muls they cost ~half of decompress (like-for-like stage timings r2); here
+they ride in the same VMEM program as the addition-chain exponentiation
+(ops/powchain.sqrt_chain), so decompression touches HBM once on the way
+in (y) and once on the way out.
+
+The kernel returns both root candidates (x and x*sqrt(-1)) plus vxx and
+u; the cheap data-dependent tail — which root is valid, the sign-bit
+flip, ok-masking — stays in jnp where canonical equality already lives
+(ba_tpu/crypto/ed25519.decompress).
+
+Differential contract: each output equals the corresponding jnp
+intermediate value (same field element; carried forms may differ).
+Like the ladder, the fused kernel is pinned on real TPU only
+(tests/test_ops.py; interpret-under-jit blows past a 9-minute XLA-CPU
+compile) — its pieces are CPU-covered separately (plane ops, the
+sqrt_chain algebra + interpret run in ops/powchain tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ba_tpu.crypto.field import LIMBS
+from ba_tpu.crypto.oracle import D, P, SQRT_M1
+from ba_tpu.ops.ladder import (
+    TILE, _from_tiles, _to_tiles, plane_out_shape, plane_spec,
+)
+from ba_tpu.ops.planes import const_planes, p_add, p_carry, p_mul, p_sub
+from ba_tpu.ops.powchain import p_sq_n, sqrt_chain
+
+_D_PLANES = const_planes(D % P)
+_SQRTM1_PLANES = const_planes(SQRT_M1)
+_ONE = const_planes(1)
+
+
+def _decompress_kernel(y_ref, x_ref, xalt_ref, vxx_ref, u_ref):
+    y = p_carry([y_ref[i] for i in range(LIMBS)])
+    one = list(_ONE)
+    yy = p_mul(y, y)
+    u = p_carry(p_sub(yy, one))  # subtrahend-safe form for later users
+    v = p_carry(p_add(p_mul(yy, _D_PLANES), one))
+    v3 = p_mul(p_mul(v, v), v)
+    v7 = p_mul(p_mul(v3, v3), v)
+    t = sqrt_chain(p_mul(u, v7), p_mul, p_sq_n)
+    x = p_mul(p_mul(u, v3), t)
+    x_alt = p_mul(x, _SQRTM1_PLANES)
+    vxx = p_mul(v, p_mul(x, x))
+    for ref, planes in (
+        (x_ref, x), (xalt_ref, x_alt), (vxx_ref, vxx), (u_ref, u)
+    ):
+        for i in range(LIMBS):
+            ref[i] = planes[i] + jnp.zeros_like(y[0])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decompress_core(y: jnp.ndarray, *, interpret: bool = False) -> tuple:
+    """y limbs [B, 22] -> (x, x*sqrt(-1), v*x^2, u = y^2-1), each [B, 22].
+
+    The caller picks the valid root via canonical equality of vxx with
+    +-u and applies the encoding's sign bit (ed25519.decompress).
+    """
+    B = y.shape[0]
+    batch_pad = -(-B // TILE) * TILE
+    tiles = _to_tiles(y, batch_pad)
+    outs = pl.pallas_call(
+        _decompress_kernel,
+        grid=(batch_pad // TILE,),
+        in_specs=[plane_spec(LIMBS)],
+        out_specs=(plane_spec(LIMBS),) * 4,
+        out_shape=(plane_out_shape(LIMBS, batch_pad),) * 4,
+        interpret=interpret,
+    )(tiles)
+    return tuple(_from_tiles(o, B) for o in outs)

@@ -70,13 +70,15 @@ def sqrt_chain(z, mul, sq_n):
     return mul(sq_n(t250, 2), z)
 
 
+def p_sq_n(x, n):
+    """n plane squarings as a fori_loop (n static) — the kernel-side
+    squaring-run helper shared by every addition-chain kernel."""
+    return jax.lax.fori_loop(0, n, lambda _, v: p_mul(v, v), x)
+
+
 def _sqrt_chain_kernel(a_ref, out_ref):
     z = p_carry([a_ref[i] for i in range(LIMBS)])
-
-    def sq_n(x, n):
-        return jax.lax.fori_loop(0, n, lambda _, v: p_mul(v, v), x)
-
-    result = sqrt_chain(z, p_mul, sq_n)
+    result = sqrt_chain(z, p_mul, p_sq_n)
     for i in range(LIMBS):
         out_ref[i] = result[i]
 

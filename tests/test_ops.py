@@ -252,6 +252,47 @@ def test_treeadd_pallas_tpu_multi_tile():
     assert np.asarray(E.point_eq(got, ref)).all()
 
 
+# -- decompression core kernel ------------------------------------------------
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="Mosaic kernel needs real TPU")
+def test_decompress_core_matches_jnp():
+    # The fused chain is too large for interpret-under-jit on CPU (the
+    # XLA-CPU compile blows past 9 min); its pieces are CPU-covered
+    # separately (plane ops, sqrt_chain algebra + interpret), and this
+    # pins the fused kernel against the jnp formulation on hardware.
+    from ba_tpu.crypto.oracle import P
+    from ba_tpu.ops.decompress import decompress_core
+
+    rng = np.random.default_rng(18)
+    vals = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(6)]
+    ylimbs = jnp.asarray(
+        np.stack([
+            [(v >> (12 * i)) & 0xFFF for i in range(F.LIMBS)] for v in vals
+        ]).astype(np.int32)
+    )
+    x, x_alt, vxx, u = decompress_core(ylimbs)
+    one = jnp.broadcast_to(F.constant(1), ylimbs.shape)
+    yy = F.square(ylimbs)
+    u_ref = F.sub(yy, one)
+    d = F.constant((-121665 * pow(121666, P - 2, P)) % P)
+    v = F.carry(F.add(F.mul(yy, d), one))
+    v3 = F.mul(F.square(v), v)
+    v7 = F.mul(F.square(v3), v)
+    t = F.pow_const(F.mul(u_ref, v7), (P - 5) // 8)
+    x_ref = F.mul(F.mul(u_ref, v3), t)
+    vxx_ref = F.mul(v, F.square(x_ref))
+    for got, ref in ((x, x_ref), (vxx, vxx_ref), (u, u_ref)):
+        np.testing.assert_array_equal(
+            np.asarray(F.canonical(got)), np.asarray(F.canonical(ref))
+        )
+    sqrt_m1 = F.constant(pow(2, (P - 1) // 4, P))
+    np.testing.assert_array_equal(
+        np.asarray(F.canonical(x_alt)),
+        np.asarray(F.canonical(F.mul(x_ref, sqrt_m1))),
+    )
+
+
 # -- mod-L reduction kernel ---------------------------------------------------
 
 
