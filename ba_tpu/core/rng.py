@@ -27,6 +27,39 @@ import jax.random as jr
 from ba_tpu.core.types import COMMAND_DTYPE
 
 
+def uniform_u8(key: jax.Array, shape) -> jnp.ndarray:
+    """iid uniform draws on [0, 256) as int32: 4 draws per PRNG word.
+
+    The collapsed SM relay compares uniforms against a per-(instance,
+    value) Bernoulli threshold (core/sm.py); drawing 8-bit fields instead
+    of ``jr.uniform`` f32 lanes quarters the threefry work — the dominant
+    cost of the relay at sweep scale (VERDICT r2) — and drops the
+    int->float conversion entirely.  Same [4, nwords] unpack orientation
+    as ``coin_bits`` (byte-index major keeps the long word axis on vector
+    lanes).
+    """
+    size = math.prod(shape)
+    nwords = -(-size // 4)
+    words = jr.bits(key, (nwords,), jnp.uint32)
+    shifts = jnp.arange(4, dtype=jnp.uint32) * 8
+    vals = ((words[None, :] >> shifts[:, None]) & 0xFF).astype(jnp.int32)
+    return vals.reshape(-1)[:size].reshape(shape)
+
+
+def or_coin_threshold8(k_cnt: jnp.ndarray, gate: jnp.ndarray) -> jnp.ndarray:
+    """8-bit threshold T with P(uniform_u8 < T) = 1 - 2^-k, gated to 0.
+
+    The OR of k iid fair coins fires with probability 1 - 2^-k: exact in
+    256ths for k <= 8; for k > 8 the threshold saturates at 256 (fire
+    always, absolute error 2^-k, at most 2^-9, per draw).  ``gate`` False
+    forces probability 0 (the chain-length bound of the signed relay).
+    """
+    t = jnp.where(
+        k_cnt > 8, 256, 256 - (256 >> jnp.minimum(k_cnt, 8))
+    )
+    return jnp.where(gate, t, 0)
+
+
 def coin_bits(key: jax.Array, shape, dtype=COMMAND_DTYPE) -> jnp.ndarray:
     """iid fair coins of ``shape``: 0/1 in ``dtype`` (bool for masks).
 

@@ -55,7 +55,7 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from ba_tpu.core.om import round1_broadcast
-from ba_tpu.core.rng import coin_bits
+from ba_tpu.core.rng import coin_bits, or_coin_threshold8, uniform_u8
 from ba_tpu.core.quorum import majority_counts, quorum_decision
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
@@ -129,10 +129,16 @@ def sm_relay_rounds_collapsed(
     and the coins are independent across receivers.  The OR of k iid
     Bernoulli(1/2) draws is Bernoulli(1 - 2^-k), still independent across
     receivers — so sample that directly and never materialise the
-    [B, n, n, 2] send cube.  Every execution reachable by the exact model is
-    reachable here with identical probability (the transition law of the
-    ``seen`` Markov chain matches round by round); tests/test_sm.py pins the
-    equivalence both deterministically (t = 0) and statistically.
+    [B, n, n, 2] send cube.  The transition law of the ``seen`` Markov
+    chain matches the exact model round by round up to sampling
+    granularity: the packed 8-bit threshold draw (``uniform_u8`` /
+    ``or_coin_threshold8``, 4 draws per threefry word — the relay's
+    dominant cost at sweep scale) realises Bernoulli(1 - 2^-k) exactly
+    for k <= 8 traitor holders and saturates to probability 1 beyond
+    (absolute error 2^-k, at most 2^-9, per draw; the earlier f32 ``jr.uniform``
+    comparison carried the analogous bound from k = 25 on, at 4x the RNG
+    cost).  tests/test_sm.py pins the equivalence both deterministically
+    (t = 0) and statistically.
 
     This is the path that makes the n=1024 scale point (BASELINE config #4)
     cheap: an SM(m) round costs O(B * n) instead of O(B * n^2), so the
@@ -148,13 +154,13 @@ def sm_relay_rounds_collapsed(
         held_honest = jnp.any(seen & honest[..., None], axis=1)  # [B, 2]
         chain_ok = (r < t)[:, None] | held_honest  # [B, 2]
         k_cnt = jnp.sum(seen & traitor[..., None], axis=1)  # [B, 2]
-        p = jnp.where(chain_ok, 1.0 - jnp.exp2(-k_cnt.astype(jnp.float32)), 0.0)
-        u = jr.uniform(jr.fold_in(key, r), (B, n, 2))
-        incoming = (u < p[:, None, :]) | held_honest[:, None, :]
+        thresh = or_coin_threshold8(k_cnt, chain_ok)  # [B, 2]
+        u = uniform_u8(jr.fold_in(key, r), (B, n, 2))
+        incoming = (u < thresh[:, None, :]) | held_honest[:, None, :]
         seen = (seen | incoming) & state.alive[..., None]
         return seen, None
 
-    seen, _ = jax.lax.scan(one_round, seen, jnp.arange(1, m + 1))
+    seen, _ = jax.lax.scan(one_round, seen, jnp.arange(1, m + 1), unroll=True)
     return seen
 
 

@@ -32,8 +32,7 @@ from ba_tpu.core.quorum import quorum_decision, strict_majority
 from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
-
-_COMPILED: dict = {}
+from ba_tpu.parallel.mesh import cached_jit
 
 
 def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
@@ -117,9 +116,9 @@ def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
         decision, needed, total = quorum_decision(att, ret, und)
         return maj, decision, needed, total, att, ret, und
 
-    cache_key = (mesh, n, m)
-    if cache_key not in _COMPILED:
-        f = jax.shard_map(
+    fn = cached_jit(
+        ("eig", mesh, n, m),
+        lambda: jax.shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(
@@ -139,9 +138,9 @@ def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
                 P("data"),  # n_retreat
                 P("data"),  # n_undefined
             ),
-        )
-        _COMPILED[cache_key] = jax.jit(f)
-    maj, decision, needed, total, att, ret, und = _COMPILED[cache_key](
+        ),
+    )
+    maj, decision, needed, total, att, ret, und = fn(
         key, state.order, state.leader, state.faulty, state.alive, received
     )
     return {

@@ -85,8 +85,12 @@ def make_global_mesh(
     n_proc = max(len(counts), 1)
     data = n_proc * (n_local // node_devices_per_host)
     arr = np.empty((data, node_devices_per_host), dtype=object)
-    # Keep each host's devices contiguous along "node": sort by
-    # (process, local ordinal) — jax.devices() is already in that order.
+    # Keep each host's devices contiguous along "node".  jax.devices() is
+    # documented to group by process, but the hot "node" axis silently
+    # spanning hosts over DCN would defeat the whole axis policy, so sort
+    # explicitly by (process, local ordinal) rather than trusting the
+    # returned order (ADVICE r2).
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
     for i, d in enumerate(devs):
         arr[i // node_devices_per_host, i % node_devices_per_host] = d
     return Mesh(arr, axis_names)

@@ -29,11 +29,7 @@ from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.quorum import quorum_decision, strict_majority
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
-
-# Compiled-program cache keyed by (mesh, n): rebuilding the shard_map
-# closure per call would re-trace and recompile every round (~2 s each on
-# the 8-device CPU mesh) — repeated rounds must hit the pjit cache.
-_COMPILED: dict = {}
+from ba_tpu.parallel.mesh import cached_jit
 
 
 def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
@@ -92,9 +88,9 @@ def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
         decision, needed, total = quorum_decision(att, ret, und)
         return maj, decision, needed, total, att, ret, und
 
-    cache_key = (mesh, n)
-    if cache_key not in _COMPILED:
-        f = jax.shard_map(
+    fn = cached_jit(
+        ("om1", mesh, n),
+        lambda: jax.shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(
@@ -113,9 +109,9 @@ def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
                 P("data"),
                 P("data"),
             ),
-        )
-        _COMPILED[cache_key] = jax.jit(f)
-    maj, decision, needed, total, att, ret, und = _COMPILED[cache_key](
+        ),
+    )
+    maj, decision, needed, total, att, ret, und = fn(
         key, state.order, state.leader, state.faulty, state.alive
     )
     return {

@@ -36,13 +36,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ba_tpu.core.om import round1_broadcast
 from ba_tpu.core.quorum import quorum_decision
 from ba_tpu.core.sm import choice_from_seen
-from ba_tpu.core.rng import coin_bits
+from ba_tpu.core.rng import coin_bits, or_coin_threshold8, uniform_u8
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
-
-# pjit-cache guard, same rationale as node_parallel._COMPILED: rebuilding
-# the shard_map closure per call would retrace every round.
-_COMPILED: dict = {}
+from ba_tpu.parallel.mesh import cached_jit
 
 
 def sm_node_sharded(
@@ -118,14 +115,14 @@ def sm_node_sharded(
                 held, k_cnt = jax.lax.psum((held, k_cnt), "node")
                 held_honest = held > 0
                 chain_ok = (r < t)[:, None] | held_honest
-                p = jnp.where(
-                    chain_ok, 1.0 - jnp.exp2(-k_cnt.astype(jnp.float32)), 0.0
-                )
-                u = jr.uniform(jr.fold_in(k_relay, r), (b, n_local, 2))
-                incoming = (u < p[:, None, :]) | held_honest[:, None, :]
+                thresh = or_coin_threshold8(k_cnt, chain_ok)  # [b, 2]
+                u = uniform_u8(jr.fold_in(k_relay, r), (b, n_local, 2))
+                incoming = (u < thresh[:, None, :]) | held_honest[:, None, :]
                 return (seen_l | incoming) & alive_l[..., None], None
 
-            seen_l, _ = jax.lax.scan(one_round, seen_l, jnp.arange(1, m + 1))
+            seen_l, _ = jax.lax.scan(
+                one_round, seen_l, jnp.arange(1, m + 1), unroll=True
+            )
         else:
             for r in range(1, m + 1):
                 # Global V-sets: one [b, n, 2]-bool all_gather per round.
@@ -163,8 +160,7 @@ def sm_node_sharded(
         decision, needed, total = quorum_decision(att, ret, und)
         return maj, decision, needed, total, att, ret, und
 
-    cache_key = (mesh, n, m, collapsed, has_sig, has_withhold)
-    if cache_key not in _COMPILED:
+    def build():
         in_specs = [
             P(),  # key (replicated)
             P("data"),  # order
@@ -179,7 +175,7 @@ def sm_node_sharded(
             # [m, B, receiver, sender, value]: receivers shard with their
             # owning chips, senders/values replicated.
             in_specs.append(P(None, "data", "node", None, None))
-        f = jax.shard_map(
+        return jax.shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=tuple(in_specs),
@@ -193,13 +189,14 @@ def sm_node_sharded(
                 P("data"),
             ),
         )
-        _COMPILED[cache_key] = jax.jit(f)
+
+    fn = cached_jit(("sm", mesh, n, m, collapsed, has_sig, has_withhold), build)
     args = [key, state.order, state.leader, state.faulty, state.alive, received]
     if has_sig:
         args.append(sig_valid)
     if has_withhold:
         args.append(withhold)
-    maj, decision, needed, total, att, ret, und = _COMPILED[cache_key](*args)
+    maj, decision, needed, total, att, ret, und = fn(*args)
     return {
         "majorities": maj,
         "decision": decision,

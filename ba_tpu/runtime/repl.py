@@ -18,7 +18,10 @@ Divergences (all guarded crashes in the reference, documented in SURVEY.md
 section 3.3): unknown ids and an empty cluster are ignored instead of
 raising (Q4), and ``actual-order`` immediately after killing the leader
 cannot hit a not-yet-reelected assert (Q5) because election here is
-event-driven.
+event-driven.  Q6 (a general added mid-round never sees that round's
+command, ba.py:53-57) is unrepresentable here: rounds are atomic device
+programs, so membership can only change between rounds — a joiner simply
+votes from the next ``actual-order`` on.
 """
 
 from __future__ import annotations

@@ -37,17 +37,20 @@ def use_pallas() -> bool:
     return jax.devices()[0].platform == "tpu"
 
 
-def force_virtual_cpu_devices(n: int = 8) -> None:
+def force_virtual_cpu_devices(n: int = 8, *, override_tpu_guard: bool = False) -> None:
     """Ensure >= n virtual CPU devices and select the CPU platform.
 
     Must run before the first ``jax.devices()``/backend query in the process.
     Honors ``BA_TPU_TESTS_ON_TPU=1``: then it is a no-op so the caller runs
-    against whatever real hardware the environment provides.
+    against whatever real hardware the environment provides — unless
+    ``override_tpu_guard`` is set, for callers relaying an *explicit* user
+    request for CPU that must win over an inherited test-env var (ADVICE
+    r2: ``BA_TPU_EXAMPLE_PLATFORM=cpu`` silently landing on the real chip).
 
     An existing ``--xla_force_host_platform_device_count`` smaller than n is
     upgraded in place; an equal-or-larger one is preserved.
     """
-    if os.environ.get("BA_TPU_TESTS_ON_TPU") == "1":
+    if os.environ.get("BA_TPU_TESTS_ON_TPU") == "1" and not override_tpu_guard:
         return
     _provision_virtual_cpu_flag(n)
 
@@ -84,7 +87,9 @@ def select_example_platform(n: int = 8) -> None:
     """
     mode = os.environ.get("BA_TPU_EXAMPLE_PLATFORM", "auto")
     if mode == "cpu":
-        force_virtual_cpu_devices(n)
+        # Explicit user request: wins even over an inherited
+        # BA_TPU_TESTS_ON_TPU=1 (ADVICE r2).
+        force_virtual_cpu_devices(n, override_tpu_guard=True)
         return
     if mode == "auto":
         _provision_virtual_cpu_flag(n)

@@ -21,16 +21,17 @@ def test_example_runs(script):
     # Force the CPU path regardless of a present TPU: examples must be
     # runnable on any machine, and the smoke test must not contend for
     # the chip.
+    # BA_TPU_TESTS_ON_TPU=1 is set explicitly (not just inherited) so every
+    # run pins the precedence rule: an explicit BA_TPU_EXAMPLE_PLATFORM=cpu
+    # must override the TPU-tests guard inside select_example_platform, or
+    # the subprocess would land on (and race for) the real chip.
     env = dict(
         os.environ,
         BA_TPU_EXAMPLE_PLATFORM="cpu",
+        BA_TPU_TESTS_ON_TPU="1",
         SWEEP_BATCH="256",
         SWEEP_CAP="16",
     )
-    # An inherited BA_TPU_TESTS_ON_TPU=1 would make force_virtual_cpu_devices
-    # a no-op and put the example subprocesses on the real chip, racing the
-    # main pytest process for it — the explicit cpu request must win here.
-    env.pop("BA_TPU_TESTS_ON_TPU", None)
     proc = subprocess.run(
         [sys.executable, str(script)],
         capture_output=True,
