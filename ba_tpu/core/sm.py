@@ -160,7 +160,12 @@ def sm_relay_rounds_collapsed(
         seen = (seen | incoming) & state.alive[..., None]
         return seen, None
 
-    seen, _ = jax.lax.scan(one_round, seen, jnp.arange(1, m + 1), unroll=True)
+    # Bounded unroll: lets XLA fuse adjacent rounds (the m=3 sweep unrolls
+    # fully) without exploding compile time at m=32, where a full unroll
+    # inside an outer scan multiplied remote-compile time ~10x (r3).
+    seen, _ = jax.lax.scan(
+        one_round, seen, jnp.arange(1, m + 1), unroll=min(m, 4)
+    )
     return seen
 
 

@@ -27,6 +27,7 @@
 
 #include <stdint.h>
 #include <stddef.h>
+#include <stdlib.h>
 #include <string.h>
 
 #include "constants.h"
@@ -298,19 +299,23 @@ static void ge_scalarmult(ge* r, const ge* p, const u8* k, int nbytes) {
     *r = acc;
 }
 
-// Fixed-base window table: T[w][j] = [j * 16^w]B — the same 4-bit window
-// scheme as the device path (ba_tpu/crypto/ed25519.fixed_base_mult), so
-// [k]B is 64 complete additions and no doublings.
-static ge BASE_TABLE[64][16];
+// Fixed-base window table: T[w][j] = [j * 256^w]B — byte windows, twice
+// the stride of the device path's 4-bit scheme (ba_tpu/crypto/
+// ed25519.fixed_base_mult): [k]B is 32 complete additions and no
+// doublings.  1.3 MB of table (32 x 256 x 160 B) and an 8k-addition
+// one-time init (~the cost of ~130 signs) buy a 2x cut in the per-sign
+// point arithmetic — the right trade for a batch signer that signs tens
+// of thousands of times per process (the sweep's 2 signs/commander).
+static ge BASE_TABLE[32][256];
 
 static void base_table_init(void) {
     ge step;
     ge_base(&step);
-    for (int w = 0; w < 64; w++) {
+    for (int w = 0; w < 32; w++) {
         ge_identity(&BASE_TABLE[w][0]);
-        for (int j = 1; j < 16; j++)
+        for (int j = 1; j < 256; j++)
             ge_add(&BASE_TABLE[w][j], &BASE_TABLE[w][j - 1], &step);
-        ge_add(&step, &BASE_TABLE[w][15], &step);  // 16^(w+1) B
+        ge_add(&step, &BASE_TABLE[w][255], &step);  // 256^(w+1) B
     }
 }
 
@@ -318,10 +323,8 @@ static void base_table_init(void) {
 static void ge_scalarmult_base(ge* r, const u8 k[32]) {
     ge acc;
     ge_identity(&acc);
-    for (int i = 0; i < 32; i++) {
-        ge_add(&acc, &acc, &BASE_TABLE[2 * i][k[i] & 0xF]);
-        ge_add(&acc, &acc, &BASE_TABLE[2 * i + 1][k[i] >> 4]);
-    }
+    for (int i = 0; i < 32; i++)
+        ge_add(&acc, &acc, &BASE_TABLE[i][k[i]]);
     *r = acc;
 }
 
@@ -336,15 +339,51 @@ static void consts_init(void) {
     CONSTS_READY = 1;
 }
 
-static void ge_tobytes(u8 s[32], const ge* p) {
-    fe zi, x, y;
-    fe_inv(&zi, &p->z);
-    fe_mul(&x, &p->x, &zi);
-    fe_mul(&y, &p->y, &zi);
+static void ge_tobytes_with_zi(u8 s[32], const ge* p, const fe* zi) {
+    fe x, y;
+    fe_mul(&x, &p->x, zi);
+    fe_mul(&y, &p->y, zi);
     fe_tobytes(s, &y);
     u8 xb[32];
     fe_tobytes(xb, &x);
     s[31] |= (xb[0] & 1) << 7;
+}
+
+static void ge_tobytes(u8 s[32], const ge* p) {
+    fe zi;
+    fe_inv(&zi, &p->z);
+    ge_tobytes_with_zi(s, p, &zi);
+}
+
+// Batched point encoding with one shared inversion (Montgomery's trick):
+// the per-point fe_inv (~254 squarings) is the dominant cost of encoding
+// a fixed-base product on one core — prefix-product the Z's, invert the
+// total once, and peel per-point inverses back out (3 muls + 1/chunk of
+// an inversion per point).  Chunked so the working set stays in L1 and
+// OpenMP can split batches when cores exist.
+#define TOBYTES_CHUNK 256
+
+static void ge_tobytes_batch(u8* out, size_t stride, const ge* pts,
+                             size_t count) {
+#pragma omp parallel for schedule(static)
+    for (long c0 = 0; c0 < (long)count; c0 += TOBYTES_CHUNK) {
+        size_t n = count - (size_t)c0;
+        if (n > TOBYTES_CHUNK) n = TOBYTES_CHUNK;
+        const ge* p = pts + c0;
+        u8* o = out + stride * (size_t)c0;
+        fe pre[TOBYTES_CHUNK];  // pre[i] = z_0 * ... * z_i
+        pre[0] = p[0].z;
+        for (size_t i = 1; i < n; i++) fe_mul(&pre[i], &pre[i - 1], &p[i].z);
+        fe inv;
+        fe_inv(&inv, &pre[n - 1]);  // 1/(z_0 ... z_{n-1})
+        for (size_t i = n - 1; i > 0; i--) {
+            fe zi;
+            fe_mul(&zi, &inv, &pre[i - 1]);  // 1/z_i
+            ge_tobytes_with_zi(o + stride * i, &p[i], &zi);
+            fe_mul(&inv, &inv, &p[i].z);  // drop z_i from the pool
+        }
+        ge_tobytes_with_zi(o, &p[0], &inv);  // inv == 1/z_0
+    }
 }
 
 // RFC 8032 5.1.3 decode; returns 0 on invalid encodings.
@@ -561,20 +600,68 @@ int ba_ed25519_verify(const u8 pk[32], const u8* msg, size_t msg_len,
     return ge_eq(&sB, &rhs);
 }
 
+// Batch entry points are phased so every point encoding goes through the
+// shared-inversion path (ge_tobytes_batch): compute all the fixed-base
+// products first, then encode them together.  Per item that leaves
+// 32 window additions + hashes + scalar arithmetic — the inversion that
+// dominated the per-call path is amortized to ~nothing.
+
 void ba_ed25519_publickey_batch(const u8* sks, size_t count, u8* pks) {
     consts_init();
+    if (count == 0) return;
+    ge* A = (ge*)malloc(count * sizeof(ge));
+    if (!A) {  // degraded fallback: per-call path, no batch allocation
+        for (size_t i = 0; i < count; i++)
+            ba_ed25519_publickey(sks + 32 * i, pks + 32 * i);
+        return;
+    }
 #pragma omp parallel for schedule(static)
-    for (long i = 0; i < (long)count; i++)
-        ba_ed25519_publickey(sks + 32 * i, pks + 32 * i);
+    for (long i = 0; i < (long)count; i++) {
+        u8 h[64];
+        sha512_3(h, sks + 32 * i, 32, NULL, 0, NULL, 0);
+        h[0] &= 248; h[31] &= 63; h[31] |= 64;
+        ge_scalarmult_base(&A[i], h);
+    }
+    ge_tobytes_batch(pks, 32, A, count);
+    free(A);
 }
 
 void ba_ed25519_sign_batch(const u8* sks, const u8* pks, const u8* msgs,
                            size_t msg_len, size_t count, u8* sigs) {
     consts_init();
+    if (count == 0) return;
+    ge* R = (ge*)malloc(count * sizeof(ge));
+    u8* ra = (u8*)malloc(count * 64);  // r scalar + clamped a per item
+    if (!R || !ra) {
+        free(R); free(ra);
+        for (size_t i = 0; i < count; i++)
+            ba_ed25519_sign(sks + 32 * i, pks + 32 * i, msgs + msg_len * i,
+                            msg_len, sigs + 64 * i);
+        return;
+    }
 #pragma omp parallel for schedule(static)
-    for (long i = 0; i < (long)count; i++)
-        ba_ed25519_sign(sks + 32 * i, pks + 32 * i, msgs + msg_len * i,
-                        msg_len, sigs + 64 * i);
+    for (long i = 0; i < (long)count; i++) {
+        u8 h[64], nonce[64];
+        u8* r = ra + 64 * i;
+        u8* a = ra + 64 * i + 32;
+        sha512_3(h, sks + 32 * i, 32, NULL, 0, NULL, 0);
+        memcpy(a, h, 32);
+        a[0] &= 248; a[31] &= 63; a[31] |= 64;
+        sha512_3(nonce, h + 32, 32, msgs + msg_len * i, msg_len, NULL, 0);
+        sc_reduce64(r, nonce);
+        ge_scalarmult_base(&R[i], r);
+    }
+    ge_tobytes_batch(sigs, 64, R, count);  // R bytes -> sig[0:32]
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < (long)count; i++) {
+        u8 hram[64], k[32];
+        sha512_3(hram, sigs + 64 * i, 32, pks + 32 * i, 32,
+                 msgs + msg_len * i, msg_len);
+        sc_reduce64(k, hram);
+        sc_muladd(sigs + 64 * i + 32, k, ra + 64 * i + 32, ra + 64 * i);
+    }
+    free(R);
+    free(ra);
 }
 
 void ba_ed25519_verify_batch(const u8* pks, const u8* msgs, size_t msg_len,

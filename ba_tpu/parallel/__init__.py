@@ -23,7 +23,7 @@ a slice (its all_gathers want ICI bandwidth).
 """
 
 from ba_tpu.parallel.mesh import make_mesh
-from ba_tpu.parallel.multihost import init_distributed, make_global_mesh
+from ba_tpu.parallel.multihost import init_distributed, make_global_mesh, put_global
 from ba_tpu.parallel.sweep import failover_sweep, sharded_sweep, make_sweep_state
 from ba_tpu.parallel.node_parallel import om1_node_sharded
 from ba_tpu.parallel.eig_parallel import eig_node_sharded
@@ -33,6 +33,7 @@ __all__ = [
     "make_mesh",
     "init_distributed",
     "make_global_mesh",
+    "put_global",
     "failover_sweep",
     "sharded_sweep",
     "make_sweep_state",

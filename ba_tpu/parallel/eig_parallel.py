@@ -33,6 +33,12 @@ from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
 from ba_tpu.parallel.mesh import cached_jit
+from ba_tpu.parallel.multihost import put_global
+
+
+@jax.jit
+def _round1_jit(k_raw: jax.Array, state: SimState) -> jnp.ndarray:
+    return round1_broadcast(jr.wrap_key_data(k_raw), state)
 
 
 def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
@@ -46,9 +52,12 @@ def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
     n_node = mesh.shape["node"]
     assert n % n_node == 0, f"node axis {n_node} must divide n={n}"
     k1, key = jr.split(key)
-    received = round1_broadcast(k1, state)  # [B, n], node-replicated
+    # Round 1 under jit (not eager): with a multi-process mesh the state
+    # arrays are global, and only a traced computation may consume them.
+    received = _round1_jit(put_global(mesh, jr.key_data(k1), P()), state)
 
-    def shard_fn(key, order, leader, faulty, alive, rcv):
+    def shard_fn(key_raw, order, leader, faulty, alive, rcv):
+        key = jr.wrap_key_data(key_raw)
         node_idx = jax.lax.axis_index("node")
         data_idx = jax.lax.axis_index("data")
         b = order.shape[0]
@@ -140,8 +149,9 @@ def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
             ),
         ),
     )
+    key_raw = put_global(mesh, jr.key_data(key), P())
     maj, decision, needed, total, att, ret, und = fn(
-        key, state.order, state.leader, state.faulty, state.alive, received
+        key_raw, state.order, state.leader, state.faulty, state.alive, received
     )
     return {
         "majorities": maj,

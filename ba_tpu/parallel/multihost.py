@@ -25,7 +25,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ba_tpu.parallel.mesh import make_mesh
 
@@ -96,4 +96,28 @@ def make_global_mesh(
     return Mesh(arr, axis_names)
 
 
-__all__ = ["init_distributed", "make_global_mesh", "make_mesh"]
+def put_global(mesh: Mesh, x, spec: PartitionSpec) -> jax.Array:
+    """Host value -> one global array sharded as ``spec`` over ``mesh``.
+
+    The multi-process-safe ingestion path: every process passes the SAME
+    full value (numpy or local array) and contributes only its addressable
+    shards (``jax.make_array_from_callback``), so it works identically on
+    a single-process mesh and on a mesh spanning processes — where naive
+    ``device_put`` of a locally-committed array can fail.  This is the
+    framework's "scatter the membership roster to every node" step; the
+    reference ships the same information over per-peer RPC instead
+    (ba.py:86-102).
+
+    Single-process meshes take the plain ``device_put`` path: it stays
+    async and device-to-device, where the multi-process path's
+    ``np.asarray`` would drain device values through the host on every
+    call — a pure regression for the hot single-chip sweep.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
+__all__ = ["init_distributed", "make_global_mesh", "make_mesh", "put_global"]

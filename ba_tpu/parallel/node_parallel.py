@@ -30,6 +30,7 @@ from ba_tpu.core.quorum import quorum_decision, strict_majority
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
 from ba_tpu.parallel.mesh import cached_jit
+from ba_tpu.parallel.multihost import put_global
 
 
 def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
@@ -43,9 +44,10 @@ def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
     n_node = mesh.shape["node"]
     assert n % n_node == 0, f"node axis {n_node} must divide n={n}"
 
-    def shard_fn(key, order, leader, faulty, alive):
+    def shard_fn(key_raw, order, leader, faulty, alive):
         # Shapes in here are per-shard: order/leader [b], faulty/alive
         # [b, n] (replicated node axis), receivers i owned: n_local.
+        key = jr.wrap_key_data(key_raw)
         node_idx = jax.lax.axis_index("node")
         data_idx = jax.lax.axis_index("data")
         b = order.shape[0]
@@ -111,8 +113,11 @@ def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
             ),
         ),
     )
+    # Raw replicated key data crosses any mesh (incl. multi-process);
+    # re-wrapped inside the shard body.  Same mechanism in sm_/eig_parallel.
+    key_raw = put_global(mesh, jr.key_data(key), P())
     maj, decision, needed, total, att, ret, und = fn(
-        key, state.order, state.leader, state.faulty, state.alive
+        key_raw, state.order, state.leader, state.faulty, state.alive
     )
     return {
         "majorities": maj,

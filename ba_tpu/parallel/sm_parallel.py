@@ -40,6 +40,12 @@ from ba_tpu.core.rng import coin_bits, or_coin_threshold8, uniform_u8
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
 from ba_tpu.parallel.mesh import cached_jit
+from ba_tpu.parallel.multihost import put_global
+
+
+@jax.jit
+def _round1_jit(k_raw: jax.Array, state: SimState) -> jnp.ndarray:
+    return round1_broadcast(jr.wrap_key_data(k_raw), state)
 
 
 def sm_node_sharded(
@@ -72,14 +78,16 @@ def sm_node_sharded(
     if withhold is not None and collapsed:
         raise ValueError("collapsed relay cannot honor a withhold schedule")
     if received is None:
-        # Round 1 off-device-mesh: shared code path with sm_round, entering
-        # the shard_map node-replicated (O(B*n), not worth sharding).
+        # Round 1 under jit, node-replicated (O(B*n), not worth sharding):
+        # jit (not eager) so global multi-process state arrays are legal
+        # inputs — same mechanism as eig_parallel._round1_jit.
         k1, key = jr.split(key)
-        received = round1_broadcast(k1, state)
+        received = _round1_jit(put_global(mesh, jr.key_data(k1), P()), state)
     has_sig = sig_valid is not None
     has_withhold = withhold is not None
 
-    def shard_fn(key, order, leader, faulty, alive, rcv, *extra):
+    def shard_fn(key_raw, order, leader, faulty, alive, rcv, *extra):
+        key = jr.wrap_key_data(key_raw)
         node_idx = jax.lax.axis_index("node")
         data_idx = jax.lax.axis_index("data")
         b = order.shape[0]
@@ -121,7 +129,7 @@ def sm_node_sharded(
                 return (seen_l | incoming) & alive_l[..., None], None
 
             seen_l, _ = jax.lax.scan(
-                one_round, seen_l, jnp.arange(1, m + 1), unroll=True
+                one_round, seen_l, jnp.arange(1, m + 1), unroll=min(m, 4)
             )
         else:
             for r in range(1, m + 1):
@@ -191,7 +199,11 @@ def sm_node_sharded(
         )
 
     fn = cached_jit(("sm", mesh, n, m, collapsed, has_sig, has_withhold), build)
-    args = [key, state.order, state.leader, state.faulty, state.alive, received]
+    # The key rides in as raw uint32 data, globalized over the mesh, and is
+    # re-wrapped inside the shard body: a locally-committed typed key can't
+    # cross a multi-process mesh, raw replicated data can (put_global).
+    key_raw = put_global(mesh, jr.key_data(key), P())
+    args = [key_raw, state.order, state.leader, state.faulty, state.alive, received]
     if has_sig:
         args.append(sig_valid)
     if has_withhold:

@@ -21,6 +21,7 @@ from ba_tpu.core.eig import eig_round
 from ba_tpu.core.om import om1_round
 from ba_tpu.core.quorum import majority_counts, quorum_decision
 from ba_tpu.core.state import SimState
+from ba_tpu.parallel.multihost import put_global
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
 
 
@@ -166,18 +167,27 @@ def failover_sweep(
     }
 
 
+def _agreement_step_raw(keys_raw: jax.Array, state: SimState, m: int = 1):
+    """agreement_step with the per-instance keys as raw uint32 data."""
+    return agreement_step(jr.wrap_key_data(keys_raw), state, m=m)
+
+
 def sharded_sweep(mesh: Mesh, key: jax.Array, state: SimState, m: int = 1):
     """Run one agreement round per instance, instances sharded over ``mesh``.
 
     The state's batch axis is laid out on the mesh's "data" axis; every
     per-instance output stays sharded, and only the 3-bin decision histogram
-    is replicated (the lone collective).
+    is replicated (the lone collective).  Ingestion goes through
+    ``put_global`` (and the split keys ride as raw uint32 data, re-wrapped
+    under jit), so the same call works on a mesh spanning processes — the
+    multi-host sweep is literally this function on a
+    ``make_global_mesh()`` mesh (tests/test_multihost.py).
     """
     state = jax.tree.map(
-        lambda x: jax.device_put(
-            x, NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
-        ),
+        lambda x: put_global(mesh, x, P("data", *([None] * (x.ndim - 1)))),
         state,
     )
-    keys = jax.device_put(jr.split(key, state.batch), NamedSharding(mesh, P("data")))
-    return jax.jit(agreement_step, static_argnames="m")(keys, state, m=m)
+    keys_raw = put_global(
+        mesh, jr.key_data(jr.split(key, state.batch)), P("data", None)
+    )
+    return jax.jit(_agreement_step_raw, static_argnames="m")(keys_raw, state, m=m)

@@ -1,0 +1,129 @@
+"""Worker process for the two-process ``jax.distributed`` integration test.
+
+Launched by tests/test_multihost.py as ``python tests/multihost_worker.py
+<process_id> <num_processes> <coordinator_port> <out_json>``.  Each worker
+pins itself to the CPU platform with 4 virtual devices, joins the
+multi-process runtime through ``ba_tpu.parallel.multihost.init_distributed``
+(the framework analogue of the reference's join protocol, ba.py:86-102),
+builds the global (data, node) mesh — exercising ``make_global_mesh``'s
+multi-host branch, which a single process can never reach — and runs the
+node-sharded SM round plus the sharded sweep.  Process 0 writes the
+replicated/gathered results as JSON for the test to compare against the
+single-process 8-device run (both form a (4, 2) mesh, so every per-shard
+PRNG fold is identical and results must match bit-for-bit).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    port, out_path = sys.argv[3], sys.argv[4]
+
+    # Platform pinning must precede the first backend query; see
+    # ba_tpu/utils/platform.py for why this is in-process config, not env.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import jax.random as jr
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    from ba_tpu.parallel.multihost import (
+        init_distributed,
+        make_global_mesh,
+        put_global,
+    )
+
+    got = init_distributed(f"localhost:{port}", nproc, pid)
+    assert got == nproc, f"expected {nproc} processes, runtime says {got}"
+    assert jax.process_index() == pid
+
+    mesh = make_global_mesh(node_devices_per_host=2)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 2 * nproc,
+        "node": 2,
+    }
+
+    from ba_tpu.core import ATTACK, make_state
+    from ba_tpu.parallel.eig_parallel import eig_node_sharded
+    from ba_tpu.parallel.node_parallel import om1_node_sharded
+    from ba_tpu.parallel.sm_parallel import sm_node_sharded
+    from ba_tpu.parallel.sweep import make_sweep_state, sharded_sweep
+
+    # -- node-sharded SM(2), collapsed relay, pinned round-1 values --------
+    B, n = 16, 8
+    faulty = np.zeros((B, n), bool)
+    faulty[:, 3] = True
+    local_state = make_state(B, n, order=ATTACK, faulty=faulty)
+    state = jax.tree.map(
+        lambda x: put_global(mesh, x, P("data", *([None] * (x.ndim - 1)))),
+        local_state,
+    )
+    # Round 1 is pinned host-side: its eager path draws from a local typed
+    # key, which cannot cross a multi-process mesh.
+    received = np.full((B, n), int(ATTACK), np.int8)
+    out_sm = sm_node_sharded(
+        mesh,
+        jr.key(7),
+        state,
+        2,
+        received=put_global(mesh, received, P("data", None)),
+        collapsed=True,
+    )
+    dec_sm = np.asarray(
+        multihost_utils.process_allgather(out_sm["decision"], tiled=True)
+    )
+    # Default round-1 path (received=None): runs under jit so the global
+    # state arrays are legal inputs even on a multi-process mesh.
+    out_sm2 = sm_node_sharded(mesh, jr.key(10), state, 2, collapsed=True)
+    dec_sm2 = np.asarray(
+        multihost_utils.process_allgather(out_sm2["decision"], tiled=True)
+    )
+
+    # -- node-sharded OM(1) and EIG on the same global mesh ----------------
+    out_om = om1_node_sharded(mesh, jr.key(11), state)
+    dec_om = np.asarray(
+        multihost_utils.process_allgather(out_om["decision"], tiled=True)
+    )
+    out_eig = eig_node_sharded(mesh, jr.key(12), state, 2)
+    dec_eig = np.asarray(
+        multihost_utils.process_allgather(out_eig["decision"], tiled=True)
+    )
+
+    # -- sharded sweep over the global mesh --------------------------------
+    sweep_state = make_sweep_state(jr.key(8), 32, 16)
+    out_sw = sharded_sweep(mesh, jr.key(9), sweep_state)
+    hist = np.asarray(out_sw["histogram"])  # replicated output
+    dec_sw = np.asarray(
+        multihost_utils.process_allgather(out_sw["decision"], tiled=True)
+    )
+
+    if pid == 0:
+        with open(out_path, "w") as f:
+            json.dump(
+                {
+                    "sm_decision": dec_sm.tolist(),
+                    "sm_default_r1_decision": dec_sm2.tolist(),
+                    "om1_decision": dec_om.tolist(),
+                    "eig_decision": dec_eig.tolist(),
+                    "sweep_decision": dec_sw.tolist(),
+                    "sweep_histogram": hist.tolist(),
+                },
+                f,
+            )
+    multihost_utils.sync_global_devices("ba_tpu multihost worker done")
+
+
+if __name__ == "__main__":
+    main()

@@ -138,6 +138,22 @@ def test_verify_received_native_matches_jnp(monkeypatch):
     np.testing.assert_array_equal(got_native, ~corrupt)
 
 
+def test_sign_value_tables_match_order_message():
+    # The vectorized message-table encoder must stay byte-identical to the
+    # per-call order_message() contract (magic || u32 instance || value).
+    from ba_tpu.crypto.signed import (
+        commander_keys,
+        order_message,
+        sign_value_tables,
+    )
+
+    sks, pks = commander_keys(7, seed=1)
+    msgs, _ = sign_value_tables(sks, pks)
+    for b in (0, 3, 6):
+        for v in (0, 1):
+            assert msgs[b, v].tobytes() == order_message(b, v)
+
+
 def test_signed_host_paths_agree():
     # commander_keys / sign_value_tables must produce identical bytes
     # whichever host signer (native / cryptography / oracle) is active.
