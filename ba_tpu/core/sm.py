@@ -160,11 +160,13 @@ def sm_relay_rounds_collapsed(
         seen = (seen | incoming) & state.alive[..., None]
         return seen, None
 
-    # Bounded unroll: lets XLA fuse adjacent rounds (the m=3 sweep unrolls
-    # fully) without exploding compile time at m=32, where a full unroll
-    # inside an outer scan multiplied remote-compile time ~10x (r3).
+    # Unroll only short relays: the m<=4 sweep path fuses fully (XLA
+    # merges adjacent rounds' elementwise work), while large m keeps the
+    # rolled scan — at m=32 even a 4x partial unroll ballooned the remote
+    # Mosaic/XLA compile from ~1 min to >14 min (r3), and that config is
+    # sequential-latency-bound, so unrolling buys nothing there.
     seen, _ = jax.lax.scan(
-        one_round, seen, jnp.arange(1, m + 1), unroll=min(m, 4)
+        one_round, seen, jnp.arange(1, m + 1), unroll=m if m <= 4 else 1
     )
     return seen
 

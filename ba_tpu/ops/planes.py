@@ -128,3 +128,32 @@ def p_point_add(p: tuple, q: tuple) -> tuple:
     g = p_add(d, c)
     h = p_add(b, a)
     return (p_mul(e, f), p_mul(g, h), p_mul(f, g), p_mul(e, h))
+
+
+def p_point_dbl(p: tuple, with_t: bool = True) -> tuple:
+    """Dedicated doubling (dbl-2008-hwcd, a=-1): 7 muls, or 8 with T.
+
+    2P from (X : Y : Z : _): A = X^2, B = Y^2, C = 2Z^2, E = 2XY,
+    G = B - A, F = G - C, H = -(A + B); out (EF, GH, FG, EH).  The input
+    T is never read, so a doubling chain can skip computing T on every
+    step but the last (``with_t=False`` -> T planes are zeros; only the
+    step feeding a ``p_point_add`` needs the true T).  Identical group
+    element to ``p_point_add(p, p)`` in a different projective
+    representation (compare via point_eq, as with the window fold).
+
+    Bounds: E, H are single-lazy combinations of carried mul outputs
+    (within carry()'s documented multiply-safe envelope); G is carried
+    explicitly so F = G - C stays single-lazy too — a double-lazy operand
+    would push the schoolbook convolution past int32.
+    """
+    x, y, z, _ = p
+    a = p_mul(x, x)
+    b = p_mul(y, y)
+    c = p_mul2(p_mul(z, z))
+    e = p_mul2(p_mul(x, y))
+    g = p_carry(p_sub(b, a))
+    f = p_sub(g, c)
+    zero = a[0] * 0
+    h = p_sub([zero] * LIMBS, p_add(a, b))
+    t_planes = p_mul(e, h) if with_t else [zero] * LIMBS
+    return (p_mul(e, f), p_mul(g, h), p_mul(f, g), t_planes)

@@ -27,18 +27,12 @@ import jax.random as jr
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ba_tpu.core.eig import _in_path_mask
-from ba_tpu.core.om import round1_broadcast
 from ba_tpu.core.quorum import quorum_decision, strict_majority
 from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
 from ba_tpu.parallel.mesh import cached_jit
-from ba_tpu.parallel.multihost import put_global
-
-
-@jax.jit
-def _round1_jit(k_raw: jax.Array, state: SimState) -> jnp.ndarray:
-    return round1_broadcast(jr.wrap_key_data(k_raw), state)
+from ba_tpu.parallel.multihost import put_global, round1_jit
 
 
 def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
@@ -54,7 +48,7 @@ def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
     k1, key = jr.split(key)
     # Round 1 under jit (not eager): with a multi-process mesh the state
     # arrays are global, and only a traced computation may consume them.
-    received = _round1_jit(put_global(mesh, jr.key_data(k1), P()), state)
+    received = round1_jit(put_global(mesh, jr.key_data(k1), P()), state)
 
     def shard_fn(key_raw, order, leader, faulty, alive, rcv):
         key = jr.wrap_key_data(key_raw)

@@ -120,4 +120,27 @@ def put_global(mesh: Mesh, x, spec: PartitionSpec) -> jax.Array:
     return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
 
 
-__all__ = ["init_distributed", "make_global_mesh", "make_mesh", "put_global"]
+@jax.jit
+def round1_jit(k_raw: jax.Array, state):
+    """Round-1 broadcast under jit with a raw-uint32 key.
+
+    The node-sharded protocols share this instead of calling
+    ``round1_broadcast`` eagerly: on a multi-process mesh the state
+    arrays are global, and only a traced computation may consume them;
+    the key rides as replicated raw data (see ``put_global``) and is
+    re-wrapped inside the trace.
+    """
+    import jax.random as jr
+
+    from ba_tpu.core.om import round1_broadcast
+
+    return round1_broadcast(jr.wrap_key_data(k_raw), state)
+
+
+__all__ = [
+    "init_distributed",
+    "make_global_mesh",
+    "make_mesh",
+    "put_global",
+    "round1_jit",
+]

@@ -33,19 +33,13 @@ import jax.numpy as jnp
 import jax.random as jr
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ba_tpu.core.om import round1_broadcast
 from ba_tpu.core.quorum import quorum_decision
 from ba_tpu.core.sm import choice_from_seen
 from ba_tpu.core.rng import coin_bits, or_coin_threshold8, uniform_u8
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
 from ba_tpu.parallel.mesh import cached_jit
-from ba_tpu.parallel.multihost import put_global
-
-
-@jax.jit
-def _round1_jit(k_raw: jax.Array, state: SimState) -> jnp.ndarray:
-    return round1_broadcast(jr.wrap_key_data(k_raw), state)
+from ba_tpu.parallel.multihost import put_global, round1_jit
 
 
 def sm_node_sharded(
@@ -82,7 +76,7 @@ def sm_node_sharded(
         # jit (not eager) so global multi-process state arrays are legal
         # inputs — same mechanism as eig_parallel._round1_jit.
         k1, key = jr.split(key)
-        received = _round1_jit(put_global(mesh, jr.key_data(k1), P()), state)
+        received = round1_jit(put_global(mesh, jr.key_data(k1), P()), state)
     has_sig = sig_valid is not None
     has_withhold = withhold is not None
 
@@ -129,7 +123,8 @@ def sm_node_sharded(
                 return (seen_l | incoming) & alive_l[..., None], None
 
             seen_l, _ = jax.lax.scan(
-                one_round, seen_l, jnp.arange(1, m + 1), unroll=min(m, 4)
+                one_round, seen_l, jnp.arange(1, m + 1),
+                unroll=m if m <= 4 else 1,  # same policy as core/sm.py
             )
         else:
             for r in range(1, m + 1):

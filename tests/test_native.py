@@ -84,6 +84,30 @@ def test_batch_apis_match_scalar():
     assert not native.verify_batch(pks, msgs, bad).any()
 
 
+def test_batch_apis_multi_chunk():
+    # B=300 spans one full 256-point shared-inversion chunk plus a 44-point
+    # tail (ge_tobytes_batch's TOBYTES_CHUNK boundary — the index arithmetic
+    # most worth pinning); spot-check scalar equality on both sides of the
+    # boundary and at the tail end.
+    B = 300
+    sks = np.stack(
+        [
+            np.frombuffer(oracle.secret_from_seed(f"c:{i}".encode()), np.uint8)
+            for i in range(B)
+        ]
+    )
+    pks = native.publickey_batch(sks)
+    msgs = np.tile(np.arange(16, dtype=np.uint8), (B, 1))
+    msgs[:, 0] = np.arange(B) % 256
+    sigs = native.sign_batch(sks, pks, msgs)
+    for i in (0, 255, 256, 299):
+        assert pks[i].tobytes() == native.publickey(sks[i].tobytes())
+        assert sigs[i].tobytes() == native.sign(
+            sks[i].tobytes(), pks[i].tobytes(), msgs[i].tobytes()
+        )
+    assert native.verify_batch(pks, msgs, sigs).all()
+
+
 def test_rejection_edges():
     sk, pk = oracle.keypair(b"edge")
     msg = b"m" * 16

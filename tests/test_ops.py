@@ -67,6 +67,29 @@ def test_plane_point_add_matches_ed25519():
         )
 
 
+def test_plane_point_dbl_matches_point_add():
+    # The dedicated doubling must equal add(P, P) as a group element (the
+    # projective representation differs by design), with and without the
+    # T coordinate; the with_t=False T planes must be exactly zero.
+    B = 32
+    rng = np.random.default_rng(7)
+    bits = jnp.asarray(rng.integers(0, 2, (B, 16)), jnp.int32)
+    p = E.scalar_mult(E.base_point((B,)), bits)
+    ref = E.point_add(p, p)
+    got = planes.p_point_dbl(tuple(_unstack(c) for c in p))
+    got_pt = tuple(_stack(c) for c in got)
+    assert bool(jnp.all(E.point_eq(got_pt, ref)))
+    # T consistency: T == XY/Z  <=>  T * Z == X * Y.
+    x, y, z, t = got_pt
+    assert bool(jnp.all(F.eq(F.mul(t, z), F.mul(x, y))))
+    got_not = planes.p_point_dbl(tuple(_unstack(c) for c in p), with_t=False)
+    for g, g_t in zip(got[:3], got_not[:3]):
+        np.testing.assert_array_equal(
+            np.asarray(_stack(g)), np.asarray(_stack(g_t))
+        )
+    np.testing.assert_array_equal(np.asarray(_stack(got_not[3])), 0)
+
+
 # -- the ladder ---------------------------------------------------------------
 
 
