@@ -74,7 +74,7 @@ def sm_node_sharded(
     if received is None:
         # Round 1 under jit, node-replicated (O(B*n), not worth sharding):
         # jit (not eager) so global multi-process state arrays are legal
-        # inputs — same mechanism as eig_parallel._round1_jit.
+        # inputs (multihost.round1_jit, shared with eig_parallel).
         k1, key = jr.split(key)
         received = round1_jit(put_global(mesh, jr.key_data(k1), P()), state)
     has_sig = sig_valid is not None

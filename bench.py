@@ -18,7 +18,20 @@ Configs (BASELINE.md:31-36):
                        per-(instance, value) tables (2 signs/commander,
                        one-time setup); each timed round runs the whole
                        device pipeline — round-1 broadcast, signature-mask
-                       gather, 3 collapsed relay rounds, quorum.
+                       gather, 3 collapsed relay rounds, quorum.  Reports
+                       including-setup rates at stated horizons.
+
+Framework extensions beyond the 5 BASELINE configs:
+
+6. ``eig_n1024``     — the dense EIG tree at its single-chip frontier
+                       (n=1024, m=2; GiB-scale level tensors).
+7. ``interactive_b1``— per-round B=1 latency (median/p10/p90), the
+                       interactive REPL case the reference serves in
+                       ~0.2-0.3 s.
+
+``--stages`` replaces the config suite with a per-kernel breakdown of the
+verify pipeline plus the measured VPU int32-multiply peak (the roofline
+denominator).
 
 The primary metric stays config #1's rounds/s (continuity with round 1's
 BENCH json); every config's numbers ride in the same line under "configs",
