@@ -1,0 +1,68 @@
+"""Packed-coin RNG helpers (ba_tpu/core/rng.py).
+
+These back every fault coin in the framework (the vectorised analogue of
+the reference's per-call ``random.randint``, ba.py:44-49) and the collapsed
+relay's Bernoulli thresholds, so their distributional claims are pinned
+here exactly where the docstrings make them.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+import jax.random as jr
+
+from ba_tpu.core.rng import coin_bits, or_coin_threshold8, uniform_u8
+
+
+def test_coin_bits_shape_dtype_determinism():
+    a = coin_bits(jr.key(0), (7, 13), bool)
+    assert a.shape == (7, 13) and a.dtype == jnp.bool_
+    b = coin_bits(jr.key(0), (7, 13), bool)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = coin_bits(jr.key(1), (7, 13), bool)
+    assert (np.asarray(a) != np.asarray(c)).any()
+
+
+def test_coin_bits_fair():
+    coins = np.asarray(coin_bits(jr.key(2), (1 << 20,), jnp.int32))
+    assert set(np.unique(coins)) <= {0, 1}
+    # 4-sigma band for a fair coin at 2^20 draws: 0.5 +- 0.002.
+    assert abs(coins.mean() - 0.5) < 0.002
+
+
+def test_uniform_u8_range_and_uniformity():
+    u = np.asarray(uniform_u8(jr.key(3), (1 << 20,)))
+    assert u.dtype == np.int32
+    assert u.min() >= 0 and u.max() <= 255
+    counts = np.bincount(u, minlength=256)
+    # Each byte value: n*p = 4096 expected, sigma ~ 64; allow 6 sigma.
+    assert (np.abs(counts - 4096) < 6 * 64).all()
+
+
+def test_or_threshold8_exact_small_k():
+    k = jnp.arange(0, 12)
+    t = np.asarray(or_coin_threshold8(k, jnp.ones_like(k, bool)))
+    for kk in range(9):  # exact in 256ths for k <= 8
+        assert t[kk] == 256 - (256 >> kk), (kk, t[kk])
+        assert t[kk] / 256 == 1.0 - 2.0 ** -kk
+    assert (t[9:] == 256).all()  # saturation: fire always, error 2^-k
+
+
+def test_or_threshold8_gate_and_large_k():
+    k = jnp.asarray([0, 1, 5, 40, 1000])  # large k must not hit shift UB
+    gated = np.asarray(or_coin_threshold8(k, jnp.zeros_like(k, bool)))
+    assert (gated == 0).all()
+    open_ = np.asarray(or_coin_threshold8(k, jnp.ones_like(k, bool)))
+    assert open_[0] == 0 and (open_[3:] == 256).all()
+
+
+def test_threshold_draw_realizes_bernoulli():
+    # End-to-end: P(uniform_u8 < T(k)) ~ 1 - 2^-k within binomial noise.
+    n = 1 << 18
+    for kk in (1, 3, 8):
+        t = int(or_coin_threshold8(jnp.asarray(kk), jnp.asarray(True)))
+        u = np.asarray(uniform_u8(jr.fold_in(jr.key(4), kk), (n,)))
+        p_hat = (u < t).mean()
+        p = 1 - 2.0 ** -kk
+        sigma = (p * (1 - p) / n) ** 0.5
+        assert abs(p_hat - p) < 6 * max(sigma, 1e-4), (kk, p_hat, p)
