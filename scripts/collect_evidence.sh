@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Collect the round's TPU evidence artifacts in one sequential pass.
+#
+# Produces (in the repo root):
+#   BENCH_local_r{N}.json  - full bench suite (all configs, one JSON line)
+#   STAGES_r{N}.json       - per-kernel verify-pipeline breakdown + VPU peak
+#   TESTS_TPU_r{N}.txt     - the TPU-gated Mosaic-kernel test transcript
+#   LATENCY_r{N}.jsonl     - REPL metrics incl. per-round round_elapsed_s
+#
+# Run it ALONE: nothing else may touch the TPU while it runs (a second
+# default-backend process blocks on the chip lease and can wedge both).
+set -u
+N="${1:?usage: collect_evidence.sh <round number, e.g. 3>}"
+cd "$(dirname "$0")/.."
+
+echo "== [1/4] bench suite"
+python bench.py > "BENCH_local_r${N}.json" 2> "/tmp/bench_r${N}.err"
+echo "   exit $? ($(date))"
+
+echo "== [2/4] stage breakdown"
+python bench.py --stages > "STAGES_r${N}.json" 2> "/tmp/stages_r${N}.err"
+echo "   exit $? ($(date))"
+
+echo "== [3/4] TPU-gated kernel tests"
+BA_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_ops.py -q \
+    > "TESTS_TPU_r${N}.txt" 2>&1
+echo "   exit $? ($(date))"
+
+echo "== [4/4] interactive REPL latency (metrics sink)"
+printf 'actual-order attack\nactual-order retreat\nactual-order attack\nExit\n' \
+    | BA_TPU_METRICS="LATENCY_r${N}.jsonl" ./Generals_Byzantine_program.sh 4 \
+    > "/tmp/repl_r${N}.out" 2>&1
+echo "   exit $? ($(date))"
+
+echo "done; artifacts: BENCH_local_r${N}.json STAGES_r${N}.json TESTS_TPU_r${N}.txt LATENCY_r${N}.jsonl"
