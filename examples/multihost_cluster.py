@@ -1,0 +1,78 @@
+"""Scaling past one host: the join protocol and the global (data, node) mesh.
+
+The reference joins a new general by dialing every known peer for the
+leader's port (discover_leader, ba.py:86-102); its transport tops out at
+one OS process of threads.  This framework's join is
+``init_distributed()`` (every process dials the coordinator) followed by
+``make_global_mesh()`` — "data" (independent instances) spans hosts over
+DCN, "node" (generals of one big cluster) stays inside a slice on ICI —
+and the SAME shard_map programs run unchanged on the bigger mesh.
+
+Single-process this degenerates to the local-device mesh, so the example
+runs anywhere; launch it once per process with BA_TPU_COORD/NPROCS/PROCID
+set to see the true multi-process path (tests/test_multihost.py drives
+that form with two OS processes over gloo and checks bit-identical
+decisions).
+
+    python examples/multihost_cluster.py
+"""
+
+import os
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    from ba_tpu.utils.platform import select_example_platform
+
+    select_example_platform(8)
+    import jax
+    import jax.random as jr
+
+    from ba_tpu.parallel import (
+        init_distributed,
+        make_global_mesh,
+        sm_node_sharded,
+        sharded_sweep,
+        make_sweep_state,
+    )
+
+    # The join: a no-op single-process, jax.distributed across hosts.
+    nproc = init_distributed(
+        os.environ.get("BA_TPU_COORD"),
+        int(os.environ.get("BA_TPU_NPROCS", "1")),
+        int(os.environ.get("BA_TPU_PROCID", "0")),
+    )
+    n_dev = len(jax.devices())
+    node = 2 if n_dev % 2 == 0 else 1
+    mesh = make_global_mesh(node_devices_per_host=node)
+    print(f"processes={nproc} devices={n_dev} "
+          f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    # One big signed cluster, generals sharded over "node".
+    from ba_tpu.core import ATTACK, make_state
+
+    B, n = 64, 128
+    state = make_state(B, n, order=ATTACK)
+    out = sm_node_sharded(mesh, jr.key(0), state, m=2)
+    maj = np.asarray(out["majorities"])
+    assert (maj == ATTACK).all()
+    print(f"node-sharded SM(2): n={n} generals agree on attack "
+          f"(needed {int(np.asarray(out['needed'])[0])} of "
+          f"{int(np.asarray(out['total'])[0])})")
+
+    # A fault-pattern sweep, instances sharded over "data".
+    sweep = make_sweep_state(jr.key(1), 4096, 32)
+    res = sharded_sweep(mesh, jr.key(2), sweep)
+    hist = np.asarray(res["histogram"])
+    assert hist.sum() == 4096
+    print(f"sharded sweep: 4096 instances -> "
+          f"retreat/attack/undefined = {hist.tolist()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,23 +13,37 @@ set -u
 N="${1:?usage: collect_evidence.sh <round number, e.g. 3>}"
 cd "$(dirname "$0")/.."
 
-echo "== [1/4] bench suite"
-python bench.py > "BENCH_local_r${N}.json" 2> "/tmp/bench_r${N}.err"
-echo "   exit $? ($(date))"
+MANIFEST="EVIDENCE_r${N}.manifest"
+: > "$MANIFEST"
+fail=0
+step() {  # step <name> <artifact> -- cmd...
+    local name="$1" artifact="$2"; shift 2; shift  # drop '--'
+    echo "== $name"
+    "$@"
+    local rc=$?
+    echo "$name exit=$rc artifact=$artifact $(date -u +%FT%TZ)" >> "$MANIFEST"
+    echo "   exit $rc ($(date))"
+    [ "$rc" -ne 0 ] && fail=1
+}
 
-echo "== [2/4] stage breakdown"
-python bench.py --stages > "STAGES_r${N}.json" 2> "/tmp/stages_r${N}.err"
-echo "   exit $? ($(date))"
+step "bench" "BENCH_local_r${N}.json" -- \
+    bash -c "python bench.py > 'BENCH_local_r${N}.json' 2> '/tmp/bench_r${N}.err'"
 
-echo "== [3/4] TPU-gated kernel tests"
-BA_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_ops.py -q \
-    > "TESTS_TPU_r${N}.txt" 2>&1
-echo "   exit $? ($(date))"
+step "stages" "STAGES_r${N}.json" -- \
+    bash -c "python bench.py --stages > 'STAGES_r${N}.json' 2> '/tmp/stages_r${N}.err'"
 
-echo "== [4/4] interactive REPL latency (metrics sink)"
-printf 'actual-order attack\nactual-order retreat\nactual-order attack\nExit\n' \
-    | BA_TPU_METRICS="LATENCY_r${N}.jsonl" ./Generals_Byzantine_program.sh 4 \
-    > "/tmp/repl_r${N}.out" 2>&1
-echo "   exit $? ($(date))"
+step "tpu-tests" "TESTS_TPU_r${N}.txt" -- \
+    bash -c "BA_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_ops.py -q \
+             > 'TESTS_TPU_r${N}.txt' 2>&1"
 
-echo "done; artifacts: BENCH_local_r${N}.json STAGES_r${N}.json TESTS_TPU_r${N}.txt LATENCY_r${N}.jsonl"
+# The metrics sink appends; start the latency artifact fresh so reruns
+# never mix stale rounds in.
+rm -f "LATENCY_r${N}.jsonl"
+step "repl-latency" "LATENCY_r${N}.jsonl" -- \
+    bash -c "printf 'actual-order attack\nactual-order retreat\nactual-order attack\nExit\n' \
+             | BA_TPU_METRICS='LATENCY_r${N}.jsonl' ./Generals_Byzantine_program.sh 4 \
+             > '/tmp/repl_r${N}.out' 2>&1"
+
+echo "done (fail=$fail); manifest:"
+cat "$MANIFEST"
+exit "$fail"
