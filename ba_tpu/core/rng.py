@@ -19,12 +19,31 @@ oracle draws from Python's ``random`` by design.
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 import jax.random as jr
 
 from ba_tpu.core.types import COMMAND_DTYPE
+
+
+def make_key(seed: int) -> jax.Array:
+    """Typed PRNG key honoring the ``BA_TPU_RNG`` impl knob.
+
+    ``BA_TPU_RNG=rbg`` swaps the *bit-generation* substrate to XLA's
+    ``RngBitGenerator`` — the TPU's hardware-accelerated generator — while
+    key derivation (``split``/``fold_in``) stays threefry-strength (that is
+    jax's "rbg" impl contract; "unsafe_rbg" would weaken derivation too and
+    is deliberately not offered).  The fault-coin streams this feeds are
+    simulation randomness, not cryptography: every protocol property test
+    is outcome-distribution-based, so the only requirement is iid uniform
+    bits, which RngBitGenerator provides.  Default remains threefry2x32 —
+    fully deterministic across backends — so differential tests and
+    recorded artifacts stay reproducible; benches opt in for throughput.
+    """
+    impl = os.environ.get("BA_TPU_RNG", "threefry2x32")
+    return jr.key(seed, impl=impl)
 
 
 def uniform_u8(key: jax.Array, shape) -> jnp.ndarray:

@@ -58,6 +58,16 @@ REFERENCE_ROUNDS_PER_SEC = 10.0  # 0.1 s poll floor, ba.py:287-301
 HBM_PEAK_GBPS = float(os.environ.get("BA_TPU_HBM_PEAK_GBPS", 1200.0))  # v4 chip
 
 
+def make_key(seed: int):
+    """Bench PRNG keys honor the BA_TPU_RNG impl knob (core.rng.make_key):
+    rbg = TPU hardware RngBitGenerator for coin draws, threefry derivation.
+    Lazy import so bench's platform selection still happens before jax init.
+    """
+    from ba_tpu.core.rng import make_key as _mk
+
+    return _mk(seed)
+
+
 def _timed(fn, make_args, iters, reps=3):
     """Compile/warm on iteration 0, then time ``iters`` dispatches.
 
@@ -100,7 +110,7 @@ def bench_om1_n4(jax, jnp, jr):
         out = om1_agreement(key, state)
         return out["decision"].astype(jnp.int32).sum(), out["needed"].sum()
 
-    key = jr.key(0)
+    key = make_key(0)
     iters = 30
     elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
     bytes_round = batch * (2 * n * n + 5 * n)  # answer+coin cubes, int8 rows
@@ -128,7 +138,7 @@ def bench_om3_n10(jax, jnp, jr):
         out = eig_agreement(key, state, m)
         return out["decision"].astype(jnp.int32).sum(), out["needed"].sum()
 
-    key = jr.key(1)
+    key = make_key(1)
     iters = 20
     elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
     # EIG levels 1..m: n^l cells per general, touched ~3x (coins, send
@@ -206,7 +216,7 @@ def bench_sm1_n64_signed(jax, jnp, jr):
         out = sm_agreement(key, state, m, None, sig_valid, None, False)
         return out["decision"].astype(jnp.int32).sum()
 
-    key = jr.key(3)
+    key = make_key(3)
     iters = 20
     elapsed = _timed(
         step, lambda i: (jr.fold_in(key, i), state, sig_valid), iters
@@ -254,7 +264,7 @@ def bench_eig_n1024(jax, jnp, jr):
         out = eig_agreement(key, state, m)
         return out["decision"].astype(jnp.int32).sum(), out["needed"].sum()
 
-    key = jr.key(8)
+    key = make_key(8)
     iters = 5
     elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
     cells = sum(n ** l for l in range(1, m + 1))
@@ -288,7 +298,7 @@ def bench_n1024_m32(jax, jnp, jr):
         acc, _ = jax.lax.scan(one, jnp.int32(0), jr.split(key, inner))
         return acc
 
-    key = jr.key(4)
+    key = make_key(4)
     iters = 5
     elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
     bytes_round = m * n * 2 * 3  # per relay round: packed-u8 draws + seen bools
@@ -315,7 +325,7 @@ def bench_sweep10k_signed(jax, jnp, jr):
 
     batch = int(os.environ.get("BA_TPU_BENCH_SWEEP_BATCH", 10240))
     cap, m = 1024, 3
-    state = make_sweep_state(jr.key(5), batch, cap)
+    state = make_sweep_state(make_key(5), batch, cap)
 
     # One-time setup, off the clock: per-instance keys, 2 signs each, and
     # one device verify of each distinct signature ([B, 2] tables).
@@ -350,7 +360,7 @@ def bench_sweep10k_signed(jax, jnp, jr):
         out = sm_agreement(k2, state, m, None, sig_valid, received, True)
         return out["decision"].astype(jnp.int32).sum()
 
-    key = jr.key(6)
+    key = make_key(6)
     iters = 50
     elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state, ok), iters)
     # Per round: m packed-u8 draw cubes [B, cap, 2] + seen/broadcast rows.
@@ -413,7 +423,7 @@ def bench_interactive_b1(jax, jnp, jr):
         out = om1_agreement(key, state)
         return out["decision"].astype(jnp.int32).sum(), out["needed"].sum()
 
-    key = jr.key(9)
+    key = make_key(9)
     jax.device_get(step(key, state))  # compile off the clock
     times = []
     for i in range(1, 21):
@@ -450,7 +460,7 @@ def bench_vpu_int32_peak(jax, jnp, jr):
         out = jax.lax.fori_loop(0, depth, body, x)
         return out.astype(jnp.int32).sum()
 
-    key = jr.key(7)
+    key = make_key(7)
     iters = 10
     elapsed = _timed(
         f, lambda i: (jr.randint(jr.fold_in(key, i), (lanes,), 0, 1 << 30,

@@ -7,11 +7,12 @@ here exactly where the docstrings make them.
 """
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 import jax.random as jr
 
-from ba_tpu.core.rng import coin_bits, or_coin_threshold8, uniform_u8
+from ba_tpu.core.rng import coin_bits, make_key, or_coin_threshold8, uniform_u8
 
 
 def test_coin_bits_shape_dtype_determinism():
@@ -54,6 +55,41 @@ def test_or_threshold8_gate_and_large_k():
     assert (gated == 0).all()
     open_ = np.asarray(or_coin_threshold8(k, jnp.ones_like(k, bool)))
     assert open_[0] == 0 and (open_[3:] == 256).all()
+
+
+def test_make_key_default_is_threefry(monkeypatch):
+    # Default impl must stay threefry2x32: recorded artifacts and the
+    # differential tests depend on cross-backend-deterministic streams.
+    monkeypatch.delenv("BA_TPU_RNG", raising=False)
+    a = np.asarray(jr.key_data(make_key(7)))
+    b = np.asarray(jr.key_data(jr.key(7)))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_make_key_rbg_draws_are_uniform(monkeypatch):
+    # The BA_TPU_RNG=rbg bench knob: every packed-draw helper must keep its
+    # distributional contract on the RngBitGenerator substrate too.
+    monkeypatch.setenv("BA_TPU_RNG", "rbg")
+    key = make_key(11)
+    coins = np.asarray(coin_bits(key, (1 << 20,), jnp.int32))
+    assert set(np.unique(coins)) <= {0, 1}
+    assert abs(coins.mean() - 0.5) < 0.002  # 4 sigma at 2^20
+    u = np.asarray(uniform_u8(jr.fold_in(key, 1), (1 << 20,)))
+    assert u.min() >= 0 and u.max() <= 255
+    counts = np.bincount(u, minlength=256)
+    assert (np.abs(counts - 4096) < 6 * 64).all()
+    # fold_in/split derivation stays usable (and distinct) on rbg keys.
+    k1, k2 = jr.split(key)
+    assert (
+        np.asarray(coin_bits(k1, (128,), jnp.int32))
+        != np.asarray(coin_bits(k2, (128,), jnp.int32))
+    ).any()
+
+
+def test_make_key_rejects_unknown_impl(monkeypatch):
+    monkeypatch.setenv("BA_TPU_RNG", "definitely-not-an-impl")
+    with pytest.raises(Exception):
+        make_key(0)
 
 
 def test_threshold_draw_realizes_bernoulli():
