@@ -42,8 +42,20 @@ def make_key(seed: int) -> jax.Array:
     fully deterministic across backends — so differential tests and
     recorded artifacts stay reproducible; benches opt in for throughput.
     """
-    impl = os.environ.get("BA_TPU_RNG", "threefry2x32")
+    impl = rng_impl()
     return jr.key(seed, impl=impl)
+
+
+def rng_impl() -> str:
+    """The resolved BA_TPU_RNG impl name (single source of truth for
+    reporting in bench artifacts).  Allowlisted: anything else — including
+    jax's "unsafe_rbg", which weakens key derivation — is rejected."""
+    impl = os.environ.get("BA_TPU_RNG", "threefry2x32")
+    if impl not in ("threefry2x32", "rbg"):
+        raise ValueError(
+            f"BA_TPU_RNG={impl!r} not supported; use 'threefry2x32' or 'rbg'"
+        )
+    return impl
 
 
 def uniform_u8(key: jax.Array, shape) -> jnp.ndarray:

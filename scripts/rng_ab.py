@@ -1,0 +1,69 @@
+"""A/B the sweep's RNG substrate on the real chip: threefry vs rbg.
+
+The north-star sweep is VPU-bound with packed-u8 threefry draws as a major
+term (BENCH_r02 "bound"); jax's "rbg" impl swaps ``jr.bits`` to XLA's
+RngBitGenerator — the TPU's hardware generator — while keeping threefry
+key derivation.  This script times the exact bench step (round-1 broadcast
+-> signature gather -> collapsed relay -> quorum) under both impls and
+prints one JSON line; it informs whether BENCH recommends BA_TPU_RNG=rbg.
+
+Run ALONE (one TPU chip, one claim — see .claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ba_tpu.core import sm_agreement
+    from ba_tpu.core.om import round1_broadcast
+    from ba_tpu.crypto.signed import sig_valid_from_tables
+    from ba_tpu.parallel import make_sweep_state
+
+    batch, cap, m = 10240, 1024, 3
+    iters, reps = 50, 3
+    state = make_sweep_state(jr.key(5), batch, cap)
+    ok = jnp.ones((batch, 2), bool)  # table-verify mask; content irrelevant here
+
+    @jax.jit
+    def step(key, state, ok):
+        k1, k2 = jr.split(key)
+        received = round1_broadcast(k1, state)
+        sig_valid = sig_valid_from_tables(ok, received)
+        out = sm_agreement(k2, state, m, None, sig_valid, received, True)
+        return out["decision"].astype(jnp.int32).sum()
+
+    results = {}
+    for impl in ("threefry2x32", "rbg"):
+        key = jr.key(6, impl=impl)
+        jax.device_get(step(jr.fold_in(key, 0), state, ok))  # compile+warm
+        best = float("inf")
+        for r in range(reps):
+            t0 = time.perf_counter()
+            res = None
+            for i in range(1, iters + 1):
+                res = step(jr.fold_in(key, r * iters + i), state, ok)
+            jax.device_get(res)
+            best = min(best, time.perf_counter() - t0)
+        results[impl] = {
+            "elapsed_s": round(best, 4),
+            "rounds_per_sec": round(batch * iters / best, 1),
+        }
+        print(f"{impl}: {results[impl]}", file=sys.stderr, flush=True)
+
+    results["speedup_rbg"] = round(
+        results["threefry2x32"]["elapsed_s"] / results["rbg"]["elapsed_s"], 3
+    )
+    print(json.dumps({"metric": "sweep-rng-ab", "batch": batch, "n_max": cap,
+                      "m": m, "iters": iters, **results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -88,7 +88,12 @@ def test_make_key_rbg_draws_are_uniform(monkeypatch):
 
 def test_make_key_rejects_unknown_impl(monkeypatch):
     monkeypatch.setenv("BA_TPU_RNG", "definitely-not-an-impl")
-    with pytest.raises(Exception):
+    with pytest.raises(ValueError):
+        make_key(0)
+    # unsafe_rbg weakens split/fold_in derivation; the allowlist keeps the
+    # docstring's "deliberately not offered" contract honest.
+    monkeypatch.setenv("BA_TPU_RNG", "unsafe_rbg")
+    with pytest.raises(ValueError):
         make_key(0)
 
 
