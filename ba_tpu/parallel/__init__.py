@@ -24,7 +24,12 @@ a slice (its all_gathers want ICI bandwidth).
 
 from ba_tpu.parallel.mesh import make_mesh
 from ba_tpu.parallel.multihost import init_distributed, make_global_mesh, put_global
-from ba_tpu.parallel.sweep import failover_sweep, sharded_sweep, make_sweep_state
+from ba_tpu.parallel.sweep import (
+    bucketed_sweep_states,
+    failover_sweep,
+    make_sweep_state,
+    sharded_sweep,
+)
 from ba_tpu.parallel.node_parallel import om1_node_sharded
 from ba_tpu.parallel.eig_parallel import eig_node_sharded
 from ba_tpu.parallel.sm_parallel import sm_node_sharded
@@ -37,6 +42,7 @@ __all__ = [
     "failover_sweep",
     "sharded_sweep",
     "make_sweep_state",
+    "bucketed_sweep_states",
     "om1_node_sharded",
     "eig_node_sharded",
     "sm_node_sharded",

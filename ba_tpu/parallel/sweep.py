@@ -31,12 +31,14 @@ def make_sweep_state(
     capacity: int,
     *,
     min_n: int | None = None,
+    max_n: int | None = None,
     max_traitor_frac: float = 1 / 3,
     order=ATTACK,
 ) -> SimState:
     """Sample a batch of random (n, fault-pattern) cluster configurations.
 
-    Per instance: cluster size n uniform in [min_n, capacity] (alive = the
+    Per instance: cluster size n uniform in [min_n, max_n (default:
+    capacity)] (alive = the
     first n slots, mirroring ascending spawn order ba.py:344-351), then an
     independent traitor count in [0, floor(n * max_traitor_frac)] assigned to
     uniformly-random lieutenants.  The leader (slot 0) stays honest so that
@@ -50,9 +52,14 @@ def make_sweep_state(
     """
     if min_n is None:
         min_n = min(4, capacity)
+    if max_n is None:
+        max_n = capacity
+    if not min_n <= max_n <= capacity:
+        raise ValueError(f"need min_n <= max_n <= capacity, got "
+                         f"{min_n}/{max_n}/{capacity}")
     k_n, k_m, k_perm = jr.split(key, 3)
     idx = jnp.arange(capacity)[None, :]
-    n = jr.randint(k_n, (batch,), min_n, capacity + 1)
+    n = jr.randint(k_n, (batch,), min_n, max_n + 1)
     alive = idx < n[:, None]
     max_traitors = (n * max_traitor_frac).astype(jnp.int32)
     n_traitors = jr.randint(k_m, (batch,), 0, max_traitors + 1)
@@ -71,6 +78,65 @@ def make_sweep_state(
             jnp.arange(1, capacity + 1, dtype=jnp.int32), (batch, capacity)
         ),
     )
+
+
+def bucketed_sweep_states(
+    key: jax.Array,
+    batch: int,
+    capacity: int,
+    n_buckets: int = 2,
+    *,
+    min_n: int = 4,
+    max_traitor_frac: float = 1 / 3,
+    order=ATTACK,
+) -> list[SimState]:
+    """Equal-count, equal-width cluster-size buckets: ragged batching.
+
+    ``make_sweep_state`` pads every instance to ``capacity``, so a sweep
+    whose sizes are uniform on [min_n, capacity] burns ~half its lanes on
+    dead padding (mean n ~ capacity/2 — the relay's elementwise cost
+    scales with the PADDED width).  Splitting the size range into
+    ``n_buckets`` equal-width sub-ranges, each padded only to its own
+    upper edge, cuts the mean padded width to ~3/4 (2 buckets) or ~5/8
+    (4 buckets) of ``capacity`` with zero change to the sampled
+    distribution: equal instance counts x equal-width uniform sub-ranges
+    compose to the same uniform mixture over [min_n, capacity] (up to the
+    integer edge where ranges abut).  Remainder instances go to the last
+    (widest) bucket, biasing toward MORE work, never less.
+
+    Returns one SimState per bucket (padded widths capacity/n_buckets *
+    (k+1), rounded up to a multiple of 128 so the lane axis stays
+    TPU-tile-aligned); consensus semantics are unchanged — each bucket is
+    just a smaller independent sweep, so decisions compose by
+    concatenation and histograms by summation.
+    """
+    if n_buckets < 1 or n_buckets > capacity:
+        raise ValueError(f"n_buckets={n_buckets} out of range")
+    if capacity // n_buckets < min_n:
+        raise ValueError(
+            f"capacity/n_buckets = {capacity}/{n_buckets} puts the first "
+            f"bucket's upper edge below min_n={min_n}; use fewer buckets"
+        )
+    per = batch // n_buckets
+    states = []
+    lo = min_n
+    for k in range(n_buckets):
+        hi = capacity * (k + 1) // n_buckets
+        cap_k = -(-hi // 128) * 128 if hi >= 128 else hi
+        bk = per if k < n_buckets - 1 else batch - per * (n_buckets - 1)
+        states.append(
+            make_sweep_state(
+                jr.fold_in(key, k),
+                bk,
+                min(cap_k, capacity),
+                min_n=lo,
+                max_n=hi,
+                max_traitor_frac=max_traitor_frac,
+                order=order,
+            )
+        )
+        lo = hi + 1
+    return states
 
 
 def decision_histogram(decision: jnp.ndarray) -> jnp.ndarray:

@@ -8,6 +8,7 @@ import pytest
 
 from ba_tpu.core import ATTACK, RETREAT, make_state
 from ba_tpu.parallel import (
+    bucketed_sweep_states,
     make_mesh,
     make_sweep_state,
     om1_node_sharded,
@@ -35,6 +36,44 @@ def test_sweep_state_shapes():
     assert not f[:, 0].any()
     assert (f & ~np.asarray(state.alive)).sum() == 0
     assert (f.sum(-1) <= n_alive // 3).all()
+
+
+def test_bucketed_sweep_states_partition():
+    # 2 buckets over capacity 1024: sizes in [4,512] pad to 512, sizes in
+    # [513,1024] pad to 1024; instance counts split evenly; remainder goes
+    # to the last (widest) bucket.
+    states = bucketed_sweep_states(jr.key(1), 1001, 1024, 2)
+    assert [s.faulty.shape for s in states] == [(500, 512), (501, 1024)]
+    n0 = np.asarray(states[0].alive).sum(-1)
+    n1 = np.asarray(states[1].alive).sum(-1)
+    assert (n0 >= 4).all() and (n0 <= 512).all()
+    assert (n1 >= 513).all() and (n1 <= 1024).all()
+    # Sweep-state invariants hold per bucket (honest leader, traitor cap).
+    for s, n in ((states[0], n0), (states[1], n1)):
+        f = np.asarray(s.faulty)
+        assert not f[:, 0].any()
+        assert (f.sum(-1) <= n // 3).all()
+
+
+def test_bucketed_sweep_one_bucket_matches_flat():
+    # n_buckets=1 degenerates to a single make_sweep_state-shaped batch.
+    (state,) = bucketed_sweep_states(jr.key(2), 64, 16, 1)
+    assert state.faulty.shape == (64, 16)
+    n_alive = np.asarray(state.alive).sum(-1)
+    assert (n_alive >= 4).all() and (n_alive <= 16).all()
+
+
+def test_bucketed_sweep_decisions_compose():
+    # Each bucket is an independent sweep: with an honest leader every
+    # instance must decide the ordered value regardless of padding width.
+    from ba_tpu.core import sm_agreement
+
+    states = bucketed_sweep_states(jr.key(3), 96, 256, 2, order=ATTACK)
+    for i, st in enumerate(states):
+        out = jax.jit(
+            lambda k, s: sm_agreement(k, s, 3, collapsed=True)
+        )(jr.fold_in(jr.key(4), i), st)
+        assert (np.asarray(out["decision"]) == ATTACK).all()
 
 
 def test_sharded_sweep_all_decide_order(mesh8):
