@@ -251,7 +251,10 @@ def bench_sm1_n64_signed(jax, jnp, jr):
         "pct_of_measured_peak": round(
             100 * gmults / peak["measured_gmults_per_sec"], 1
         ),
-        "bound": "compute (int32 limb multiplies on VPU)",
+        "bound": "compute (int32 limb multiplies; >100% of the VPU-only "
+                 "peak is possible because the int8 table-gather einsums "
+                 "and conv matmuls carry part of the multiply work on the "
+                 "MXU — the est counts them as if they were VPU lanes)",
     }
 
 
