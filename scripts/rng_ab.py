@@ -13,8 +13,11 @@ Run ALONE (one TPU chip, one claim — see .claude/skills/verify/SKILL.md).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
