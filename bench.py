@@ -326,8 +326,6 @@ def bench_n1024_m32(jax, jnp, jr):
 
 
 def bench_sweep10k_signed(jax, jnp, jr):
-    import numpy as np
-
     from ba_tpu.core import sm_agreement
     from ba_tpu.crypto.signed import (
         commander_keys,

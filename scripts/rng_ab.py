@@ -15,7 +15,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -25,13 +24,14 @@ def main() -> None:
     import jax.numpy as jnp
     import jax.random as jr
 
+    from bench import _timed  # one source of truth for the tunnel-safe timing
     from ba_tpu.core import sm_agreement
     from ba_tpu.core.om import round1_broadcast
     from ba_tpu.crypto.signed import sig_valid_from_tables
     from ba_tpu.parallel import make_sweep_state
 
     batch, cap, m = 10240, 1024, 3
-    iters, reps = 50, 3
+    iters = 50
     state = make_sweep_state(jr.key(5), batch, cap)
     ok = jnp.ones((batch, 2), bool)  # table-verify mask; content irrelevant here
 
@@ -46,15 +46,7 @@ def main() -> None:
     results = {}
     for impl in ("threefry2x32", "rbg"):
         key = jr.key(6, impl=impl)
-        jax.device_get(step(jr.fold_in(key, 0), state, ok))  # compile+warm
-        best = float("inf")
-        for r in range(reps):
-            t0 = time.perf_counter()
-            res = None
-            for i in range(1, iters + 1):
-                res = step(jr.fold_in(key, r * iters + i), state, ok)
-            jax.device_get(res)
-            best = min(best, time.perf_counter() - t0)
+        best = _timed(step, lambda i: (jr.fold_in(key, i), state, ok), iters)
         results[impl] = {
             "elapsed_s": round(best, 4),
             "rounds_per_sec": round(batch * iters / best, 1),
