@@ -228,8 +228,11 @@ def bench_sm1_n64_signed(jax, jnp, jr):
     # passes are shifts, not multiplies).  Cross-checked against XLA's own
     # op count below when the backend exposes cost analysis.
     est_mults = 1.7e6
-    try:  # XLA's count of the compiled executable's arithmetic ops
-        ca = vjit.lower(*variants[0]).compile().cost_analysis()
+    try:  # XLA's op count from the LOWERED module — pre-compile, so the
+        # big verify program is not compiled a second time just for this
+        # (an AOT .compile() does not share jit's executable cache and
+        # costs ~1 min through the tunnel).
+        ca = vjit.lower(*variants[0]).cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         xla_flops_per_verify = round(float(ca["flops"]) / nv, 1)
