@@ -1,0 +1,129 @@
+"""Per-stage breakdown of the north-star sweep step on the real chip.
+
+Decomposes the signed-sweep step (bench_sweep10k_signed's one_bucket) into
+its four sub-programs — round-1 broadcast, signature-mask gather, the m
+collapsed relay rounds, and the quorum — each timed as its own jitted
+program on device-resident inputs (the bench._timed playbook: host-fetch
+sync, V distinct variants against tunnel memoization, min-of-reps).
+``sum_of_stages ~ full_step`` (minus per-dispatch latency x stage count)
+is the coverage cross-check.  Output: one JSON line.
+
+Run ALONE (one TPU chip, one claim).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from bench import _timed
+    from ba_tpu.core import sm_agreement
+    from ba_tpu.core.om import round1_broadcast
+    from ba_tpu.core.quorum import majority_counts, quorum_decision
+    from ba_tpu.core.sm import (
+        _initial_seen,
+        sm_choice,
+        sm_relay_rounds_collapsed,
+    )
+    from ba_tpu.crypto.signed import sig_valid_from_tables
+    from ba_tpu.parallel import make_sweep_state
+
+    batch = int(os.environ.get("SWEEP_STAGES_BATCH", 10240))
+    cap = int(os.environ.get("SWEEP_STAGES_CAP", 1024))
+    m = 3
+    iters, reps = 20, 2
+    V = reps * iters + 2
+    state = make_sweep_state(jr.key(5), batch, cap)
+    ok = jnp.ones((batch, 2), bool)
+    keys = [jr.fold_in(jr.key(6), v) for v in range(V)]
+
+    results = {}
+
+    def timed(name, fn, make_args):
+        elapsed = _timed(fn, make_args, iters, reps=reps)
+        results[name] = {
+            "ms_per_dispatch": round(elapsed / iters * 1e3, 3),
+            "us_per_instance": round(elapsed / iters / batch * 1e6, 3),
+        }
+        return elapsed / iters
+
+    t_total = 0.0
+
+    # Stage 1: round-1 broadcast (coins + leader row scatter).
+    fn_r1 = jax.jit(
+        lambda k: round1_broadcast(k, state).astype(jnp.int32).sum()
+    )
+    t_total += timed("round1_broadcast", fn_r1, lambda i: (keys[i % V],))
+
+    # Stage inputs: V distinct received rows, device-resident.
+    recv = [jax.jit(lambda k: round1_broadcast(k, state))(keys[v])
+            for v in range(V)]
+
+    # Stage 2: signature-mask gather from the verified tables.
+    fn_sig = jax.jit(
+        lambda r: sig_valid_from_tables(ok, r).astype(jnp.int32).sum()
+    )
+    t_total += timed("sig_gather", fn_sig, lambda i: (recv[i % V],))
+
+    # Stage 3: m collapsed relay rounds (seen init included — cheap mask).
+    def relay(k, r):
+        seen = _initial_seen(state, r)
+        seen = sm_relay_rounds_collapsed(k, state, seen, m)
+        return seen.astype(jnp.int32).sum()
+
+    fn_relay = jax.jit(relay)
+    t_total += timed(
+        "relay_m%d" % m, fn_relay, lambda i: (keys[i % V], recv[i % V])
+    )
+
+    # Stage 4: choice + majority counts + quorum decision.
+    seen_in = [
+        jax.jit(
+            lambda k, r: sm_relay_rounds_collapsed(
+                k, state, _initial_seen(state, r), m
+            )
+        )(keys[v], recv[v])
+        for v in range(V)
+    ]
+
+    def quorum(seen):
+        maj = sm_choice(state, seen)
+        n_a, n_r, n_u = majority_counts(maj, state.alive)
+        decision, _, _ = quorum_decision(n_a, n_r, n_u)
+        return decision.astype(jnp.int32).sum()
+
+    fn_q = jax.jit(quorum)
+    t_total += timed("choice_quorum", fn_q, lambda i: (seen_in[i % V],))
+
+    # Full step for the cross-check.
+    @jax.jit
+    def full(key):
+        k1, k2 = jr.split(key)
+        received = round1_broadcast(k1, state)
+        sig_valid = sig_valid_from_tables(ok, received)
+        out = sm_agreement(k2, state, m, None, sig_valid, received, True)
+        return out["decision"].astype(jnp.int32).sum()
+
+    t_full = timed("full_step", full, lambda i: (keys[i % V],))
+
+    print(json.dumps({
+        "metric": "sweep-stage-breakdown",
+        "batch": batch, "n": cap, "m": m, "iters": iters,
+        "sum_of_stages_ms": round((t_total) * 1e3, 3),
+        "full_step_ms": round(t_full * 1e3, 3),
+        "rounds_per_sec_full": round(batch / t_full, 1),
+        **results,
+    }))
+
+
+if __name__ == "__main__":
+    main()
