@@ -1,0 +1,194 @@
+"""Pallas TPU kernel: the ENTIRE signed-sweep agreement step, fused.
+
+The north-star hot path (BASELINE config #5; bench_sweep10k_signed's
+``one_bucket``) is a chain of small elementwise programs — round-1
+broadcast (ba.py:258-282 semantics), signature-mask select, m collapsed
+relay rounds (core/sm.py), choice + majority counts + 3f+1 quorum
+(ba.py:159-255) — whose XLA form pays per-op HBM round trips, layout
+changes, and threefry coin generation (the measured r2/r3 bound: "VPU
+throughput, packed-u8 RNG + elementwise relay").  This kernel runs the
+whole step for a [TILE, n] block of instances inside VMEM:
+
+- every intermediate (received row, seen planes, per-instance scalars)
+  lives in registers/VMEM — state is read once and one decision column is
+  written back;
+- fault coins and relay draws come from the TPU's in-core hardware PRNG
+  (``pltpu.prng_seed`` / ``prng_random_bits``), replacing threefry
+  entirely (one u32 draw per lane per relay round: byte 0 gates RETREAT,
+  byte 1 gates ATTACK — iid 8-bit uniforms, exactly the packed-u8
+  discipline of core/rng.uniform_u8);
+- the per-round reductions (honest-held flags, traitor-holder counts) and
+  the final majority/quorum math are row reductions over the lane axis,
+  fused with everything else.
+
+Semantics mirror the XLA path op-for-op (round1_broadcast ->
+sig_valid_from_tables -> _initial_seen & sig_valid ->
+sm_relay_rounds_collapsed -> sm_choice -> majority_counts ->
+quorum_decision, incl. the needed-overrides, retreat-first tie Q7, and
+the zero-voter guard) — only the PRNG stream differs, which nothing
+couples to (core/rng.py's stream-freedom note).  With zero traitors the
+step is draw-independent and must match the XLA path bit-for-bit;
+tests/test_ops.py pins that plus distributional equivalence with
+traitors.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+
+TILE = 64
+LANES = 128
+
+
+def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
+                 ok_r_ref, ok_a_ref, dec_ref, *, m: int):
+    T, N = faulty_ref.shape
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+
+    faulty = faulty_ref[:]  # int32 0/1, padded lanes 0
+    alive = alive_ref[:]
+    order = order_ref[:]  # [T, 1] int32 (0/1)
+    leader = leader_ref[:]  # [T, 1] int32
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (T, N), 1)
+    is_leader = iota == leader  # [T, N] bool
+
+    # Round 1: honest leader pushes order; faulty leader flips a coin per
+    # recipient (ba.py:268-273); the leader itself holds the true order.
+    leader_faulty = jnp.sum(
+        jnp.where(is_leader, faulty, 0), axis=1, keepdims=True
+    )  # [T, 1]
+    coin = (
+        pltpu.bitcast(pltpu.prng_random_bits((T, N)), jnp.int32) & 1
+    )
+    received = jnp.where(leader_faulty > 0, coin, order)
+    received = jnp.where(is_leader, order, received)
+
+    # Signature gate: per-copy validity from the per-value table verdicts
+    # (crypto/signed.sig_valid_from_tables, the V=2 broadcast select).
+    sig_ok = jnp.where(received == ATTACK, ok_a_ref[:], ok_r_ref[:])
+
+    # Initial V-sets (core/sm._initial_seen, sig-gated).
+    gate = alive * sig_ok
+    seen_r = jnp.where(received == RETREAT, gate, 0)
+    seen_a = jnp.where(received == ATTACK, gate, 0)
+
+    honest = alive * (1 - faulty)
+    traitor = alive * faulty
+    t = jnp.sum(traitor, axis=1, keepdims=True)  # coalition size [T, 1]
+
+    # m collapsed relay rounds (core/sm.sm_relay_rounds_collapsed): the OR
+    # of k traitor-holder coins is Bernoulli(1 - 2^-k), realised as an
+    # 8-bit threshold draw (core/rng.or_coin_threshold8: exact for k <= 8,
+    # saturating beyond with error <= 2^-9 per draw).  The honest-held OR
+    # (``incoming = draw | held_honest``) is folded into the threshold:
+    # held => thresh 256 > any u8, i.e. "fire always" — this keeps every
+    # per-instance flag an int32 column (narrow i1/int8 vectors hit a
+    # Mosaic relayout bug; see ops/majority.py).
+    for r in range(1, m + 1):
+        draws = pltpu.bitcast(pltpu.prng_random_bits((T, N)), jnp.int32)
+        u_r = draws & 0xFF
+        u_a = (draws >> 8) & 0xFF
+        new_planes = []
+        for seen, u in ((seen_r, u_r), (seen_a, u_a)):
+            held_cnt = jnp.sum(seen * honest, axis=1, keepdims=True)
+            k = jnp.sum(seen * traitor, axis=1, keepdims=True)
+            t8 = jnp.where(k > 8, 256, 256 - (256 >> jnp.minimum(k, 8)))
+            thresh = jnp.where(
+                held_cnt > 0, 256, jnp.where(r < t, t8, 0)
+            )  # chain bound: coalition-only reveal needs r < t
+            new_planes.append(jnp.where(u < thresh, alive, seen * alive))
+        seen_r, seen_a = new_planes
+
+    # choice(V) (core/sm.sm_choice): |V|==1 -> the value, else UNDEFINED;
+    # the leader reports its own order (Q1 parity).
+    has_r = seen_r > 0
+    has_a = seen_a > 0
+    maj = jnp.where(
+        has_a & ~has_r,
+        jnp.int32(ATTACK),
+        jnp.where(has_r & ~has_a, jnp.int32(RETREAT), jnp.int32(UNDEFINED)),
+    )
+    maj = jnp.where(is_leader, order, maj)
+
+    # Majority-of-majorities over alive nodes + quorum thresholds with the
+    # reference's overrides (core/quorum, ba.py:197-255).
+    n_a = jnp.sum(jnp.where(maj == ATTACK, alive, 0), axis=1, keepdims=True)
+    n_r = jnp.sum(jnp.where(maj == RETREAT, alive, 0), axis=1, keepdims=True)
+    n_u = jnp.sum(jnp.where(maj == UNDEFINED, alive, 0), axis=1, keepdims=True)
+    total = n_a + n_r + n_u
+    needed = 2 * ((total - 1) // 3) + 1
+    needed = jnp.where(total <= 3, total - 1, needed)
+    needed = jnp.where(total == 1, 1, needed)
+    dec = jnp.where(
+        needed <= n_r,
+        jnp.int32(RETREAT),
+        jnp.where(needed <= n_a, jnp.int32(ATTACK), jnp.int32(UNDEFINED)),
+    )
+    dec_ref[:] = jnp.where(total == 0, jnp.int32(UNDEFINED), dec)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "interpret"))
+def fused_signed_sweep_step(
+    seed: jnp.ndarray,
+    order: jnp.ndarray,
+    leader: jnp.ndarray,
+    faulty: jnp.ndarray,
+    alive: jnp.ndarray,
+    ok: jnp.ndarray,
+    m: int = 3,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One fused signed-sweep agreement round -> decisions [B] int8.
+
+    seed: int32 [1] (vary per step — the kernel folds in the tile index);
+    order [B] int8/int32; leader [B] int32; faulty/alive [B, n] bool;
+    ok [B, 2] bool (per-value table-verify verdicts, RETREAT/ATTACK order).
+    """
+    B, n = faulty.shape
+    b_pad = -(-B // TILE) * TILE
+    n_pad = -(-n // LANES) * LANES
+
+    def pad2(x):
+        return jnp.pad(x.astype(jnp.int32), ((0, b_pad - B), (0, n_pad - n)))
+
+    def pad1(x):
+        return jnp.pad(x.astype(jnp.int32), (0, b_pad - B))[:, None]
+
+    grid = b_pad // TILE
+    col = lambda i: (i, 0)  # noqa: E731
+    vcol = pl.BlockSpec((TILE, 1), col, memory_space=pltpu.VMEM)
+    vplane = pl.BlockSpec((TILE, n_pad), col, memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_step_kernel, m=m),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # seed [1]
+            vcol,  # order
+            vcol,  # leader
+            vplane,  # faulty
+            vplane,  # alive
+            vcol,  # ok retreat
+            vcol,  # ok attack
+        ],
+        out_specs=pl.BlockSpec((TILE, 1), col, memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.int32),
+        interpret=interpret,
+    )(
+        seed.astype(jnp.int32),
+        pad1(order),
+        pad1(leader),
+        pad2(faulty),
+        pad2(alive),
+        pad1(ok[:, 0]),
+        pad1(ok[:, 1]),
+    )
+    return out[:B, 0].astype(COMMAND_DTYPE)

@@ -391,12 +391,36 @@ def bench_sweep10k_signed(jax, jnp, jr):
         oks.append(ok[off : off + bk])
         off += bk
 
-    def one_bucket(key, state, ok):
-        k1, k2 = jr.split(key)
-        received = round1_broadcast(k1, state)
-        sig_valid = sig_valid_from_tables(ok, received)
-        out = sm_agreement(k2, state, m, None, sig_valid, received, True)
-        return out["decision"].astype(jnp.int32).sum()
+    # BA_TPU_FUSED_SWEEP: 1 = the single-Pallas-kernel step (in-kernel
+    # hardware PRNG, whole round in VMEM — ops/sweep_step.py), 0 = the XLA
+    # composition, auto = fused wherever the Pallas kernels are on.
+    # Default is 0 until the kernel's TPU-gated differential tests have
+    # run on hardware (flip to "auto" then — the driver's bench must never
+    # gamble on an unvalidated Mosaic compile).  Differential tests:
+    # tests/test_ops.py fused-sweep section.
+    from ba_tpu.utils.platform import use_pallas
+
+    fused_env = os.environ.get("BA_TPU_FUSED_SWEEP", "0")
+    use_fused = fused_env == "1" or (fused_env == "auto" and use_pallas())
+    if use_fused:
+        from ba_tpu.ops.sweep_step import fused_signed_sweep_step
+
+        def one_bucket(key, state, ok):
+            seed = jax.lax.bitcast_convert_type(
+                jr.key_data(key)[-1:], jnp.int32
+            )
+            dec = fused_signed_sweep_step(
+                seed, state.order, state.leader, state.faulty, state.alive,
+                ok, m,
+            )
+            return dec.astype(jnp.int32).sum()
+    else:
+        def one_bucket(key, state, ok):
+            k1, k2 = jr.split(key)
+            received = round1_broadcast(k1, state)
+            sig_valid = sig_valid_from_tables(ok, received)
+            out = sm_agreement(k2, state, m, None, sig_valid, received, True)
+            return out["decision"].astype(jnp.int32).sum()
 
     @jax.jit
     def step(key, states, oks):
@@ -436,6 +460,7 @@ def bench_sweep10k_signed(jax, jnp, jr):
             {"instances": b, "padded_n": c}
             for b, c in zip(bucket_sizes, bucket_caps)
         ],
+        "fused_kernel": use_fused,
         "elapsed_s": round(elapsed, 4),
         "setup_sign_s": round(setup_sign_s, 2),
         "setup_verify_s": round(setup_verify_s, 2),
