@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Hardware validation + same-window A/B for the fused sweep kernel.
+# Run ALONE (one TPU chip, one claim).  Produces:
+#   TESTS_TPU_FUSED_r{N}.txt  - the kernel's differential tests on chip
+#   SWEEP_STAGES_r{N}.json    - per-stage breakdown of the XLA sweep step
+#   FUSED_AB_r{N}.json        - same-window XLA-vs-fused sweep bench A/B
+set -u
+N="${1:?usage: validate_fused.sh <round>}"
+cd "$(dirname "$0")/.."
+
+echo "== fused kernel differential tests (first Mosaic compile included)"
+BA_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_ops.py -q -k "fused" \
+    > "TESTS_TPU_FUSED_r${N}.txt" 2>&1
+rc_tests=$?
+tail -2 "TESTS_TPU_FUSED_r${N}.txt"
+[ $rc_tests -ne 0 ] && { echo "TESTS FAILED - stopping"; exit 1; }
+
+echo "== sweep stage breakdown (XLA path)"
+python scripts/sweep_stages.py > "SWEEP_STAGES_r${N}.json" 2> /tmp/sweep_stages.err
+tail -c 400 "SWEEP_STAGES_r${N}.json"; echo
+
+echo "== same-window A/B: XLA vs fused sweep config"
+xla=$(BA_TPU_FUSED_SWEEP=0 python bench.py --configs sweep10k_signed 2>/dev/null)
+fused=$(BA_TPU_FUSED_SWEEP=1 python bench.py --configs sweep10k_signed 2>/dev/null)
+python - "$xla" "$fused" > "FUSED_AB_r${N}.json" <<'EOF'
+import json, sys
+xla = json.loads(sys.argv[1])["configs"]["sweep10k_signed"]
+fused = json.loads(sys.argv[2])["configs"]["sweep10k_signed"]
+out = {
+    "metric": "fused-sweep-ab",
+    "xla": {k: xla[k] for k in ("rounds_per_sec", "elapsed_s",
+                                "incl_setup_crossover_1M_iters")},
+    "fused": {k: fused[k] for k in ("rounds_per_sec", "elapsed_s",
+                                    "incl_setup_crossover_1M_iters")},
+    "speedup_fused": round(xla["elapsed_s"] / fused["elapsed_s"], 3),
+}
+print(json.dumps(out))
+EOF
+cat "FUSED_AB_r${N}.json"
