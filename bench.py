@@ -393,14 +393,13 @@ def bench_sweep10k_signed(jax, jnp, jr):
 
     # BA_TPU_FUSED_SWEEP: 1 = the single-Pallas-kernel step (in-kernel
     # hardware PRNG, whole round in VMEM — ops/sweep_step.py), 0 = the XLA
-    # composition, auto = fused wherever the Pallas kernels are on.
-    # Default is 0 until the kernel's TPU-gated differential tests have
-    # run on hardware (flip to "auto" then — the driver's bench must never
-    # gamble on an unvalidated Mosaic compile).  Differential tests:
-    # tests/test_ops.py fused-sweep section.
+    # composition, auto (default) = fused wherever the Pallas kernels are
+    # on.  Hardware-validated r3: 5/5 differential tests on chip
+    # (TESTS_TPU_FUSED_r3.txt) and a same-window +28% over the XLA path
+    # (FUSED_AB_r3.json).
     from ba_tpu.utils.platform import use_pallas
 
-    fused_env = os.environ.get("BA_TPU_FUSED_SWEEP", "0")
+    fused_env = os.environ.get("BA_TPU_FUSED_SWEEP", "auto")
     use_fused = fused_env == "1" or (fused_env == "auto" and use_pallas())
     if use_fused:
         from ba_tpu.ops.sweep_step import fused_signed_sweep_step

@@ -513,7 +513,7 @@ def test_fused_sweep_step_faulty_leader_equivocates():
 
     B, cap, m = 2048, 32, 1
     state = make_sweep_state(jr.key(6), B, cap)
-    faulty = np.asarray(state.faulty)
+    faulty = np.array(state.faulty)  # np.asarray of a device array is read-only
     faulty[:, 0] = True  # leader lies per recipient (ba.py:268-273)
     state = type(state)(
         state.order, state.leader, jnp.asarray(faulty), state.alive, state.ids
@@ -547,7 +547,7 @@ def test_fused_sweep_step_histogram_matches_xla():
 
     B, cap, m = 8192, 16, 2
     state = make_sweep_state(jr.key(8), B, cap)
-    faulty = np.asarray(state.faulty)
+    faulty = np.array(state.faulty)  # writable copy
     faulty[:, 0] = True  # every leader equivocates
     state = type(state)(
         state.order, state.leader, jnp.asarray(faulty), state.alive, state.ids
