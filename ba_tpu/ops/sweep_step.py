@@ -43,7 +43,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
 
-TILE = 64
+import os
+
+# Instances per kernel invocation.  64 keeps ~10 int32 [TILE, 1024] planes
+# comfortably in VMEM (~2.6 MB); BA_TPU_FUSED_TILE overrides for tuning
+# (read at import, like the sibling kernels' tile constants).
+TILE = int(os.environ.get("BA_TPU_FUSED_TILE", 64))
 LANES = 128
 
 
@@ -135,7 +140,7 @@ def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
     dec_ref[:] = jnp.where(total == 0, jnp.int32(UNDEFINED), dec)
 
 
-@functools.partial(jax.jit, static_argnames=("m", "interpret"))
+@functools.partial(jax.jit, static_argnames=("m", "tile", "interpret"))
 def fused_signed_sweep_step(
     seed: jnp.ndarray,
     order: jnp.ndarray,
@@ -145,6 +150,7 @@ def fused_signed_sweep_step(
     ok: jnp.ndarray,
     m: int = 3,
     *,
+    tile: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """One fused signed-sweep agreement round -> decisions [B] int8.
@@ -153,6 +159,7 @@ def fused_signed_sweep_step(
     order [B] int8/int32; leader [B] int32; faulty/alive [B, n] bool;
     ok [B, 2] bool (per-value table-verify verdicts, RETREAT/ATTACK order).
     """
+    TILE = tile or globals()["TILE"]
     B, n = faulty.shape
     b_pad = -(-B // TILE) * TILE
     n_pad = -(-n // LANES) * LANES

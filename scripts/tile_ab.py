@@ -1,0 +1,73 @@
+"""Same-window tile-size A/B for the fused sweep kernel (one process,
+interleaved reps so service drift cancels).  Run ALONE."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ba_tpu.ops.sweep_step import fused_signed_sweep_step
+    from ba_tpu.parallel import bucketed_sweep_states
+
+    tiles = [int(t) for t in
+             os.environ.get("TILE_AB_TILES", "32,64,128,256").split(",")]
+    batch, cap, m = 10240, 1024, 3
+    iters, reps = 30, 3
+    states = bucketed_sweep_states(jr.key(5), batch, cap, 2)
+    ok = jnp.ones((batch, 2), bool)
+    oks, off = [], 0
+    for s in states:
+        b = s.faulty.shape[0]
+        oks.append(ok[off:off + b])
+        off += b
+
+    def make_step(tile):
+        @jax.jit
+        def step(seed):
+            acc = jnp.int32(0)
+            for i, (st, okb) in enumerate(zip(states, oks)):
+                dec = fused_signed_sweep_step(
+                    seed + i, st.order, st.leader, st.faulty, st.alive,
+                    okb, m, tile=tile,
+                )
+                acc += dec.astype(jnp.int32).sum()
+            return acc
+        return step
+
+    steps = {t: make_step(t) for t in tiles}
+    for t, step in steps.items():  # compile + warm, off the clock
+        jax.device_get(step(jnp.asarray([t], jnp.int32)))
+
+    best = {t: float("inf") for t in tiles}
+    for r in range(reps):  # interleave tiles within each rep: drift cancels
+        for t, step in steps.items():
+            t0 = time.perf_counter()
+            res = None
+            for i in range(1, iters + 1):
+                res = step(jnp.asarray([r * 1000 + i], jnp.int32))
+            jax.device_get(res)
+            best[t] = min(best[t], time.perf_counter() - t0)
+
+    out = {
+        "metric": "fused-tile-ab", "batch": batch, "iters": iters,
+        "tiles": {
+            str(t): {"elapsed_s": round(e, 4),
+                     "rounds_per_sec": round(batch * iters / e, 1)}
+            for t, e in best.items()
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
