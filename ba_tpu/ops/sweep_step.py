@@ -159,9 +159,11 @@ def fused_signed_sweep_step(
     order [B] int8/int32; leader [B] int32; faulty/alive [B, n] bool;
     ok [B, 2] bool (per-value table-verify verdicts, RETREAT/ATTACK order).
     """
-    TILE = tile or globals()["TILE"]
+    tile = TILE if tile is None else tile  # explicit 0 is a loud error below
+    if tile <= 0:
+        raise ValueError(f"tile={tile} must be positive")
     B, n = faulty.shape
-    b_pad = -(-B // TILE) * TILE
+    b_pad = -(-B // tile) * tile
     n_pad = -(-n // LANES) * LANES
 
     def pad2(x):
@@ -170,10 +172,10 @@ def fused_signed_sweep_step(
     def pad1(x):
         return jnp.pad(x.astype(jnp.int32), (0, b_pad - B))[:, None]
 
-    grid = b_pad // TILE
+    grid = b_pad // tile
     col = lambda i: (i, 0)  # noqa: E731
-    vcol = pl.BlockSpec((TILE, 1), col, memory_space=pltpu.VMEM)
-    vplane = pl.BlockSpec((TILE, n_pad), col, memory_space=pltpu.VMEM)
+    vcol = pl.BlockSpec((tile, 1), col, memory_space=pltpu.VMEM)
+    vplane = pl.BlockSpec((tile, n_pad), col, memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         functools.partial(_step_kernel, m=m),
         grid=(grid,),
@@ -186,7 +188,7 @@ def fused_signed_sweep_step(
             vcol,  # ok retreat
             vcol,  # ok attack
         ],
-        out_specs=pl.BlockSpec((TILE, 1), col, memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((tile, 1), col, memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.int32),
         interpret=interpret,
     )(

@@ -6,7 +6,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -44,6 +43,8 @@ def main() -> None:
             return acc
         return step
 
+    from bench import _timed  # the tunnel-safe timing single source of truth
+
     steps = {t: make_step(t) for t in tiles}
     for t, step in steps.items():  # compile + warm, off the clock
         jax.device_get(step(jnp.asarray([t], jnp.int32)))
@@ -51,12 +52,8 @@ def main() -> None:
     best = {t: float("inf") for t in tiles}
     for r in range(reps):  # interleave tiles within each rep: drift cancels
         for t, step in steps.items():
-            t0 = time.perf_counter()
-            res = None
-            for i in range(1, iters + 1):
-                res = step(jnp.asarray([r * 1000 + i], jnp.int32))
-            jax.device_get(res)
-            best[t] = min(best[t], time.perf_counter() - t0)
+            mk = lambda i, _r=r: (jnp.asarray([_r * 1000 + i], jnp.int32),)
+            best[t] = min(best[t], _timed(steps[t], mk, iters, reps=1))
 
     out = {
         "metric": "fused-tile-ab", "batch": batch, "iters": iters,
