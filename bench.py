@@ -105,14 +105,16 @@ def bench_om1_n4(jax, jnp, jr):
     faulty = jnp.zeros((batch, n), bool).at[:, 2].set(True)
     state = make_state(batch, n, order=ATTACK, faulty=faulty)
 
+    # state is constant across rounds: close over it (seed-only dispatch,
+    # same rationale and measurement as the sweep config below).
     @jax.jit
-    def step(key, state):
+    def step(key):
         out = om1_agreement(key, state)
         return out["decision"].astype(jnp.int32).sum(), out["needed"].sum()
 
     key = make_key(0)
     iters = 30
-    elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
+    elapsed = _timed(step, lambda i: (jr.fold_in(key, i),), iters)
     bytes_round = batch * (2 * n * n + 5 * n)  # answer+coin cubes, int8 rows
     return {
         "rounds_per_sec": round(batch * iters / elapsed, 1),
@@ -120,7 +122,8 @@ def bench_om1_n4(jax, jnp, jr):
         "elapsed_s": round(elapsed, 4),
         "bytes_per_round_est": bytes_round,
         "achieved_gbps_est": round(bytes_round * iters / elapsed / 1e9, 2),
-        "bound": "dispatch/latency (tiny per-round footprint)",
+        "bound": "VPU elementwise + per-iter dispatch (seed-only dispatch "
+                 "r3: shipping the state pytree per call was 14x slower)",
     }
 
 
@@ -134,13 +137,13 @@ def bench_om3_n10(jax, jnp, jr):
     state = make_state(batch, n, order=ATTACK, faulty=faulty)
 
     @jax.jit
-    def step(key, state):
+    def step(key):  # state closed over: constant across rounds
         out = eig_agreement(key, state, m)
         return out["decision"].astype(jnp.int32).sum(), out["needed"].sum()
 
     key = make_key(1)
     iters = 20
-    elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
+    elapsed = _timed(step, lambda i: (jr.fold_in(key, i),), iters)
     # EIG levels 1..m: n^l cells per general, touched ~3x (coins, send
     # tensor, resolve pass), all int8.
     cells = sum(n ** l for l in range(1, m + 1))
@@ -212,15 +215,13 @@ def bench_sm1_n64_signed(jax, jnp, jr):
     sig_valid = jnp.ones((batch, n), bool)
 
     @jax.jit
-    def step(key, state, sig_valid):
+    def step(key):  # state/sig_valid closed over: constant across rounds
         out = sm_agreement(key, state, m, None, sig_valid, None, False)
         return out["decision"].astype(jnp.int32).sum()
 
     key = make_key(3)
     iters = 20
-    elapsed = _timed(
-        step, lambda i: (jr.fold_in(key, i), state, sig_valid), iters
-    )
+    elapsed = _timed(step, lambda i: (jr.fold_in(key, i),), iters)
     # ~1.7M int32 multiplies per verify: ~3.6k field muls — 4-bit-window
     # [h]A ladder (2.5k: 256 doublings + 64 window adds + 14 table adds),
     # 63-add fixed-base [S]B tree (0.6k), 2 decompression pow-chains
@@ -276,13 +277,13 @@ def bench_eig_n1024(jax, jnp, jr):
     state = make_state(1, n, order=ATTACK, faulty=faulty)
 
     @jax.jit
-    def step(key, state):
+    def step(key):  # state closed over: constant across rounds
         out = eig_agreement(key, state, m)
         return out["decision"].astype(jnp.int32).sum(), out["needed"].sum()
 
     key = make_key(8)
     iters = 5
-    elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
+    elapsed = _timed(step, lambda i: (jr.fold_in(key, i),), iters)
     cells = sum(n ** l for l in range(1, m + 1))
     bytes_round = n * cells * 3  # coins + send tensor + resolve pass, int8
     return {
@@ -306,7 +307,7 @@ def bench_n1024_m32(jax, jnp, jr):
     # dispatch latency (tens of ms, high variance) out of the measurement
 
     @jax.jit
-    def step(key, state):
+    def step(key):  # state closed over: constant across rounds
         def one(acc, k):
             out = sm_agreement(k, state, m, None, None, None, True)
             return acc + out["decision"].astype(jnp.int32).sum(), None
@@ -316,7 +317,7 @@ def bench_n1024_m32(jax, jnp, jr):
 
     key = make_key(4)
     iters = 5
-    elapsed = _timed(step, lambda i: (jr.fold_in(key, i), state), iters)
+    elapsed = _timed(step, lambda i: (jr.fold_in(key, i),), iters)
     bytes_round = m * n * 2 * 3  # per relay round: packed-u8 draws + seen bools
     return {
         "rounds_per_sec": round(inner * iters / elapsed, 1),
