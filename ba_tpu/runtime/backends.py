@@ -207,6 +207,7 @@ class JaxBackend:
 
     def run_round(self, generals, leader_idx, order_code, seed):
         import jax.random as jr
+        import numpy as np
 
         n = len(generals)
         state = self._make_state(generals, leader_idx, order_code)
@@ -214,4 +215,7 @@ class JaxBackend:
             maj = self._run_signed(state, seed)
         else:
             maj = self._fn()(jr.key(seed), state)
-        return [int(v) for v in maj[0, :n]]
+        # ONE host fetch for the whole row: int(v) per element costs a
+        # ~50-100 ms tunnel round-trip per general (measured r3: the REPL
+        # round dropped ~4x when this loop stopped fetching elementwise).
+        return [int(v) for v in np.asarray(maj[0, :n])]
