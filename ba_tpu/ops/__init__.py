@@ -43,9 +43,23 @@ Pallas kernels for the ops that dominate the BASELINE workloads:
   point and as the fusion template (differential tests in test_ops.py).
 - ``planes``   — shape-agnostic limb-plane field/Edwards arithmetic shared
   by the kernel bodies and their CPU differential anchors.
+- ``sweep_step`` — the ENTIRE north-star signed-sweep agreement round as
+  one kernel (round-1 broadcast, signature gate, m collapsed relay
+  rounds, choice, quorum) with the TPU's in-core hardware PRNG; +28%
+  same-window over the XLA composition (r3), 5/5 on-chip differential
+  tests, and a shard_map form for the multi-chip data axis.
 """
 
 from ba_tpu.ops.ladder import scalar_mult as ladder_scalar_mult
 from ba_tpu.ops.majority import masked_majority_rows
+from ba_tpu.ops.sweep_step import (
+    fused_sharded_sweep_step,
+    fused_signed_sweep_step,
+)
 
-__all__ = ["ladder_scalar_mult", "masked_majority_rows"]
+__all__ = [
+    "ladder_scalar_mult",
+    "masked_majority_rows",
+    "fused_signed_sweep_step",
+    "fused_sharded_sweep_step",
+]
