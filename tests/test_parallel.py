@@ -63,6 +63,28 @@ def test_bucketed_sweep_one_bucket_matches_flat():
     assert (n_alive >= 4).all() and (n_alive <= 16).all()
 
 
+def test_make_sweep_state_max_n_bounds():
+    # max_n narrows the size range without touching the padded capacity.
+    state = make_sweep_state(jr.key(9), 64, 32, min_n=6, max_n=9)
+    assert state.faulty.shape == (64, 32)
+    n_alive = np.asarray(state.alive).sum(-1)
+    assert (n_alive >= 6).all() and (n_alive <= 9).all()
+    with pytest.raises(ValueError):
+        make_sweep_state(jr.key(9), 4, 32, min_n=10, max_n=9)
+    with pytest.raises(ValueError):
+        make_sweep_state(jr.key(9), 4, 32, max_n=33)
+
+
+def test_bucketed_sweep_custom_min_n_and_guard():
+    # Custom min_n threads through to the first bucket; the bucket-width
+    # guard names the real constraint.
+    states = bucketed_sweep_states(jr.key(10), 64, 256, 2, min_n=100)
+    n0 = np.asarray(states[0].alive).sum(-1)
+    assert (n0 >= 100).all() and (n0 <= 128).all()
+    with pytest.raises(ValueError, match="upper edge below min_n"):
+        bucketed_sweep_states(jr.key(10), 64, 256, 2, min_n=200)
+
+
 def test_bucketed_sweep_decisions_compose():
     # Each bucket is an independent sweep: with an honest leader every
     # instance must decide the ordered value regardless of padding width.
