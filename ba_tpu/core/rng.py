@@ -40,7 +40,15 @@ def make_key(seed: int) -> jax.Array:
     is outcome-distribution-based, so the only requirement is iid uniform
     bits, which RngBitGenerator provides.  Default remains threefry2x32 —
     fully deterministic across backends — so differential tests and
-    recorded artifacts stay reproducible; benches opt in for throughput.
+    recorded artifacts stay reproducible.
+
+    Measured cost, so nobody reaches for this knob expecting a win: on the
+    TPU v5e bench chip ``rbg`` is 2.8-3.5x SLOWER than the default for
+    these packed-bit coin workloads (same-window A/B, ``RNG_AB_r3.json``)
+    — the hardware generator's wide draws don't amortize at the 1-word-
+    per-32-coins rate ``coin_bits`` already achieves.  The knob is kept as
+    a recorded negative result and an escape hatch for backends where
+    threefry underperforms, not as a fast path.
     """
     impl = rng_impl()
     return jr.key(seed, impl=impl)

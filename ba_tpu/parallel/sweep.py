@@ -98,15 +98,20 @@ def bucketed_sweep_states(
     scales with the PADDED width).  Splitting the size range into
     ``n_buckets`` equal-width sub-ranges, each padded only to its own
     upper edge, cuts the mean padded width to ~3/4 (2 buckets) or ~5/8
-    (4 buckets) of ``capacity`` with zero change to the sampled
-    distribution: equal instance counts x equal-width uniform sub-ranges
-    compose to the same uniform mixture over [min_n, capacity] (up to the
-    integer edge where ranges abut).  Remainder instances go to the last
-    (widest) bucket, biasing toward MORE work, never less.
+    (4 buckets) of ``capacity`` while sampling approximately the same
+    distribution: equal instance counts over equal-width uniform
+    sub-ranges compose to the uniform mixture over [min_n, capacity] up
+    to the integer edges where ranges abut (sub-range widths in integers
+    can differ by one size value, e.g. 509 vs 512 at capacity 1024, so
+    sizes near an edge are represented at slightly different rates than
+    in the flat batch).  Remainder instances go to the last (widest)
+    bucket, biasing toward MORE work, never less.
 
     Returns one SimState per bucket (padded widths capacity/n_buckets *
     (k+1), rounded up to a multiple of 128 so the lane axis stays
-    TPU-tile-aligned); consensus semantics are unchanged — each bucket is
+    TPU-tile-aligned — capped at ``capacity`` itself when that is smaller,
+    e.g. tiny test capacities); consensus semantics are unchanged — each
+    bucket is
     just a smaller independent sweep, so decisions compose by
     concatenation and histograms by summation.
     """
@@ -122,7 +127,7 @@ def bucketed_sweep_states(
     lo = min_n
     for k in range(n_buckets):
         hi = capacity * (k + 1) // n_buckets
-        cap_k = -(-hi // 128) * 128 if hi >= 128 else hi
+        cap_k = -(-hi // 128) * 128
         bk = per if k < n_buckets - 1 else batch - per * (n_buckets - 1)
         states.append(
             make_sweep_state(

@@ -817,7 +817,35 @@ def main() -> None:
         line["ed25519_verifies_per_sec"] = results["sm1_n64_signed"][
             "ed25519_verifies_per_sec"
         ]
-    print(json.dumps(line))
+
+    # Output contract (driver round 3 regression: the full per-config line
+    # outgrew the driver's stdout tail window, so its recorded artifact had
+    # parsed=null and the numbers had to be text-scraped).  The FINAL stdout
+    # line is a compact headline object guaranteed to fit any tail window;
+    # the full per-config detail goes to a JSON file plus stderr.
+    detail_path = os.environ.get("BA_TPU_BENCH_DETAIL", "BENCH_detail.json")
+    with open(detail_path, "w") as f:
+        json.dump(line, f)
+    print(json.dumps(line), file=sys.stderr)
+    compact = {
+        "metric": line["metric"],
+        "value": line["value"],
+        "unit": line["unit"],
+        "vs_baseline": line["vs_baseline"],
+        "platform": line["platform"],
+        "rng_impl": line["rng_impl"],
+        "detail_file": detail_path,
+    }
+    for k in ("north_star_rounds_per_sec", "ed25519_verifies_per_sec"):
+        if k in line:
+            compact[k] = line[k]
+    sweep = results.get("sweep10k_signed")
+    if sweep:
+        compact["incl_setup_crossover_1M_iters"] = sweep[
+            "incl_setup_crossover_1M_iters"
+        ]
+        compact["setup_verify_s"] = sweep["setup_verify_s"]
+    print(json.dumps(compact))
 
 
 if __name__ == "__main__":

@@ -1,23 +1,44 @@
 #!/usr/bin/env bash
-# One full-bench attempt: replace BENCH_local_r{N}.json only if this run's
-# north-star sweep beats the committed artifact's.  Honest rule: artifacts
-# are whole runs — configs are never cherry-picked across runs.
+# One full-bench attempt.  Every attempt is APPENDED to
+# BENCH_attempts_r{N}.jsonl (timestamped), so the committed artifact can be
+# judged against the whole window distribution instead of silently
+# ratcheting toward the noise ceiling (ADVICE r3: replace-only-if-better
+# alone drifts the headline to the best weather ever seen).  The committed
+# BENCH_local_r{N}.json is still replaced only when the north-star sweep
+# beats it, and artifacts stay whole runs — configs are never
+# cherry-picked across runs.
 set -u
 N="${1:?usage: bench_refresh.sh <round>}"
 cd "$(dirname "$0")/.."
 TMP=$(mktemp /tmp/bench_attempt.XXXX.json)
-python bench.py > "$TMP" 2> /tmp/bench_attempt.err || exit 1
-python - "$TMP" "BENCH_local_r${N}.json" <<'EOF'
-import json, shutil, sys
-new, cur = sys.argv[1], sys.argv[2]
-k = ("configs", "sweep10k_signed", "rounds_per_sec")
-def get(p):
+BA_TPU_BENCH_DETAIL="$TMP" python bench.py > /tmp/bench_compact.json \
+    2> /tmp/bench_attempt.err || exit 1
+python - "$TMP" "BENCH_local_r${N}.json" "BENCH_attempts_r${N}.jsonl" <<'EOF'
+import datetime, json, shutil, sys
+new, cur, log = sys.argv[1], sys.argv[2], sys.argv[3]
+def star(p):
     d = json.load(open(p))
     return d["configs"]["sweep10k_signed"]["rounds_per_sec"]
-n, c = get(new), get(cur)
+n = star(new)
+attempt = json.load(open(new))
+attempt["attempt_utc"] = datetime.datetime.now(
+    datetime.timezone.utc
+).isoformat(timespec="seconds")
+with open(log, "a") as f:
+    f.write(json.dumps(attempt) + "\n")
+rates = sorted(
+    json.loads(l)["configs"]["sweep10k_signed"]["rounds_per_sec"]
+    for l in open(log)
+)
+dist = (f"attempts n={len(rates)} min={rates[0]:.0f} "
+        f"median={rates[len(rates) // 2]:.0f} max={rates[-1]:.0f}")
+try:
+    c = star(cur)
+except FileNotFoundError:
+    c = float("-inf")
 if n > c:
     shutil.copy(new, cur)
-    print(f"REPLACED: {n:.0f} > {c:.0f}")
+    print(f"REPLACED: {n:.0f} > {c:.0f} | {dist}")
 else:
-    print(f"kept: attempt {n:.0f} <= committed {c:.0f}")
+    print(f"kept: attempt {n:.0f} <= committed {c:.0f} | {dist}")
 EOF

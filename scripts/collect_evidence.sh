@@ -26,8 +26,11 @@ step() {  # step <name> <artifact> -- cmd...
     [ "$rc" -ne 0 ] && fail=1
 }
 
+# bench.py's stdout is now the compact headline line (driver tail-window
+# contract); the full per-config artifact is the BA_TPU_BENCH_DETAIL file.
 step "bench" "BENCH_local_r${N}.json" -- \
-    bash -c "python bench.py > 'BENCH_local_r${N}.json' 2> '/tmp/bench_r${N}.err'"
+    bash -c "BA_TPU_BENCH_DETAIL='BENCH_local_r${N}.json' python bench.py \
+             > '/tmp/bench_compact_r${N}.json' 2> '/tmp/bench_r${N}.err'"
 
 step "stages" "STAGES_r${N}.json" -- \
     bash -c "python bench.py --stages > 'STAGES_r${N}.json' 2> '/tmp/stages_r${N}.err'"

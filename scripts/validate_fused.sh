@@ -20,12 +20,22 @@ python scripts/sweep_stages.py > "SWEEP_STAGES_r${N}.json" 2> /tmp/sweep_stages.
 tail -c 400 "SWEEP_STAGES_r${N}.json"; echo
 
 echo "== same-window A/B: XLA vs fused sweep config"
-xla=$(BA_TPU_FUSED_SWEEP=0 python bench.py --configs sweep10k_signed 2>/dev/null)
-fused=$(BA_TPU_FUSED_SWEEP=1 python bench.py --configs sweep10k_signed 2>/dev/null)
-python - "$xla" "$fused" > "FUSED_AB_r${N}.json" <<'EOF'
+# bench.py's stdout is the compact headline line; the per-config detail
+# lands in the BA_TPU_BENCH_DETAIL file (bench.py output contract, r4).
+# Stale files are removed first and each run must succeed, so a crashed
+# bench can never silently pair one side with a previous run's numbers.
+rm -f /tmp/fused_ab_xla.json /tmp/fused_ab_fused.json
+BA_TPU_FUSED_SWEEP=0 BA_TPU_BENCH_DETAIL=/tmp/fused_ab_xla.json \
+    python bench.py --configs sweep10k_signed > /dev/null \
+    2> /tmp/fused_ab_xla.err || { echo "XLA bench failed"; exit 1; }
+BA_TPU_FUSED_SWEEP=1 BA_TPU_BENCH_DETAIL=/tmp/fused_ab_fused.json \
+    python bench.py --configs sweep10k_signed > /dev/null \
+    2> /tmp/fused_ab_fused.err || { echo "fused bench failed"; exit 1; }
+python - /tmp/fused_ab_xla.json /tmp/fused_ab_fused.json \
+    > "FUSED_AB_r${N}.json" <<'EOF'
 import json, sys
-xla = json.loads(sys.argv[1])["configs"]["sweep10k_signed"]
-fused = json.loads(sys.argv[2])["configs"]["sweep10k_signed"]
+xla = json.load(open(sys.argv[1]))["configs"]["sweep10k_signed"]
+fused = json.load(open(sys.argv[2]))["configs"]["sweep10k_signed"]
 out = {
     "metric": "fused-sweep-ab",
     "xla": {k: xla[k] for k in ("rounds_per_sec", "elapsed_s",
