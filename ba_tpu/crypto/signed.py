@@ -110,7 +110,7 @@ def order_message(instance: int, value: int) -> bytes:
 
 
 def sign_value_tables(
-    sks: list[bytes], pks: np.ndarray, n_values: int = 2
+    sks: list[bytes], pks: np.ndarray, n_values: int = 2, base: int = 0
 ) -> tuple[np.ndarray, np.ndarray]:
     """Per-(instance, value) signature tables: ``n_values`` signs per commander.
 
@@ -119,6 +119,12 @@ def sign_value_tables(
     these tables: msgs uint8 [B, V, MSG_LEN], sigs uint8 [B, V, 64].
     Equivocation = two honestly-signed contradictory claims — exactly the
     paper's faulty-commander power.
+
+    ``base`` offsets the instance ids bound into the messages: row b signs
+    claims for instance ``base + b``.  Chunked setups
+    (``setup_signed_tables_overlapped``) MUST pass their chunk offset here
+    — a chunk signed with local ids would re-bind instances 0..chunk-1 and
+    void the anti-cross-instance-replay binding (module docstring).
     """
     B = len(sks)
     # Vectorized order_message: byte-identical to the per-call encoder
@@ -128,7 +134,7 @@ def sign_value_tables(
     msgs = np.zeros((B, n_values, MSG_LEN), np.uint8)
     msgs[:, :, 0:4] = np.frombuffer(_MAGIC, np.uint8)
     msgs[:, :, 4:8] = (
-        np.arange(B, dtype="<u4").view(np.uint8).reshape(B, 1, 4)
+        np.arange(base, base + B, dtype="<u4").view(np.uint8).reshape(B, 1, 4)
     )
     msgs[:, :, 8] = np.arange(n_values, dtype=np.uint8)[None, :]
     nat = _native_or_none()
@@ -271,6 +277,89 @@ def verify_received(pks, msgs, sigs):
         for o in range(0, total + pad, chunk)
     ]
     return jnp.concatenate(oks)[:total].reshape(B, n)
+
+
+def setup_signed_tables_overlapped(
+    batch: int,
+    seed: int = 0,
+    chunks: int = 4,
+):
+    """Key-set setup with host signing OVERLAPPED against device verify.
+
+    The sweep north star's one-time setup used to be strictly sequential:
+    sign all 2*batch table signatures on the host, then upload + verify
+    them on device — so the wall clock paid sign_time + verify_time
+    (BENCH_r03: 0.33 s + 0.19 s for batch=10240).  Device dispatches on
+    this backend return on ACK (the queue drains only at a host fetch), so
+    chunking the batch lets chunk c's upload+verify execute on the chip
+    while the host is already signing chunk c+1: the wall clock tends to
+    max(sign, verify) + one chunk's drain instead of the sum.
+
+    Each chunk is the same shape, so the verify kernel compiles once (at
+    the chunk's own lane count — no padding to the 64k production chunk);
+    callers warm that shape off the clock with ``warm_signed_tables``.
+
+    Returns ``(sks, pks, msgs_t, sigs_t, ok, timings)`` where timings has
+    ``keys_s`` (keygen), ``sign_s`` (host signing, sum over chunks),
+    ``drain_s`` (wall time from last sign to verified mask on host — the
+    un-overlapped residual), and ``total_s`` (whole setup wall clock).
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    if not 1 <= chunks <= batch:
+        raise ValueError(f"chunks={chunks} out of range for batch={batch}")
+    t_start = time.perf_counter()
+    sks, pks = commander_keys(batch, seed)
+    t_keys = time.perf_counter() - t_start
+    per = -(-batch // chunks)
+    sign_s = 0.0
+    msgs_parts, sigs_parts, oks = [], [], []
+    for lo in range(0, batch, per):
+        hi = min(batch, lo + per)
+        t0 = time.perf_counter()
+        m_c, s_c = sign_value_tables(sks[lo:hi], pks[lo:hi], base=lo)
+        sign_s += time.perf_counter() - t0
+        msgs_parts.append(m_c)
+        sigs_parts.append(s_c)
+        pk_c = pks[lo:hi]
+        if hi - lo < per:  # pad the tail chunk so every dispatch shares
+            pad = per - (hi - lo)  # one compiled shape (warmed off-clock)
+            pk_c = np.concatenate([pk_c, np.tile(pk_c[:1], (pad, 1))])
+            m_c = np.concatenate([m_c, np.tile(m_c[:1], (pad, 1, 1))])
+            s_c = np.concatenate([s_c, np.tile(s_c[:1], (pad, 1, 1))])
+        oks.append(verify_received(pk_c, m_c, s_c)[: hi - lo])
+    t_signed = time.perf_counter()
+    ok = jnp.concatenate(oks) if len(oks) > 1 else oks[0]
+    jax.device_get(ok)  # host fetch: genuinely drain the verify queue
+    t_end = time.perf_counter()
+    msgs_t = np.concatenate(msgs_parts)
+    sigs_t = np.concatenate(sigs_parts)
+    timings = {
+        "keys_s": t_keys,
+        "sign_s": sign_s,
+        "drain_s": t_end - t_signed,
+        "total_s": t_end - t_start,
+        "chunks": len(oks),
+    }
+    return sks, pks, msgs_t, sigs_t, ok, timings
+
+
+def warm_signed_tables(batch: int, chunks: int = 4) -> None:
+    """Compile/warm the chunk-shaped verify program off the clock.
+
+    Same chunk shape as ``setup_signed_tables_overlapped`` will dispatch,
+    content from a throwaway key-set (the tunnel backend memoizes only
+    byte-identical repeats, and real setups use different keys/content).
+    """
+    per = -(-batch // chunks)
+    sks, pks = commander_keys(per, seed=987654321)
+    m_c, s_c = sign_value_tables(sks, pks)
+    import jax
+
+    jax.device_get(verify_received(pks, m_c, s_c))
 
 
 def sig_valid_from_tables(ok, received):

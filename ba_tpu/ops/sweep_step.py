@@ -19,7 +19,12 @@ whole step for a [TILE, n] block of instances inside VMEM:
   discipline of core/rng.uniform_u8);
 - the per-round reductions (honest-held flags, traitor-holder counts) and
   the final majority/quorum math are row reductions over the lane axis,
-  fused with everything else.
+  fused with everything else;
+- ``rounds`` chains up to 15 independent agreement rounds in ONE dispatch
+  (state planes read once, PRNG stream continuing, one packed decision
+  column written), dividing the per-dispatch tunnel/grid overhead by the
+  round count — the r4 answer to SWEEP_STAGES_r3.json's finding that
+  dispatch, not compute, bounds the fused step.
 
 Semantics mirror the XLA path op-for-op (round1_broadcast ->
 sig_valid_from_tables -> _initial_seen & sig_valid ->
@@ -53,7 +58,7 @@ LANES = 128
 
 
 def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
-                 ok_r_ref, ok_a_ref, dec_ref, *, m: int):
+                 ok_r_ref, ok_a_ref, dec_ref, *, m: int, rounds: int):
     T, N = faulty_ref.shape
     pltpu.prng_seed(seed_ref[0], pl.program_id(0))
 
@@ -65,82 +70,95 @@ def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
     iota = jax.lax.broadcasted_iota(jnp.int32, (T, N), 1)
     is_leader = iota == leader  # [T, N] bool
 
-    # Round 1: honest leader pushes order; faulty leader flips a coin per
-    # recipient (ba.py:268-273); the leader itself holds the true order.
     leader_faulty = jnp.sum(
         jnp.where(is_leader, faulty, 0), axis=1, keepdims=True
     )  # [T, 1]
-    coin = (
-        pltpu.bitcast(pltpu.prng_random_bits((T, N)), jnp.int32) & 1
-    )
-    received = jnp.where(leader_faulty > 0, coin, order)
-    received = jnp.where(is_leader, order, received)
-
-    # Signature gate: per-copy validity from the per-value table verdicts
-    # (crypto/signed.sig_valid_from_tables, the V=2 broadcast select).
-    sig_ok = jnp.where(received == ATTACK, ok_a_ref[:], ok_r_ref[:])
-
-    # Initial V-sets (core/sm._initial_seen, sig-gated).
-    gate = alive * sig_ok
-    seen_r = jnp.where(received == RETREAT, gate, 0)
-    seen_a = jnp.where(received == ATTACK, gate, 0)
-
     honest = alive * (1 - faulty)
     traitor = alive * faulty
     t = jnp.sum(traitor, axis=1, keepdims=True)  # coalition size [T, 1]
 
-    # m collapsed relay rounds (core/sm.sm_relay_rounds_collapsed): the OR
-    # of k traitor-holder coins is Bernoulli(1 - 2^-k), realised as an
-    # 8-bit threshold draw (core/rng.or_coin_threshold8: exact for k <= 8,
-    # saturating beyond with error <= 2^-9 per draw).  The honest-held OR
-    # (``incoming = draw | held_honest``) is folded into the threshold:
-    # held => thresh 256 > any u8, i.e. "fire always" — this keeps every
-    # per-instance flag an int32 column (narrow i1/int8 vectors hit a
-    # Mosaic relayout bug; see ops/majority.py).
-    for r in range(1, m + 1):
-        draws = pltpu.bitcast(pltpu.prng_random_bits((T, N)), jnp.int32)
-        u_r = draws & 0xFF
-        u_a = (draws >> 8) & 0xFF
-        new_planes = []
-        for seen, u in ((seen_r, u_r), (seen_a, u_a)):
-            held_cnt = jnp.sum(seen * honest, axis=1, keepdims=True)
-            k = jnp.sum(seen * traitor, axis=1, keepdims=True)
-            t8 = jnp.where(k > 8, 256, 256 - (256 >> jnp.minimum(k, 8)))
-            thresh = jnp.where(
-                held_cnt > 0, 256, jnp.where(r < t, t8, 0)
-            )  # chain bound: coalition-only reveal needs r < t
-            new_planes.append(jnp.where(u < thresh, alive, seen * alive))
-        seen_r, seen_a = new_planes
+    # ``rounds`` independent agreement rounds per dispatch, batch-resident:
+    # the state planes are read once, the PRNG stream simply continues
+    # across rounds (iid draws), and each round's decision packs into 2
+    # bits of the int32 output column (decisions are in {0, 1, 2}; 15
+    # rounds fit 30 bits).  Round 0's draw order is identical to the
+    # single-round kernel, so rounds=1 is bit-compatible with r3's kernel.
+    acc = jnp.zeros((T, 1), jnp.int32)
+    for _rr in range(rounds):
+        # Round 1: honest leader pushes order; faulty leader flips a coin
+        # per recipient (ba.py:268-273); the leader holds the true order.
+        coin = (
+            pltpu.bitcast(pltpu.prng_random_bits((T, N)), jnp.int32) & 1
+        )
+        received = jnp.where(leader_faulty > 0, coin, order)
+        received = jnp.where(is_leader, order, received)
 
-    # choice(V) (core/sm.sm_choice): |V|==1 -> the value, else UNDEFINED;
-    # the leader reports its own order (Q1 parity).
-    has_r = seen_r > 0
-    has_a = seen_a > 0
-    maj = jnp.where(
-        has_a & ~has_r,
-        jnp.int32(ATTACK),
-        jnp.where(has_r & ~has_a, jnp.int32(RETREAT), jnp.int32(UNDEFINED)),
-    )
-    maj = jnp.where(is_leader, order, maj)
+        # Signature gate: per-copy validity from the per-value table
+        # verdicts (crypto/signed.sig_valid_from_tables, V=2 select).
+        sig_ok = jnp.where(received == ATTACK, ok_a_ref[:], ok_r_ref[:])
 
-    # Majority-of-majorities over alive nodes + quorum thresholds with the
-    # reference's overrides (core/quorum, ba.py:197-255).
-    n_a = jnp.sum(jnp.where(maj == ATTACK, alive, 0), axis=1, keepdims=True)
-    n_r = jnp.sum(jnp.where(maj == RETREAT, alive, 0), axis=1, keepdims=True)
-    n_u = jnp.sum(jnp.where(maj == UNDEFINED, alive, 0), axis=1, keepdims=True)
-    total = n_a + n_r + n_u
-    needed = 2 * ((total - 1) // 3) + 1
-    needed = jnp.where(total <= 3, total - 1, needed)
-    needed = jnp.where(total == 1, 1, needed)
-    dec = jnp.where(
-        needed <= n_r,
-        jnp.int32(RETREAT),
-        jnp.where(needed <= n_a, jnp.int32(ATTACK), jnp.int32(UNDEFINED)),
-    )
-    dec_ref[:] = jnp.where(total == 0, jnp.int32(UNDEFINED), dec)
+        # Initial V-sets (core/sm._initial_seen, sig-gated).
+        gate = alive * sig_ok
+        seen_r = jnp.where(received == RETREAT, gate, 0)
+        seen_a = jnp.where(received == ATTACK, gate, 0)
+
+        # m collapsed relay rounds (core/sm.sm_relay_rounds_collapsed):
+        # the OR of k traitor-holder coins is Bernoulli(1 - 2^-k),
+        # realised as an 8-bit threshold draw (core/rng.or_coin_threshold8:
+        # exact for k <= 8, saturating beyond with error <= 2^-9 per
+        # draw).  The honest-held OR (``incoming = draw | held_honest``)
+        # is folded into the threshold: held => thresh 256 > any u8, i.e.
+        # "fire always" — this keeps every per-instance flag an int32
+        # column (narrow i1/int8 vectors hit a Mosaic relayout bug; see
+        # ops/majority.py).
+        for r in range(1, m + 1):
+            draws = pltpu.bitcast(pltpu.prng_random_bits((T, N)), jnp.int32)
+            u_r = draws & 0xFF
+            u_a = (draws >> 8) & 0xFF
+            new_planes = []
+            for seen, u in ((seen_r, u_r), (seen_a, u_a)):
+                held_cnt = jnp.sum(seen * honest, axis=1, keepdims=True)
+                k = jnp.sum(seen * traitor, axis=1, keepdims=True)
+                t8 = jnp.where(k > 8, 256, 256 - (256 >> jnp.minimum(k, 8)))
+                thresh = jnp.where(
+                    held_cnt > 0, 256, jnp.where(r < t, t8, 0)
+                )  # chain bound: coalition-only reveal needs r < t
+                new_planes.append(jnp.where(u < thresh, alive, seen * alive))
+            seen_r, seen_a = new_planes
+
+        # choice(V) (core/sm.sm_choice): |V|==1 -> the value, else
+        # UNDEFINED; the leader reports its own order (Q1 parity).
+        has_r = seen_r > 0
+        has_a = seen_a > 0
+        maj = jnp.where(
+            has_a & ~has_r,
+            jnp.int32(ATTACK),
+            jnp.where(has_r & ~has_a, jnp.int32(RETREAT), jnp.int32(UNDEFINED)),
+        )
+        maj = jnp.where(is_leader, order, maj)
+
+        # Majority-of-majorities over alive nodes + quorum thresholds with
+        # the reference's overrides (core/quorum, ba.py:197-255).
+        n_a = jnp.sum(jnp.where(maj == ATTACK, alive, 0), axis=1, keepdims=True)
+        n_r = jnp.sum(jnp.where(maj == RETREAT, alive, 0), axis=1, keepdims=True)
+        n_u = jnp.sum(jnp.where(maj == UNDEFINED, alive, 0), axis=1, keepdims=True)
+        total = n_a + n_r + n_u
+        needed = 2 * ((total - 1) // 3) + 1
+        needed = jnp.where(total <= 3, total - 1, needed)
+        needed = jnp.where(total == 1, 1, needed)
+        dec = jnp.where(
+            needed <= n_r,
+            jnp.int32(RETREAT),
+            jnp.where(needed <= n_a, jnp.int32(ATTACK), jnp.int32(UNDEFINED)),
+        )
+        dec = jnp.where(total == 0, jnp.int32(UNDEFINED), dec)
+        acc = acc * 4 + dec
+    dec_ref[:] = acc
 
 
-@functools.partial(jax.jit, static_argnames=("m", "tile", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("m", "rounds", "tile", "interpret")
+)
 def fused_signed_sweep_step(
     seed: jnp.ndarray,
     order: jnp.ndarray,
@@ -149,11 +167,19 @@ def fused_signed_sweep_step(
     alive: jnp.ndarray,
     ok: jnp.ndarray,
     m: int = 3,
+    rounds: int = 1,
     *,
     tile: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """One fused signed-sweep agreement round -> decisions [B] int8.
+    """``rounds`` fused signed-sweep agreement rounds in ONE dispatch.
+
+    Returns decisions [B] int8 for rounds=1 (r3-bit-compatible), else
+    [B, rounds] int8 — column r is round r's independent decision.  The
+    state planes stay VMEM-resident across all rounds, so per-dispatch
+    overhead (tunnel latency, grid setup, state reads) amortizes by
+    ``rounds``; the kernel packs each round's {0,1,2} decision into 2 bits
+    of its int32 output, bounding rounds at 15 per dispatch.
 
     seed: int32 [1] (vary per step — the kernel folds in the tile index);
     order [B] int8/int32; leader [B] int32; faulty/alive [B, n] bool;
@@ -162,6 +188,9 @@ def fused_signed_sweep_step(
     tile = TILE if tile is None else tile  # explicit 0 is a loud error below
     if tile <= 0:
         raise ValueError(f"tile={tile} must be positive")
+    if not 1 <= rounds <= 15:
+        raise ValueError(f"rounds={rounds} outside [1, 15] (2 bits/round "
+                         "of the packed int32 output)")
     B, n = faulty.shape
     b_pad = -(-B // tile) * tile
     n_pad = -(-n // LANES) * LANES
@@ -177,7 +206,7 @@ def fused_signed_sweep_step(
     vcol = pl.BlockSpec((tile, 1), col, memory_space=pltpu.VMEM)
     vplane = pl.BlockSpec((tile, n_pad), col, memory_space=pltpu.VMEM)
     out = pl.pallas_call(
-        functools.partial(_step_kernel, m=m),
+        functools.partial(_step_kernel, m=m, rounds=rounds),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # seed [1]
@@ -200,7 +229,11 @@ def fused_signed_sweep_step(
         pad1(ok[:, 0]),
         pad1(ok[:, 1]),
     )
-    return out[:B, 0].astype(COMMAND_DTYPE)
+    acc = out[:B, 0]
+    if rounds == 1:
+        return acc.astype(COMMAND_DTYPE)
+    shifts = 2 * (rounds - 1 - jnp.arange(rounds, dtype=jnp.int32))
+    return ((acc[:, None] >> shifts[None, :]) & 3).astype(COMMAND_DTYPE)
 
 
 def fused_sharded_sweep_step(
@@ -212,6 +245,7 @@ def fused_sharded_sweep_step(
     alive: jnp.ndarray,
     ok: jnp.ndarray,
     m: int = 3,
+    rounds: int = 1,
 ) -> jnp.ndarray:
     """The fused step over a multi-chip mesh: instances shard on "data".
 
@@ -243,21 +277,21 @@ def fused_sharded_sweep_step(
             # of 1 would replay shard k's streams as shard k-1's next step.
             return fused_signed_sweep_step(
                 seed + idx * jnp.int32(-1640531527),  # 0x9E3779B9 as int32
-                order, leader, faulty, alive, ok, m,
+                order, leader, faulty, alive, ok, m, rounds,
             )
 
         return jax.shard_map(
             local,
             mesh=mesh,
             in_specs=(P(), pspec, pspec, row, row, row),
-            out_specs=pspec,
+            out_specs=pspec if rounds == 1 else row,
             # The pallas_call inside has no vma annotation on its outputs;
             # replication checking has nothing to verify here anyway (the
             # kernel writes purely shard-local decisions).
             check_vma=False,
         )
 
-    fn = cached_jit(("fused_sweep", mesh, faulty.shape, m), build)
+    fn = cached_jit(("fused_sweep", mesh, faulty.shape, m, rounds), build)
     args = [
         put_global(mesh, x, s)
         for x, s in (

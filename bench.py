@@ -204,11 +204,27 @@ def bench_sm1_n64_signed(jax, jnp, jr):
     vjit = jax.jit(verify)
     first = jax.device_get(vjit(*variants[0]))
     assert bool(jnp.all(first)), "bench signatures must all verify"
-    v_elapsed = _timed(
-        lambda *a: vjit(*a), lambda i: variants[i % len(variants)],
-        v_iters, reps=v_reps,
-    )
+    # Same-window roofline: verify reps INTERLEAVED with field-mul probe
+    # reps (see make_fieldmul_probe) so numerator and denominator share
+    # one service window — the r3 pct_of_peak doubled with the weather
+    # because the two sides were measured in different windows.
+    fm_fn, fm_variants, fm_per_dispatch = make_fieldmul_probe(jax, jnp, jr)
+    jax.device_get(fm_fn(*fm_variants[0]))  # compile/warm off the clock
+    fm_iters = 3
+    v_elapsed = fm_elapsed = float("inf")
+    for r in range(v_reps):
+        v_elapsed = min(v_elapsed, _timed(
+            lambda *a: vjit(*a),
+            lambda i, _r=r: variants[(_r * v_iters + i) % len(variants)],
+            v_iters, reps=1,
+        ))
+        fm_elapsed = min(fm_elapsed, _timed(
+            fm_fn,
+            lambda i, _r=r: fm_variants[(_r * fm_iters + i) % len(fm_variants)],
+            fm_iters, reps=1,
+        ))
     verifies_per_sec = nv * v_iters / v_elapsed
+    fieldmul_peak_per_sec = fm_per_dispatch * fm_iters / fm_elapsed
 
     # (b) the full signed agreement round on-device (verify mask reused —
     # commander signatures are per-(instance, value), already checked).
@@ -222,13 +238,13 @@ def bench_sm1_n64_signed(jax, jnp, jr):
     key = make_key(3)
     iters = 20
     elapsed = _timed(step, lambda i: (jr.fold_in(key, i),), iters)
-    # ~1.7M int32 multiplies per verify: ~3.6k field muls — 4-bit-window
-    # [h]A ladder (2.5k: 256 doublings + 64 window adds + 14 table adds),
-    # 63-add fixed-base [S]B tree (0.6k), 2 decompression pow-chains
-    # (0.55k) — x 484 limb products each (22x22 schoolbook; carry/fold
-    # passes are shifts, not multiplies).  Cross-checked against XLA's own
-    # op count below when the backend exposes cost analysis.
-    est_mults = 1.7e6
+    # ~3.66k field muls per verify: 4-bit-window [h]A ladder (~2.56k: 64
+    # windows x ~38 muls + 14 table-build adds x 9), 63-add fixed-base
+    # [S]B tree (~0.57k), 2 decompression pow-chains (~0.52k), finishing
+    # add + projective eq (~0.01k).  Each field mul is 484 int32 limb
+    # products + carry/fold shift-adds; the probe chains the same p_mul
+    # primitive, so achieved/peak is unit-consistent by construction.
+    fmuls_per_verify = 3.66e3
     try:  # XLA's op count from the LOWERED module — pre-compile, so the
         # big verify program is not compiled a second time just for this
         # (an AOT .compile() does not share jit's executable cache and
@@ -239,10 +255,7 @@ def bench_sm1_n64_signed(jax, jnp, jr):
         xla_flops_per_verify = round(float(ca["flops"]) / nv, 1)
     except Exception:
         xla_flops_per_verify = None
-    gmults = verifies_per_sec * est_mults / 1e9
-    # Roofline denominator: the measured (not assumed) VPU int32-multiply
-    # peak, so "compute bound" is falsifiable (VERDICT r2 missing #4).
-    peak = bench_vpu_int32_peak(jax, jnp, jr)
+    achieved_fmuls = verifies_per_sec * fmuls_per_verify
     return {
         "xla_flops_per_verify": xla_flops_per_verify,
         "rounds_per_sec": round(batch * iters / elapsed, 1),
@@ -250,15 +263,22 @@ def bench_sm1_n64_signed(jax, jnp, jr):
         "verify_batch": nv, "batch": batch, "n": n, "m": m,
         "iters": iters, "elapsed_s": round(elapsed, 4),
         "verify_elapsed_s": round(v_elapsed, 4),
-        "est_int32_gmults_per_sec": round(gmults, 1),
-        "vpu_int32_peak": peak,
-        "pct_of_measured_peak": round(
-            100 * gmults / peak["measured_gmults_per_sec"], 1
+        "fieldmuls_per_verify_est": fmuls_per_verify,
+        "achieved_fieldmuls_per_sec": round(achieved_fmuls, 1),
+        "fieldmul_peak_per_sec": round(fieldmul_peak_per_sec, 1),
+        "est_int32_gmults_per_sec": round(
+            achieved_fmuls * 484 / 1e9, 1
         ),
-        "bound": "compute (int32 limb multiplies; >100% of the VPU-only "
-                 "peak is possible because the int8 table-gather einsums "
-                 "and conv matmuls carry part of the multiply work on the "
-                 "MXU — the est counts them as if they were VPU lanes)",
+        "pct_of_fieldmul_peak": round(
+            100 * achieved_fmuls / fieldmul_peak_per_sec, 1
+        ),
+        "bound": "compute (GF(2^255-19) multiplies; the roofline "
+                 "denominator is a same-window Pallas p_mul chain at "
+                 "full VMEM occupancy — same primitive, same unit, "
+                 "interleaved reps, so the ratio is <=100% up to noise "
+                 "and the gap to 100% is non-mul overhead: point-add "
+                 "adds/selects, sha512, decompress root choice, output "
+                 "plumbing)",
     }
 
 
@@ -332,9 +352,8 @@ def bench_n1024_m32(jax, jnp, jr):
 def bench_sweep10k_signed(jax, jnp, jr):
     from ba_tpu.core import sm_agreement
     from ba_tpu.crypto.signed import (
-        commander_keys,
-        sign_value_tables,
-        verify_received,
+        setup_signed_tables_overlapped,
+        warm_signed_tables,
     )
     from ba_tpu.parallel import bucketed_sweep_states
 
@@ -351,31 +370,30 @@ def bench_sweep10k_signed(jax, jnp, jr):
     bucket_caps = [int(s.faulty.shape[1]) for s in states]
     bucket_sizes = [int(s.faulty.shape[0]) for s in states]
 
-    # Warm the host signer before the setup timer: first use may compile
-    # the native .so (g++, ~0.3-0.5 s) and build the fixed-base window
-    # table — process-lifetime costs, the host-side analogue of the XLA
-    # compile that the device warmup below already excludes.  Per-KEY-SET
-    # costs (keygen + 2 signs/instance + table verify) stay on the clock.
-    sign_value_tables(*commander_keys(1))
+    # Warm the host signer AND the chunk-shaped verify program before the
+    # setup timer: native .so compile, fixed-base window table, and the
+    # XLA/Mosaic verify compile are process-lifetime costs (the host-side
+    # analogue of the device warmup below).  Per-KEY-SET costs (keygen +
+    # 2 signs/instance + table verify) stay on the clock.
+    setup_chunks = int(os.environ.get("BA_TPU_BENCH_SETUP_CHUNKS", 4))
+    warm_signed_tables(batch, setup_chunks)
 
-    # One-time setup, off the clock: per-instance keys, 2 signs each, and
-    # one device verify of each distinct signature ([B, 2] tables).
-    t0 = time.perf_counter()
-    sks, pks = commander_keys(batch)
-    msgs_t, sigs_t = sign_value_tables(sks, pks)
-    setup_sign_s = time.perf_counter() - t0
-    # Warm the verify kernel on a same-shape but different-content call:
-    # shape-identical so the one-time XLA/Mosaic compile is not billed as
-    # throughput, content-distinct because the tunnel backend memoizes
-    # repeat dispatches of byte-identical buffers (see bench_sm1 note).
-    warm_sigs = sigs_t.copy()
-    warm_sigs[..., 0] ^= 0xFF
-    jax.device_get(verify_received(pks, msgs_t, warm_sigs))
-    t0 = time.perf_counter()
-    ok = verify_received(pks, msgs_t, sigs_t)  # [B, 2]
-    jax.device_get(ok)  # host fetch: truly drain (see _timed)
-    setup_verify_s = time.perf_counter() - t0
-    table_verifies_per_sec = 2 * batch / setup_verify_s
+    # One-time setup, ON the clock: per-instance keys, 2 signs each, and
+    # the device verify of each distinct signature ([B, 2] tables) —
+    # chunked so signing chunk c+1 overlaps chunk c's upload+verify on
+    # device (VERDICT r3 #1: the sequential form paid sign + verify in
+    # full; the residual after the last sign is ``drain_s``).
+    sks, pks, msgs_t, sigs_t, ok, setup_t = setup_signed_tables_overlapped(
+        batch, chunks=setup_chunks
+    )
+    setup_sign_s = setup_t["keys_s"] + setup_t["sign_s"]
+    # setup_verify_s is the verify cost the setup WALL CLOCK still pays
+    # after overlap (the drain residual) — not the device-verify execution
+    # time r3 reported under this key; the incl_sign rate below replaces
+    # r3's table_verifies_per_sec under a new name so artifact comparisons
+    # can't mistake the accounting change for a regression.
+    setup_verify_s = setup_t["drain_s"]
+    setup_verifies_per_sec_incl_sign = 2 * batch / setup_t["total_s"]
 
     # The timed step is the whole per-round signed pipeline on device:
     # round-1 equivocation broadcast -> per-copy signature-mask gather from
@@ -402,6 +420,12 @@ def bench_sweep10k_signed(jax, jnp, jr):
 
     fused_env = os.environ.get("BA_TPU_FUSED_SWEEP", "auto")
     use_fused = fused_env == "1" or (fused_env == "auto" and use_pallas())
+    # Rounds per fused dispatch (BA_TPU_FUSED_ROUNDS): the state planes
+    # stay VMEM-resident and the per-dispatch overhead divides by K
+    # (ops/sweep_step.py multi-round kernel).  The XLA path is one round
+    # per call, so K applies only when fused.
+    fused_rounds = int(os.environ.get("BA_TPU_FUSED_ROUNDS", 8))
+    rounds_per_step = fused_rounds if use_fused else 1
     if use_fused:
         from ba_tpu.ops.sweep_step import fused_signed_sweep_step
 
@@ -411,7 +435,7 @@ def bench_sweep10k_signed(jax, jnp, jr):
             )
             dec = fused_signed_sweep_step(
                 seed, state.order, state.leader, state.faulty, state.alive,
-                ok, m,
+                ok, m, fused_rounds,
             )
             return dec.astype(jnp.int32).sum()
     else:
@@ -443,20 +467,22 @@ def bench_sweep10k_signed(jax, jnp, jr):
     # Per round: m packed-u8 draw cubes [B, cap_bucket, 2] + seen rows.
     lane_rows = sum(b * c for b, c in zip(bucket_sizes, bucket_caps))
     bytes_round = lane_rows * (m * 2 + 8)
-    rps = batch * iters / elapsed
+    rounds_per_iter = batch * rounds_per_step
+    rps = rounds_per_iter * iters / elapsed
     # The honest north-star accounting (VERDICT r2 missing #1): a fresh
-    # key-set pays setup (host signing + the one device table-verify)
-    # before any round runs, so report rounds/s *including* setup at
-    # stated amortization horizons, plus the horizon where the
-    # including-setup rate crosses the 1M target.
-    setup_total = setup_sign_s + setup_verify_s
+    # key-set pays setup (keygen + host signing + the device table-verify,
+    # overlapped) before any round runs, so report rounds/s *including*
+    # setup at stated amortization horizons, plus the horizon where the
+    # including-setup rate crosses the 1M target.  An "iteration" here is
+    # one dispatch = rounds_per_step agreement rounds per instance.
+    setup_total = setup_t["total_s"]
     t_iter = elapsed / iters
     incl = {
-        f"h{h}": round(batch * h / (setup_total + h * t_iter), 1)
+        f"h{h}": round(rounds_per_iter * h / (setup_total + h * t_iter), 1)
         for h in (50, 100, 500, 5000)
     }
-    if batch / 1e6 > t_iter:
-        crossover = setup_total / (batch / 1e6 - t_iter)
+    if rounds_per_iter / 1e6 > t_iter:
+        crossover = setup_total / (rounds_per_iter / 1e6 - t_iter)
         crossover_iters = int(crossover) + 1
     else:
         crossover_iters = None  # never crosses at this throughput
@@ -469,19 +495,111 @@ def bench_sweep10k_signed(jax, jnp, jr):
             for b, c in zip(bucket_sizes, bucket_caps)
         ],
         "fused_kernel": use_fused,
+        "fused_rounds_per_dispatch": rounds_per_step,
         "elapsed_s": round(elapsed, 4),
         "setup_sign_s": round(setup_sign_s, 2),
         "setup_verify_s": round(setup_verify_s, 2),
-        "table_verifies_per_sec": round(table_verifies_per_sec, 1),
+        "setup_total_s": round(setup_total, 2),
+        "setup_chunks": setup_t["chunks"],
+        "setup_verifies_per_sec_incl_sign": round(
+            setup_verifies_per_sec_incl_sign, 1
+        ),
         "rounds_per_sec_incl_setup": incl,
         "incl_setup_crossover_1M_iters": crossover_iters,
         "bytes_per_round_est": bytes_round,
-        "achieved_gbps_est": round(bytes_round * iters / elapsed / 1e9, 2),
+        "achieved_gbps_est": round(
+            bytes_round * rounds_per_step * iters / elapsed / 1e9, 2
+        ),
         "bound": "VPU throughput (packed-u8 RNG + elementwise relay; "
                  "far from HBM peak)",
-        "note": "signing+table-verify are one-time setup per key-set; "
-                "rounds_per_sec_incl_setup charges them at each horizon H "
-                "(batch*H / (setup + H*t_iter))",
+        "note": "signing+table-verify are one-time setup per key-set, "
+                "host-sign overlapped with device verify "
+                "(setup_verify_s = the un-overlapped drain residual); "
+                "rounds_per_sec_incl_setup charges setup_total_s at each "
+                "horizon H of fused-rounds dispatches",
+    }
+
+
+def bench_failover_sweep(jax, jnp, jr):
+    """On-device failure detection + re-election throughput (VERDICT r3
+    weak #6: the subsystem was tested and dry-run but never measured).
+
+    R rounds of kill -> detect dead leader -> re-elect lowest alive id ->
+    agree, all inside ONE lax.scan dispatch (``parallel.failover_sweep``
+    — the tensor-scale form of the reference's 0.1 s detect->elect loop,
+    ba.py:306-314), A/B'd same-window against the identical R-round OM(1)
+    scan WITHOUT the kill/election stage, so the reported overhead is the
+    re-election machinery itself, not window weather.  Kill schedule:
+    each node dies with p=2% per round (pre-staged on device, off the
+    clock), so most instances re-elect at least once across R rounds.
+    """
+    from ba_tpu.core import make_state
+    from ba_tpu.core.om import om1_round
+    from ba_tpu.core.quorum import majority_counts, quorum_decision
+    from ba_tpu.core.types import ATTACK
+    from ba_tpu.parallel import failover_sweep
+
+    batch = int(os.environ.get("BA_TPU_BENCH_FAILOVER_BATCH", 8192))
+    n, R, m = 64, 16, 1
+    faulty = jnp.zeros((batch, n), bool).at[:, 5].set(True)
+    state = make_state(batch, n, order=ATTACK, faulty=faulty)
+    # ~2%/node/round crash schedule; node 0 starts as leader, so a fair
+    # share of instances lose their leader mid-scan and re-elect.
+    import jax.random as _jr
+
+    kills = _jr.bernoulli(make_key(12), 0.02, (R, batch, n))
+
+    @jax.jit
+    def fail_step(key):  # state/kills closed over (seed-only dispatch)
+        out = failover_sweep(key, state, kills, m=m)
+        return (
+            out["decisions"].astype(jnp.int32).sum()
+            + out["leaders"].sum()
+        )
+
+    @jax.jit
+    def plain_step(key):
+        def one(acc, k):
+            majorities = om1_round(k, state)
+            n_a, n_r, n_u = majority_counts(majorities, state.alive)
+            d, _, _ = quorum_decision(n_a, n_r, n_u)
+            return acc + d.astype(jnp.int32).sum(), None
+
+        acc, _ = jax.lax.scan(one, jnp.int32(0), jr.split(key, R))
+        return acc
+
+    key = make_key(13)
+    jax.device_get(fail_step(key))  # compile/warm off the clock
+    jax.device_get(plain_step(key))
+    iters, reps = 10, 3
+    t_fail = t_plain = float("inf")
+    for r in range(reps):  # interleaved: drift cancels
+        t_fail = min(t_fail, _timed(
+            fail_step, lambda i, _r=r: (jr.fold_in(key, 2 * (_r * iters + i)),),
+            iters, reps=1,
+        ))
+        t_plain = min(t_plain, _timed(
+            plain_step,
+            lambda i, _r=r: (jr.fold_in(key, 2 * (_r * iters + i) + 1),),
+            iters, reps=1,
+        ))
+    rounds = batch * R * iters
+    bytes_round = batch * (2 * n * n + 5 * n + n)  # om1 cubes + kill plane
+    return {
+        "rounds_per_sec": round(rounds / t_fail, 1),
+        "plain_rounds_per_sec": round(rounds / t_plain, 1),
+        "reelection_overhead_pct": round(100 * (t_fail - t_plain) / t_plain, 1),
+        "batch": batch, "n": n, "m": m, "rounds_per_dispatch": R,
+        "iters": iters, "elapsed_s": round(t_fail, 4),
+        "kill_prob_per_round": 0.02,
+        "bytes_per_round_est": bytes_round,
+        "achieved_gbps_est": round(bytes_round * R * iters / t_fail / 1e9, 2),
+        "bound": "VPU elementwise (om1 answer cubes) + scan-carried "
+                 "alive/leader state; reference analogue: one detect->"
+                 "elect cycle per 0.1 s poll tick (ba.py:306-314)",
+        "note": "A/B same-window: plain = the identical R-round OM(1) "
+                "scan without kill/election; overhead pct is fail vs "
+                "plain",
     }
 
 
@@ -522,6 +640,120 @@ def bench_interactive_b1(jax, jnp, jr):
         "rounds": len(times), "n": n, "batch": 1,
         "reference_latency_s": "~0.2-0.3 (poll-loop floor, ba.py:287-301)",
         "bound": "per-dispatch tunnel latency (~50-100 ms), not compute",
+    }
+
+
+def make_fieldmul_probe(jax, jnp, jr):
+    """Field-multiply calibration probe: the roofline denominator for the
+    Ed25519 verify pipeline, in the verify's OWN unit (GF(2^255-19) muls/s)
+    and its own execution discipline.
+
+    VERDICT r3 weak #3: the old roofline divided verify's estimated raw
+    int32 multiplies by a separately-measured VPU multiply peak — two
+    different units (a field mul is 484 lane multiplies PLUS ~2x that in
+    carry/fold shifts and adds, some of which XLA/Mosaic schedules onto
+    the MXU via int8 einsums) measured in two different service windows,
+    which produced 108-198% "of peak" depending on the weather.  This
+    probe instead runs the SAME ``p_mul`` plane primitive the production
+    kernels use (ba_tpu.ops.planes), chained data-dependently inside one
+    Pallas kernel at full VMEM occupancy: achieved/peak is then a
+    like-for-like ratio, <= 100% up to measurement noise, and the caller
+    interleaves probe reps with verify reps so both sides share one
+    window.
+
+    Returns (fn, variants, fieldmuls_per_dispatch); fn is jitted and
+    returns a scalar (host-fetch-sync contract of ``_timed``), and
+    ``variants`` is a list of DEVICE-resident input tuples — staged here,
+    outside any timed loop, so probe dispatches never pay a host->device
+    upload through the tunnel (trap: multi-MB uploads inside timed loops
+    dominate silently).  Content differs per variant (tunnel memoization).
+    On non-Pallas backends the probe chains ``crypto.field.mul`` instead
+    (same unit, XLA discipline).
+    """
+    import numpy as np
+
+    from ba_tpu.crypto import field as F
+    from ba_tpu.utils.platform import use_pallas
+
+    depth = 512
+    rng = np.random.default_rng(11)
+
+    if use_pallas():
+        import functools
+
+        from jax.experimental import pallas as pl
+        from ba_tpu.ops.ladder import plane_spec, plane_out_shape, TILE
+        from ba_tpu.ops.planes import p_mul
+
+        lanes = 1 << 16  # 64 [8, 128] tiles
+
+        def kernel(a_ref, b_ref, o_ref):
+            a = [a_ref[i] for i in range(F.LIMBS)]
+            b = [b_ref[i] for i in range(F.LIMBS)]
+            a = jax.lax.fori_loop(
+                0, depth, lambda t, acc: p_mul(acc, b), a
+            )
+            for i in range(F.LIMBS):
+                o_ref[i] = a[i]
+
+        grid = lanes // TILE
+
+        @jax.jit
+        def fn(a, b):
+            out = pl.pallas_call(
+                kernel,
+                grid=(grid,),
+                in_specs=[plane_spec(F.LIMBS)] * 2,
+                out_specs=plane_spec(F.LIMBS),
+                out_shape=plane_out_shape(F.LIMBS, lanes),
+            )(a, b)
+            return out.astype(jnp.int32).sum()
+
+        def make_variant():
+            a = rng.integers(0, 1 << 12, (F.LIMBS, lanes // 128, 128))
+            b = rng.integers(0, 1 << 12, (F.LIMBS, lanes // 128, 128))
+            return jnp.asarray(a, jnp.int32), jnp.asarray(b, jnp.int32)
+
+    else:
+        lanes = 1 << 12  # CPU fallback: unit-correct, not a perf claim
+
+        @jax.jit
+        def fn(a, b):
+            def body(t, acc):
+                return F.mul(acc, b)
+
+            return jax.lax.fori_loop(0, depth, body, a).astype(
+                jnp.int32
+            ).sum()
+
+        def make_variant():
+            a = rng.integers(0, 1 << 12, (lanes, F.LIMBS))
+            b = rng.integers(0, 1 << 12, (lanes, F.LIMBS))
+            return jnp.asarray(a, jnp.int32), jnp.asarray(b, jnp.int32)
+
+    n_variants = int(os.environ.get("BA_TPU_FMUL_PROBE_VARIANTS", 12))
+    variants = [make_variant() for _ in range(n_variants)]
+    return fn, variants, lanes * depth
+
+
+def bench_fieldmul_peak(jax, jnp, jr):
+    """Standalone field-mul probe timing (see make_fieldmul_probe) for the
+    --stages artifact; bench_sm1 interleaves the same probe with its
+    verify reps instead of calling this."""
+    fn, variants, per_dispatch = make_fieldmul_probe(jax, jnp, jr)
+    iters = 3
+    elapsed = _timed(
+        fn, lambda i: variants[i % len(variants)], iters, reps=3
+    )
+    per_sec = per_dispatch * iters / elapsed
+    return {
+        "measured_fieldmuls_per_sec": round(per_sec, 1),
+        "gmults_equiv_per_sec": round(per_sec * 484 / 1e9, 1),
+        "fieldmuls_per_dispatch": per_dispatch,
+        "elapsed_s": round(elapsed, 4),
+        "note": "chained ops.planes.p_mul (schoolbook 484-MAC + "
+                "reduce/carry) at full VMEM occupancy — the unit-"
+                "consistent roofline denominator for the verify pipeline",
     }
 
 
@@ -726,6 +958,7 @@ CONFIGS = {
     "om3_n10": bench_om3_n10,
     "n1024_m32": bench_n1024_m32,
     "eig_n1024": bench_eig_n1024,
+    "failover_sweep": bench_failover_sweep,
     "sweep10k_signed": bench_sweep10k_signed,
     "sm1_n64_signed": bench_sm1_n64_signed,
 }
@@ -763,6 +996,7 @@ def main() -> None:
             "platform": jax.devices()[0].platform,
             "rng_impl": rng_impl(),
             "vpu_int32_peak": bench_vpu_int32_peak(jax, jnp, jr),
+            "fieldmul_peak": bench_fieldmul_peak(jax, jnp, jr),
             "stages": bench_verify_stages(jax, jnp, jr),
         }
         print(json.dumps(line))

@@ -1,0 +1,54 @@
+"""Shared harness for same-window fused-sweep A/Bs (tile_ab / rounds_ab).
+
+One process, parameter variants interleaved within each rep so tunnel
+service drift cancels; min-of-reps per variant.  Warm-up seeds are NEGATIVE
+(-1 - variant) so no warm dispatch can ever be byte-identical to a timed
+one (timed seeds are r*1000 + i, all >= 1) — a memoized repeat inside a
+timed window would fake throughput (bench.py's tunnel-memoization note).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def sweep_fixture(batch: int = 10240, cap: int = 1024, m: int = 3):
+    """The standard north-star A/B fixture: bucketed states + all-valid
+    table verdicts, split per bucket."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ba_tpu.parallel import bucketed_sweep_states
+
+    states = bucketed_sweep_states(jr.key(5), batch, cap, 2)
+    ok = jnp.ones((batch, 2), bool)
+    oks, off = [], 0
+    for s in states:
+        b = s.faulty.shape[0]
+        oks.append(ok[off:off + b])
+        off += b
+    return states, oks
+
+
+def interleaved_ab(steps: dict, iters: int, reps: int) -> dict:
+    """Time each jitted ``steps[variant]`` (seed [1] int32 -> scalar)
+    interleaved across variants; returns {variant: best elapsed_s}."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _timed  # the tunnel-safe timing single source of truth
+
+    for idx, step in enumerate(steps.values()):  # compile + warm, off clock
+        jax.device_get(step(jnp.asarray([-1 - idx], jnp.int32)))
+
+    best = {k: float("inf") for k in steps}
+    for r in range(reps):
+        for k, step in steps.items():
+            mk = lambda i, _r=r: (jnp.asarray([_r * 1000 + i], jnp.int32),)
+            best[k] = min(best[k], _timed(step, mk, iters, reps=1))
+    return best
+
+
+def emit(metric: str, batch: int, iters: int, variants: dict, **extra):
+    print(json.dumps({"metric": metric, "batch": batch, "iters": iters,
+                      **extra, "variants": variants}))

@@ -1,9 +1,11 @@
-"""Same-window tile-size A/B for the fused sweep kernel (one process,
+"""Same-window A/B over fused-kernel rounds-per-dispatch K (one process,
 interleaved reps so service drift cancels).  Run ALONE.
 
-TILE_AB_TILES picks the tile candidates; TILE_AB_ROUNDS sets the fused
-rounds-per-dispatch K the tiles are compared at (the production default
-should be A/B'd at the production K)."""
+K>1 chains K independent agreement rounds inside one kernel dispatch
+(ops/sweep_step.py), dividing per-dispatch overhead by K; this script
+measures where that amortization saturates.  Throughput is reported in
+agreement ROUNDS/s (batch * K per dispatch), so K values compare directly.
+ROUNDS_AB_K picks the candidates; ROUNDS_AB_TILE pins the kernel tile."""
 
 from __future__ import annotations
 
@@ -21,14 +23,14 @@ def main() -> None:
     from ab_common import emit, interleaved_ab, sweep_fixture
     from ba_tpu.ops.sweep_step import fused_signed_sweep_step
 
-    tiles = [int(t) for t in
-             os.environ.get("TILE_AB_TILES", "32,64,128,256").split(",")]
-    k_rounds = int(os.environ.get("TILE_AB_ROUNDS", 1))
+    rounds = [int(k) for k in
+              os.environ.get("ROUNDS_AB_K", "1,4,8,15").split(",")]
+    tile = int(os.environ.get("ROUNDS_AB_TILE", 0)) or None
     batch, m = 10240, 3
     iters, reps = 30, 3
     states, oks = sweep_fixture(batch)
 
-    def make_step(tile):
+    def make_step(k_rounds):
         @jax.jit
         def step(seed):
             acc = jnp.int32(0)
@@ -41,15 +43,15 @@ def main() -> None:
             return acc
         return step
 
-    best = interleaved_ab({t: make_step(t) for t in tiles}, iters, reps)
+    best = interleaved_ab({k: make_step(k) for k in rounds}, iters, reps)
     emit(
-        "fused-tile-ab", batch, iters,
+        "fused-rounds-ab", batch, iters,
         {
-            str(t): {"elapsed_s": round(e, 4),
-                     "rounds_per_sec": round(batch * k_rounds * iters / e, 1)}
-            for t, e in best.items()
+            str(k): {"elapsed_s": round(e, 4),
+                     "rounds_per_sec": round(batch * k * iters / e, 1)}
+            for k, e in best.items()
         },
-        rounds_per_dispatch=k_rounds,
+        tile=tile or "default",
     )
 
 

@@ -192,3 +192,29 @@ def test_signed_host_paths_agree():
             assert sigs[b, v].tobytes() == oracle.sign(
                 sks[b], pks[b].tobytes(), msgs[b, v].tobytes()
             )
+
+
+def test_overlapped_setup_matches_sequential_tables():
+    # The chunked, sign/verify-overlapped setup must produce BYTE-identical
+    # tables to one sequential sign_value_tables call: in particular every
+    # chunk's messages must bind the GLOBAL instance id (a chunk signed
+    # with local ids would re-bind instances 0..chunk-1 — the replay
+    # protection the message format exists for).
+    from ba_tpu.crypto.signed import (
+        commander_keys,
+        order_message,
+        setup_signed_tables_overlapped,
+        sign_value_tables,
+    )
+
+    B = 37  # uneven: exercises the padded tail chunk too
+    sks, pks = commander_keys(B)
+    want_msgs, want_sigs = sign_value_tables(sks, pks)
+    _, pks2, got_msgs, got_sigs, ok, _ = setup_signed_tables_overlapped(
+        B, chunks=4
+    )
+    np.testing.assert_array_equal(pks2, pks)
+    np.testing.assert_array_equal(got_msgs, want_msgs)
+    np.testing.assert_array_equal(got_sigs, want_sigs)
+    assert np.asarray(ok).all()
+    assert got_msgs[B - 1, 1].tobytes() == order_message(B - 1, 1)

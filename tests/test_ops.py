@@ -593,3 +593,100 @@ def test_fused_sharded_sweep_matches_unsharded():
         ok, m,
     ))
     np.testing.assert_array_equal(got, want)
+
+
+def test_fused_multi_round_bounds():
+    # The 2-bits-per-round packing caps rounds at 15; the wrapper must
+    # reject out-of-range values loudly at trace time (CPU-safe: the check
+    # runs before the pallas_call is built).
+    from ba_tpu.ops.sweep_step import fused_signed_sweep_step
+
+    o = jnp.zeros((8,), jnp.int8)
+    ldr = jnp.zeros((8,), jnp.int32)
+    f = jnp.zeros((8, 16), bool)
+    ok = jnp.ones((8, 2), bool)
+    for bad in (0, 16):
+        with pytest.raises(ValueError, match="rounds"):
+            fused_signed_sweep_step(
+                jnp.asarray([1], jnp.int32), o, ldr, f, f, ok, 1, bad
+            )
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="in-kernel PRNG needs real TPU")
+def test_fused_multi_round_first_round_bit_compatible():
+    # Round 0 of a rounds=K dispatch consumes the PRNG stream in exactly
+    # the order the single-round kernel does, so column 0 must equal the
+    # rounds=1 output bit-for-bit under the same seed.
+    import jax.random as jr
+
+    from ba_tpu.ops.sweep_step import fused_signed_sweep_step
+    from ba_tpu.parallel import make_sweep_state
+
+    B, cap, m = 1024, 64, 3
+    state = make_sweep_state(jr.key(30), B, cap)
+    ok = jnp.ones((B, 2), bool)
+    seed = jnp.asarray([31], jnp.int32)
+    single = np.asarray(fused_signed_sweep_step(
+        seed, state.order, state.leader, state.faulty, state.alive, ok, m,
+    ))
+    multi = np.asarray(fused_signed_sweep_step(
+        seed, state.order, state.leader, state.faulty, state.alive, ok, m, 8,
+    ))
+    assert multi.shape == (B, 8)
+    np.testing.assert_array_equal(multi[:, 0], single)
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="in-kernel PRNG needs real TPU")
+def test_fused_multi_round_matches_xla_no_traitors():
+    # Zero traitors => draw-independent => EVERY round's column must match
+    # the XLA composition bit-for-bit (the multi-round generalisation of
+    # test_fused_sweep_step_matches_xla_no_traitors).
+    import jax.random as jr
+
+    from ba_tpu.ops.sweep_step import fused_signed_sweep_step
+    from ba_tpu.parallel import make_sweep_state
+
+    B, cap, m = 512, 256, 3
+    state = make_sweep_state(jr.key(32), B, cap, max_traitor_frac=0.0)
+    ok = jnp.ones((B, 2), bool)
+    want = np.asarray(_xla_sweep_step(jr.key(33), state, ok, m))
+    multi = np.asarray(fused_signed_sweep_step(
+        jnp.asarray([34], jnp.int32), state.order, state.leader,
+        state.faulty, state.alive, ok, m, 6,
+    ))
+    for r in range(6):
+        np.testing.assert_array_equal(multi[:, r], want)
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="in-kernel PRNG needs real TPU")
+def test_fused_multi_round_rounds_are_independent():
+    # With equivocating leaders each round draws fresh coins, so columns
+    # must differ across rounds (live per-round randomness, not a copied
+    # round-0 result) while every column's histogram stays in the same
+    # 6-sigma band as the XLA composition's.
+    import jax.random as jr
+
+    from ba_tpu.ops.sweep_step import fused_signed_sweep_step
+    from ba_tpu.parallel import make_sweep_state
+
+    B, cap, m, R = 8192, 16, 2, 4
+    state = make_sweep_state(jr.key(35), B, cap)
+    faulty = np.array(state.faulty)
+    faulty[:, 0] = True  # every leader equivocates
+    state = type(state)(
+        state.order, state.leader, jnp.asarray(faulty), state.alive, state.ids
+    )
+    ok = jnp.ones((B, 2), bool)
+    want = np.asarray(_xla_sweep_step(jr.key(36), state, ok, m))
+    multi = np.asarray(fused_signed_sweep_step(
+        jnp.asarray([37], jnp.int32), state.order, state.leader,
+        state.faulty, state.alive, ok, m, R,
+    ))
+    h_want = np.bincount(want, minlength=3)
+    band = 6 * np.sqrt(B)
+    for r in range(R):
+        h_got = np.bincount(multi[:, r], minlength=3)
+        assert (np.abs(h_want - h_got) < band).all(), (r, h_want, h_got)
+    assert any(
+        (multi[:, r] != multi[:, 0]).any() for r in range(1, R)
+    )  # fresh coins per round
