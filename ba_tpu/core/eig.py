@@ -94,9 +94,23 @@ def eig_resolve(state: SimState, levels: list[jnp.ndarray]) -> jnp.ndarray:
     """
     B, n = state.faulty.shape
     m = len(levels) - 1
-    is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0  # [B, n]
     resolved = levels[m].reshape(B, n, n**m)
-    for level in range(m - 1, -1, -1):
+    return _resolve_from(state, levels, resolved, m)
+
+
+def _resolve_from(
+    state: SimState,
+    levels: list[jnp.ndarray],
+    resolved: jnp.ndarray,
+    start_level: int,
+) -> jnp.ndarray:
+    """The shared tail of the resolve fold: take ``resolved`` values at
+    ``start_level`` (dense path: the raw deepest level; fused path: the
+    output of eig_deepest_fused one level up) and fold the remaining
+    levels down to per-general majorities [B, n] int8."""
+    B, n = state.faulty.shape
+    is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0  # [B, n]
+    for level in range(start_level - 1, -1, -1):
         P = n**level
         children = resolved.reshape(B, n, P, n)
         in_path = jnp.asarray(_in_path_mask(n, level))  # [P, n] static
@@ -119,25 +133,172 @@ def eig_resolve(state: SimState, levels: list[jnp.ndarray]) -> jnp.ndarray:
     return majorities
 
 
-def eig_round(key: jax.Array, state: SimState, m: int) -> jnp.ndarray:
+def _binomial_half(key: jax.Array, k: jnp.ndarray, max_k: int) -> jnp.ndarray:
+    """Exact Binomial(k, 1/2) draws: popcount of the first k of max_k
+    random bits per lane.  k int32 [...] (0 <= k <= max_k) -> int32 [...].
+
+    The sum of k iid fair coins is all the resolve majority ever consumes,
+    so drawing the SUM directly replaces k per-coin tensors with
+    ceil(max_k/32) packed words per lane — the coin-collapse that makes
+    the fused deepest EIG level possible (same move as the collapsed SM
+    relay's OR-threshold, core/sm.py, but for counts instead of ORs).
+    """
+    W = -(-max_k // 32) if max_k > 0 else 1
+    words = jr.bits(key, (*k.shape, W), jnp.uint32)
+    base = jnp.arange(W, dtype=jnp.int32) * 32
+    nbits = jnp.clip(k[..., None] - base, 0, 32)
+    full = jnp.uint32(0xFFFFFFFF)
+    mask = jnp.where(
+        nbits >= 32, full,
+        (jnp.uint32(1) << nbits.astype(jnp.uint32)) - jnp.uint32(1),
+    )
+    return jax.lax.population_count(words & mask).astype(jnp.int32).sum(-1)
+
+
+def _path_digit_first(n: int, level: int) -> tuple[np.ndarray, np.ndarray]:
+    """Static per-position path digits + first-occurrence flags.
+
+    digits [level, P] int32: digit d of path p; first [level, P] bool:
+    True where position d is the FIRST occurrence of that digit value in
+    p.  The in-path exclusion is a SET (a relay appearing twice in a
+    degenerate path is excluded once — _in_path_mask semantics), so
+    per-position corrections must count each distinct digit value once.
+    """
+    P = n**level
+    p = np.arange(P)
+    digits = np.stack([(p // (n**d)) % n for d in range(level)])
+    first = np.ones((level, P), bool)
+    for d in range(level):
+        for e in range(d):
+            first[d] &= digits[d] != digits[e]
+    return digits.astype(np.int32), first
+
+
+def eig_deepest_fused(
+    key: jax.Array,
+    state: SimState,
+    levels: list[jnp.ndarray],
+    m: int,
+    max_liars: int | None = None,
+) -> jnp.ndarray:
+    """The deepest EIG resolve level WITHOUT materializing level m.
+
+    The dense path (eig_send + eig_resolve) builds V_m [B, n, n^m] — at
+    n=1024, m=2 a GiB-scale int8 tensor written, read and coin-matched
+    once (the r3 bench's HBM-bound 50 rounds/s).  But the deepest resolve
+    only consumes per-path TALLIES, and those decompose exactly:
+
+    - honest relays contribute their stored copies: an int8 einsum
+      ``n_att_h[b,i,p] = sum_j m1[b,i,j] * att[b,j,p]`` over the
+      [B, n, n^(m-1)] level-(m-1) tensor — MXU work, no n^m bytes;
+    - lying relays contribute iid fair coins, and a sum of k fair coins
+      is Binomial(k, 1/2) — drawn directly via popcount
+      (``_binomial_half``), collapsing the coin tensor n-fold;
+    - the in-path/self exclusions are per-digit elementwise corrections
+      (static gathers, first-occurrence-deduplicated for degenerate
+      repeated-digit paths).
+
+    Distributionally identical to the dense deepest level (majorities
+    depend on the tallies only; tallies have the same joint law), and
+    bit-identical to it when no general is faulty (coin-free).  Returns
+    ``resolved`` [B, n, n^(m-1)] ready for the remaining (small) resolve
+    levels.
+
+    ``max_liars`` sizes the popcount draw (default n-1, always safe;
+    pass the known traitor cap to shrink the random words 32x).  The
+    lying count is CLAMPED to it: a cap below the true count silently
+    draws Binomial(max_liars, 1/2) instead of Binomial(k, 1/2) —
+    under-dispersed tallies, a biased simulation.  Callers must derive
+    the cap from the state (bench does ``int(faulty.sum(-1).max())``),
+    never hardcode a guess.
+    """
+    B, n = state.faulty.shape
+    level = m - 1  # the resolve level being produced
+    P = n**level
+    if max_liars is None:
+        max_liars = n - 1
+    prev = levels[level].reshape(B, n, P)
+    att = (prev == ATTACK).astype(jnp.int8)  # relay j's copies, [B, j, P]
+    is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0
+    eligible = state.alive & ~is_leader  # [B, j]
+    eye = jnp.eye(n, dtype=bool)
+    # Honest-contribution weight: eligible j relaying truthfully to i
+    # (faulty j's self-copy stays honest — eig_send's ``lying`` mask).
+    m1 = eligible[:, None, :] & (~state.faulty[:, None, :] | eye[None])
+    lying = eligible[:, None, :] & state.faulty[:, None, :] & ~eye[None]
+    n_att = jnp.einsum(
+        "bij,bjp->bip", m1.astype(jnp.int8), att,
+        preferred_element_type=jnp.int32,
+    )
+    k = jnp.broadcast_to(
+        lying.sum(-1, dtype=jnp.int32)[:, :, None], (B, n, P)
+    )
+    n_elig = jnp.broadcast_to(
+        eligible.sum(-1, dtype=jnp.int32)[:, None, None], (B, n, P)
+    )
+    digits, firsts = _path_digit_first(n, level)
+    arP = jnp.arange(P)
+    for d in range(level):
+        dg = jnp.asarray(digits[d])  # [P]
+        fo = jnp.asarray(firsts[d])[None, None, :]  # [1, 1, P]
+        # att[b, dg[p], p]: relay dg[p]'s own copy for path p.
+        att_d = att.astype(jnp.int32)[:, dg, arP]  # [B, P]
+        m1_d = m1.astype(jnp.int32)[:, :, dg]  # [B, i, P]
+        n_att = n_att - jnp.where(fo, m1_d * att_d[:, None, :], 0)
+        k = k - jnp.where(fo, lying.astype(jnp.int32)[:, :, dg], 0)
+        n_elig = n_elig - jnp.where(
+            fo, eligible.astype(jnp.int32)[:, dg][:, None, :], 0
+        )
+    k = jnp.minimum(k, max_liars)
+    n_att = n_att + _binomial_half(key, k, max_liars)
+    n_ret = n_elig - n_att
+    resolved = strict_majority(n_att, n_ret)
+    # Degenerate clusters: no eligible relays -> own stored copy stands in
+    # (the OM(0) base case), exactly as eig_resolve's fallback.
+    return jnp.where(n_elig > 0, resolved, prev)
+
+
+def eig_round(
+    key: jax.Array, state: SimState, m: int, max_liars: int | None = None
+) -> jnp.ndarray:
     """Full OM(m) exchange -> per-general majorities [B, n] int8.
 
     m=0 degenerates to "trust the leader" (everyone's majority is what they
     received); m=1 is the reference's protocol.
+
+    For m >= 2 the deepest level runs FUSED (``eig_deepest_fused``): the
+    [B, n, n^m] tensor is never built, its honest tallies ride the MXU and
+    its coins collapse to Binomial draws — O(n^(m-1)) memory instead of
+    O(n^m), distributionally identical, bit-identical without traitors.
+    ``BA_TPU_EIG_FUSED=0`` restores the fully-dense path (the two are
+    differential-tested against each other).  m=1 always uses the dense
+    path, which is bit-exact with om1_round (test_eig.py pins that).
     """
+    import os
+
     if m == 0:
         # round1_broadcast already pins the leader slot to the true order.
         return round1_broadcast(key, state)
-    levels = eig_send(key, state, m)
-    return eig_resolve(state, levels)
+    fused = m >= 2 and os.environ.get("BA_TPU_EIG_FUSED", "1") != "0"
+    if not fused:
+        levels = eig_send(key, state, m)
+        return eig_resolve(state, levels)
+    k_send, k_coin = jr.split(key)
+    levels = eig_send(k_send, state, m - 1)  # levels 0..m-1 only
+    resolved = eig_deepest_fused(k_coin, state, levels, m, max_liars)
+    return _resolve_from(state, levels, resolved, m - 1)
 
 
-def eig_agreement(key: jax.Array, state: SimState, m: int):
+def eig_agreement(
+    key: jax.Array, state: SimState, m: int, max_liars: int | None = None
+):
     """OM(m) agreement + global quorum, the generalised ``actual-order``.
 
     Same output dict as ``om1_agreement`` (ba.py:376-399's hot path).
+    ``max_liars`` tightens the fused deepest level's popcount width when
+    the traitor cap is known (see eig_deepest_fused).
     """
-    majorities = eig_round(key, state, m)
+    majorities = eig_round(key, state, m, max_liars)
     n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
     decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
     return {

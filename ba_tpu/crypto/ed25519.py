@@ -276,6 +276,116 @@ def decompress(by: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
     return (xc, y, one, F.mul(xc, y)), ok
 
 
+def batch_point_sum(point: Point) -> Point:
+    """Sum a batch of points over the leading axis -> a 1-lane Point.
+
+    Log-depth halving tree of complete additions (identity-padded to the
+    next power of two), so the whole reduction costs ~B point adds total —
+    amortized ~9 field muls per lane, negligible next to any ladder.
+    """
+    B = point[0].shape[0]
+    size = 1 << max(1, (B - 1).bit_length())
+    if size != B:
+        ident = identity((size - B,))
+        point = tuple(
+            jnp.concatenate([c, i], axis=0) for c, i in zip(point, ident)
+        )
+    while size > 1:
+        half = size // 2
+        point = point_add(
+            tuple(c[:half] for c in point),
+            tuple(c[half:] for c in point),
+        )
+        size = half
+    return point
+
+
+def verify_rlc(
+    pk: jnp.ndarray,
+    msg: jnp.ndarray,
+    sig: jnp.ndarray,
+    z: jnp.ndarray,
+    pk_group: int = 1,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Random-linear-combination BATCH verification of B signatures.
+
+    Checks the single combined equation
+
+        [sum_i z_i S_i mod L] B  ==  sum_i [z_i] R_i  +  sum_j [W_j] A_j,
+        W_j = sum_{i in group j} z_i h_i mod L,
+
+    with caller-supplied random coefficients z [B, 16] uint8.  If every
+    signature is valid the equation holds identically; if any has a
+    defect d_i = S_i B - R_i - h_i A_i with a PRIME-ORDER component, the
+    combined check fails except with probability ~2^-125 over z (the
+    standard RLC soundness argument).  Callers MUST supply z_i that are
+    multiples of 8 (``crypto/signed.fresh_rlc_coeffs`` does): that makes
+    the combined equation the standard COFACTORED batch-Ed25519 check —
+    small-order (torsion) defect components are annihilated
+    deterministically rather than surviving with probability 1/8 over
+    unrestricted z.  Consequence, stated plainly: a signer can craft
+    R' = rB + T with T small-order so that the signature fails the
+    cofactorless per-signature ``verify`` but passes this cofactored
+    batch check; the divergence is one-sided (batch-accept is implied by
+    per-signature-accept, never narrower), deterministic, affects only
+    the signer's OWN malleated signatures (unforgeability of other
+    messages is untouched — the binding of commander to claimed value
+    stands either way), and is pinned by
+    test_verify_rlc_cofactored_accepts_torsion_malleated_sig.
+
+    NOT a per-signature verdict: returns ``(batch_ok, enc_ok)`` where
+    batch_ok is a scalar bool ("all B valid") and enc_ok [B] flags the
+    per-lane encoding checks (point/scalar range) that are exact either
+    way.  Callers needing the per-lane mask after a reject fall back to
+    ``verify`` (crypto/signed.verify_received does).
+
+    Why it is faster than B independent verifies: the per-lane ladder
+    shrinks from 256-bit [h]A to 128-bit [z]R (~halving the hot loop), the
+    per-lane 63-add fixed-base [S]B disappears into ONE combined
+    fixed-base multiply, and A only ladders once per KEY — ``pk_group``
+    consecutive lanes share a public key (2 table sigs per commander, n
+    broadcast copies per cluster: crypto/signed.py), so the [W]A work
+    divides by the group size.  Lanes whose encodings fail are excluded
+    from the combination by zeroing z_i ([0]P folds to the identity), so
+    one garbage lane cannot mask the others' verdict.
+    """
+    from ba_tpu.crypto.scalar import mul_mod_l, sum_mod_l
+
+    B = pk.shape[0]
+    assert B % pk_group == 0, (B, pk_group)
+    K = B // pk_group
+    r_enc = sig[..., :32]
+    s_enc = sig[..., 32:]
+    pk_u = pk[:: pk_group]  # unique keys, group-major layout
+    pts, oks = decompress(jnp.concatenate([pk_u, r_enc], axis=0))
+    a_pt = tuple(c[:K] for c in pts)
+    r_pt = tuple(c[K:] for c in pts)
+    ok_a, ok_r = oks[:K], oks[K:]
+    ok_s = _lt_const(s_enc, L)
+    enc_ok = jnp.repeat(ok_a, pk_group, axis=0) & ok_r & ok_s
+    z = jnp.where(enc_ok[:, None], z, 0).astype(jnp.uint8)
+
+    h_bytes = sha512(jnp.concatenate([r_enc, pk, msg], axis=-1))
+    if _use_pallas():
+        from ba_tpu.ops.ladder import window_mult
+        from ba_tpu.ops.modl import reduce_mod_l_planes as _modl
+
+        _mult = window_mult
+    else:
+        _modl = reduce_mod_l
+        _mult = scalar_mult
+    h = _modl(h_bytes)  # [B, 32]
+    w = sum_mod_l(mul_mod_l(h, z).reshape(K, pk_group, 32))  # [K, 32]
+    c = sum_mod_l(mul_mod_l(s_enc, z))  # combined S coefficient [32]
+
+    zr = batch_point_sum(_mult(r_pt, F.bytes_to_bits(z)))
+    wa = batch_point_sum(_mult(a_pt, F.bytes_to_bits(w)))
+    left = fixed_base_mult(c[None, :])
+    right = point_add(zr, wa)
+    batch_ok = point_eq(left, right)[0] & jnp.all(enc_ok)
+    return batch_ok, enc_ok
+
+
 def verify(pk: jnp.ndarray, msg: jnp.ndarray, sig: jnp.ndarray) -> jnp.ndarray:
     """Batched verify: pk [B, 32], msg [B, L] (L static), sig [B, 64] uint8
     -> bool [B].  Semantics identical to oracle.verify per lane.

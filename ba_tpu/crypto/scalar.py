@@ -41,6 +41,14 @@ from ba_tpu.crypto.oracle import L
 DELTA = L - 2**252  # 125 bits
 C16 = 16 * DELTA  # 2^256 mod-L fold constant, 129 bits
 
+# Static anti-diagonal scatter matrix for the 32x16-limb schoolbook
+# product (mul_mod_l): conv[k] = sum_{i+j=k} a[i] * z[j].  Same trick as
+# ba_tpu.crypto.field._CONV, sized for scalar x 128-bit-scalar.
+_CONV_32x16 = np.zeros((32 * 16, 47), np.int32)
+for _i in range(32):
+    for _j in range(16):
+        _CONV_32x16[_i * 16 + _j, _i + _j] = 1
+
 
 def _const_limbs(v: int, n: int) -> np.ndarray:
     out = np.zeros(n, np.int32)
@@ -151,3 +159,47 @@ def reduce_mod_l(h_bytes: jnp.ndarray) -> jnp.ndarray:
     diff = jnp.stack(diffs, axis=-1)
     v = jnp.where(ge[..., None], diff, v)
     return v.astype(jnp.uint8)
+
+
+def _bytes_from_signed_limbs(v: jnp.ndarray, total: int) -> jnp.ndarray:
+    """Signed int32 limbs of a NON-NEGATIVE value -> canonical uint8
+    [..., total] (zero-padded).  Carries are settled with parallel passes
+    then one exact chain; ``total`` must cover the value's byte length."""
+    v = _carry(v, passes=3, extra=2)
+    v = _exact_chain(v)
+    pad = total - v.shape[-1]
+    if pad > 0:
+        v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(0, pad)])
+    return v[..., :total].astype(jnp.uint8)
+
+
+def mul_mod_l(a_bytes: jnp.ndarray, z_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Batched ``(a * z) mod L``: a uint8 [..., 32], z uint8 [..., 16]
+    little-endian -> uint8 [..., 32].
+
+    The random-linear-combination batch verifier needs per-lane products
+    of 256-bit scalars (reduced hashes h_i) with 128-bit random
+    coefficients z_i.  Schoolbook convolution in 8-bit limbs (terms <=
+    16 * 255^2 ~ 1.04e6 — int32-safe), settled to canonical base-256
+    limbs (value < 2^384 -> 48 bytes), then reduced through the same
+    ``reduce_mod_l`` fold chain the verifier already trusts (its 64-byte
+    input covers 2^512 > 2^384).  Differential contract: equals
+    ``(int(a) * int(z)) % L`` on Python bigints (tests/test_crypto.py).
+    """
+    a = a_bytes.astype(jnp.int32)
+    z = z_bytes.astype(jnp.int32)
+    outer = a[..., :, None] * z[..., None, :]
+    flat = outer.reshape(*outer.shape[:-2], 32 * 16)
+    conv = jnp.matmul(flat, jnp.asarray(_CONV_32x16))  # [..., 47]
+    return reduce_mod_l(_bytes_from_signed_limbs(conv, 64))
+
+
+def sum_mod_l(v_bytes: jnp.ndarray, axis: int = -2) -> jnp.ndarray:
+    """Batched ``sum mod L`` over ``axis``: uint8 [..., G, 32] -> [..., 32].
+
+    Limb-wise int32 sums stay exact for G <= ~8.4M (G * 255 < 2^31); the
+    summed value is < G * L < 2^(253 + 23), which the 64-byte
+    ``reduce_mod_l`` input covers with room to spare.
+    """
+    s = v_bytes.astype(jnp.int32).sum(axis=axis)
+    return reduce_mod_l(_bytes_from_signed_limbs(s, 64))

@@ -54,6 +54,7 @@ MSG_LEN = 16
 _MAGIC = b"BAv1"
 
 _verify_jit = None  # lazily-created jitted ed25519.verify (shared cache)
+_verify_rlc_jit = None  # lazily-created jitted ed25519.verify_rlc
 
 
 def host_publickey(sk: bytes) -> bytes:
@@ -277,6 +278,74 @@ def verify_received(pks, msgs, sigs):
         for o in range(0, total + pad, chunk)
     ]
     return jnp.concatenate(oks)[:total].reshape(B, n)
+
+
+def fresh_rlc_coeffs(total: int) -> np.ndarray:
+    """Unpredictable RLC coefficients, one per lane: uint8 [total, 16]
+    from OS entropy, with the low 3 bits CLEARED (z_i = 8 * u_i, u_i
+    uniform 125-bit).  Batch-verification soundness needs z unknown to
+    whoever chose the signatures, so these are drawn fresh per call —
+    never derived from the batch contents or a fixed seed.  The factor 8
+    makes the combined equation COFACTORED (any small-order component of
+    a per-signature defect is annihilated deterministically instead of
+    surviving with probability 1/8 over z — see verify_rlc's contract),
+    which is the standard batch-Ed25519 convention."""
+    import secrets
+
+    z = np.frombuffer(
+        secrets.token_bytes(total * 16), np.uint8
+    ).reshape(total, 16).copy()
+    z[:, 0] &= 0xF8
+    return z
+
+
+def verify_received_rlc(pks, msgs, sigs):
+    """Batched verification via ONE random-linear-combination check, with
+    an exact per-signature fallback on reject: -> [B, n] bool mask.
+
+    The common case of every hot path is all-valid signatures (honest
+    commanders sign correctly; the adversary model corrupts *values*, not
+    usually encodings), and there ``ed25519.verify_rlc`` replaces B*n
+    independent verifies with one combined equation at roughly half the
+    per-lane ladder work and no per-lane fixed-base multiply (the [W]A
+    ladders also collapse n-fold because each instance's n copies share a
+    commander key).  On a reject — any invalid signature — the exact
+    per-signature ``verify_received`` runs and its mask is returned; only
+    the (rare) mixed-validity case pays both dispatches.  Soundness: a
+    batch containing a signature with a prime-order defect passes the
+    combined check with probability ~2^-125 over the fresh coefficients.
+    One DOCUMENTED divergence from the per-signature path: the batch
+    check is cofactored (the batch-Ed25519 standard), so a signer's own
+    torsion-malleated signature — R deliberately offset by a small-order
+    point — is accepted here but rejected by the cofactorless per-lane
+    path; see ed25519.verify_rlc's contract for why this does not weaken
+    the commander-to-value binding.  Callers that need strict
+    cofactorless semantics must use ``verify_received`` directly.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ba_tpu.crypto.ed25519 import verify_rlc
+
+    global _verify_rlc_jit
+    if _verify_rlc_jit is None:
+        _verify_rlc_jit = jax.jit(
+            verify_rlc, static_argnames="pk_group"
+        )
+    pks = jnp.asarray(pks, jnp.uint8)
+    msgs = jnp.asarray(msgs, jnp.uint8)
+    sigs = jnp.asarray(sigs, jnp.uint8)
+    B, n = msgs.shape[:2]
+    total = B * n
+    pk_bn = jnp.broadcast_to(pks[:, None, :], (B, n, 32)).reshape(total, 32)
+    z = jnp.asarray(fresh_rlc_coeffs(total))
+    batch_ok, _ = _verify_rlc_jit(
+        pk_bn, msgs.reshape(total, -1), sigs.reshape(total, 64), z,
+        pk_group=n,
+    )
+    if bool(batch_ok):
+        return jnp.ones((B, n), bool)
+    return verify_received(pks, msgs, sigs)
 
 
 def setup_signed_tables_overlapped(

@@ -80,9 +80,13 @@ def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
     # ``rounds`` independent agreement rounds per dispatch, batch-resident:
     # the state planes are read once, the PRNG stream simply continues
     # across rounds (iid draws), and each round's decision packs into 2
-    # bits of the int32 output column (decisions are in {0, 1, 2}; 15
-    # rounds fit 30 bits).  Round 0's draw order is identical to the
-    # single-round kernel, so rounds=1 is bit-compatible with r3's kernel.
+    # bits of an int32 output column (decisions are in {0, 1, 2}; 15
+    # rounds per column, ceil(rounds/15) columns).  Each column is stored
+    # the moment it fills — accumulating them for one final concatenate
+    # measured 1.6 MB over the 16 MB scoped-VMEM limit at 2 columns.
+    # Round 0's draw order is identical to the single-round kernel, so
+    # rounds=1 is bit-compatible with r3's kernel.
+    col = 0
     acc = jnp.zeros((T, 1), jnp.int32)
     for _rr in range(rounds):
         # Round 1: honest leader pushes order; faulty leader flips a coin
@@ -153,7 +157,10 @@ def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
         )
         dec = jnp.where(total == 0, jnp.int32(UNDEFINED), dec)
         acc = acc * 4 + dec
-    dec_ref[:] = acc
+        if (_rr + 1) % 15 == 0 or _rr == rounds - 1:
+            dec_ref[:, col : col + 1] = acc
+            col += 1
+            acc = jnp.zeros((T, 1), jnp.int32)
 
 
 @functools.partial(
@@ -178,8 +185,10 @@ def fused_signed_sweep_step(
     [B, rounds] int8 — column r is round r's independent decision.  The
     state planes stay VMEM-resident across all rounds, so per-dispatch
     overhead (tunnel latency, grid setup, state reads) amortizes by
-    ``rounds``; the kernel packs each round's {0,1,2} decision into 2 bits
-    of its int32 output, bounding rounds at 15 per dispatch.
+    ``rounds``; the kernel packs each round's {0,1,2} decision into 2
+    bits of an int32 output column, 15 rounds per column (measured r4:
+    dispatch overhead still dominated at 15, so the column axis extends
+    the chain — ROUNDS_AB_r4.json).  Kept <= 240 as a trace-size guard.
 
     seed: int32 [1] (vary per step — the kernel folds in the tile index);
     order [B] int8/int32; leader [B] int32; faulty/alive [B, n] bool;
@@ -188,10 +197,11 @@ def fused_signed_sweep_step(
     tile = TILE if tile is None else tile  # explicit 0 is a loud error below
     if tile <= 0:
         raise ValueError(f"tile={tile} must be positive")
-    if not 1 <= rounds <= 15:
-        raise ValueError(f"rounds={rounds} outside [1, 15] (2 bits/round "
-                         "of the packed int32 output)")
+    if not 1 <= rounds <= 240:
+        raise ValueError(f"rounds={rounds} outside [1, 240] (unrolled "
+                         "trace-size guard; 15 rounds per packed column)")
     B, n = faulty.shape
+    n_cols = -(-rounds // 15)
     b_pad = -(-B // tile) * tile
     n_pad = -(-n // LANES) * LANES
 
@@ -217,8 +227,8 @@ def fused_signed_sweep_step(
             vcol,  # ok retreat
             vcol,  # ok attack
         ],
-        out_specs=pl.BlockSpec((tile, 1), col, memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.int32),
+        out_specs=pl.BlockSpec((tile, n_cols), col, memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b_pad, n_cols), jnp.int32),
         interpret=interpret,
     )(
         seed.astype(jnp.int32),
@@ -229,11 +239,15 @@ def fused_signed_sweep_step(
         pad1(ok[:, 0]),
         pad1(ok[:, 1]),
     )
-    acc = out[:B, 0]
     if rounds == 1:
-        return acc.astype(COMMAND_DTYPE)
-    shifts = 2 * (rounds - 1 - jnp.arange(rounds, dtype=jnp.int32))
-    return ((acc[:, None] >> shifts[None, :]) & 3).astype(COMMAND_DTYPE)
+        return out[:B, 0].astype(COMMAND_DTYPE)
+    pieces = []
+    for c in range(n_cols):
+        rc = min(15, rounds - 15 * c)  # rounds packed in column c
+        shifts = 2 * (rc - 1 - jnp.arange(rc, dtype=jnp.int32))
+        pieces.append((out[:B, c : c + 1] >> shifts[None, :]) & 3)
+    dec = pieces[0] if n_cols == 1 else jnp.concatenate(pieces, axis=1)
+    return dec.astype(COMMAND_DTYPE)
 
 
 def fused_sharded_sweep_step(

@@ -38,12 +38,23 @@ def interleaved_ab(steps: dict, iters: int, reps: int) -> dict:
 
     from bench import _timed  # the tunnel-safe timing single source of truth
 
-    for idx, step in enumerate(steps.values()):  # compile + warm, off clock
-        jax.device_get(step(jnp.asarray([-1 - idx], jnp.int32)))
+    import sys
+
+    alive = {}
+    for idx, (k, step) in enumerate(steps.items()):  # compile+warm, off clock
+        try:
+            jax.device_get(step(jnp.asarray([-1 - idx], jnp.int32)))
+            alive[k] = step
+        except Exception as e:  # e.g. scoped-VMEM OOM at big tile x K
+            print(f"variant {k} failed to compile: "
+                  f"{str(e).splitlines()[0][:160]}", file=sys.stderr)
+            alive[k] = None
 
     best = {k: float("inf") for k in steps}
     for r in range(reps):
-        for k, step in steps.items():
+        for k, step in alive.items():
+            if step is None:
+                continue
             mk = lambda i, _r=r: (jnp.asarray([_r * 1000 + i], jnp.int32),)
             best[k] = min(best[k], _timed(step, mk, iters, reps=1))
     return best

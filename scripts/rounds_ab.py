@@ -47,8 +47,12 @@ def main() -> None:
     emit(
         "fused-rounds-ab", batch, iters,
         {
-            str(k): {"elapsed_s": round(e, 4),
-                     "rounds_per_sec": round(batch * k * iters / e, 1)}
+            str(k): (
+                {"error": "compile-failed (see stderr)"}
+                if e == float("inf")
+                else {"elapsed_s": round(e, 4),
+                      "rounds_per_sec": round(batch * k * iters / e, 1)}
+            )
             for k, e in best.items()
         },
         tile=tile or "default",

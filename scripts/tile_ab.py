@@ -45,8 +45,12 @@ def main() -> None:
     emit(
         "fused-tile-ab", batch, iters,
         {
-            str(t): {"elapsed_s": round(e, 4),
-                     "rounds_per_sec": round(batch * k_rounds * iters / e, 1)}
+            str(t): (
+                {"error": "compile-failed (see stderr)"}
+                if e == float("inf")
+                else {"elapsed_s": round(e, 4),
+                      "rounds_per_sec": round(batch * k_rounds * iters / e, 1)}
+            )
             for t, e in best.items()
         },
         rounds_per_dispatch=k_rounds,

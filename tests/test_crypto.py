@@ -263,3 +263,191 @@ def test_decompress_rejects_junk():
     _, ok = ed25519.decompress(jnp.asarray(bad))
     assert not np.asarray(ok)[0]
     assert not np.asarray(ok)[1]
+
+
+# -- mod-L products / sums + RLC batch verification ---------------------------
+
+
+def test_mul_mod_l_matches_bigints():
+    from ba_tpu.crypto.oracle import L
+    from ba_tpu.crypto.scalar import mul_mod_l
+
+    rng = np.random.default_rng(21)
+    a = rng.integers(0, 256, (64, 32)).astype(np.uint8)
+    z = rng.integers(0, 256, (64, 16)).astype(np.uint8)
+    # Edge rows: zero, max, L-1 * max.
+    a[0] = 0
+    a[1] = 255
+    a[2] = np.frombuffer(int(L - 1).to_bytes(32, "little"), np.uint8)
+    z[1] = 255
+    z[2] = 255
+    got = np.asarray(jax.jit(mul_mod_l)(jnp.asarray(a), jnp.asarray(z)))
+    for i in range(64):
+        want = (
+            int.from_bytes(a[i].tobytes(), "little")
+            * int.from_bytes(z[i].tobytes(), "little")
+        ) % L
+        assert int.from_bytes(got[i].tobytes(), "little") == want, i
+
+
+def test_sum_mod_l_matches_bigints():
+    from ba_tpu.crypto.oracle import L
+    from ba_tpu.crypto.scalar import sum_mod_l
+
+    rng = np.random.default_rng(22)
+    v = rng.integers(0, 256, (3, 4097, 32)).astype(np.uint8)  # odd G
+    got = np.asarray(jax.jit(sum_mod_l)(jnp.asarray(v)))
+    for i in range(3):
+        want = sum(
+            int.from_bytes(v[i, g].tobytes(), "little") for g in range(4097)
+        ) % L
+        assert int.from_bytes(got[i].tobytes(), "little") == want, i
+
+
+def test_batch_point_sum_matches_sequential():
+    rng = np.random.default_rng(23)
+    for B in (1, 2, 5, 8):  # covers pad and no-pad tree shapes
+        bits = jnp.asarray(rng.integers(0, 2, (B, 16)), jnp.int32)
+        pts = ed25519.scalar_mult(ed25519.base_point((B,)), bits)
+        acc = ed25519.identity((1,))
+        for i in range(B):
+            acc = ed25519.point_add(acc, tuple(c[i : i + 1] for c in pts))
+        got = ed25519.batch_point_sum(pts)
+        assert bool(ed25519.point_eq(got, acc)[0]), B
+
+
+def _rlc_fixture(rng, B=4, n=4):
+    from ba_tpu.crypto.signed import commander_keys, sign_received
+
+    sks, pks = commander_keys(B)
+    received = rng.integers(0, 2, (B, n))
+    msgs, sigs = sign_received(sks, pks, received)
+    pk_l = jnp.asarray(np.repeat(pks, n, axis=0))
+    return (
+        pks, msgs, sigs, pk_l,
+        jnp.asarray(msgs.reshape(B * n, -1)),
+        jnp.asarray(sigs.reshape(B * n, 64)),
+    )
+
+
+def test_verify_rlc_accepts_valid_batch_and_rejects_corrupt():
+    rng = np.random.default_rng(24)
+    B, n = 4, 4
+    pks, msgs, sigs, pk_l, msg_l, sig_l = _rlc_fixture(rng, B, n)
+    z = jnp.asarray(rng.integers(0, 256, (B * n, 16)), jnp.uint8)
+    ok, enc = ed25519.verify_rlc(pk_l, msg_l, sig_l, z, pk_group=n)
+    assert bool(ok) and bool(jnp.all(enc))
+    # grouped and ungrouped paths agree
+    ok_u, _ = ed25519.verify_rlc(pk_l, msg_l, sig_l, z, pk_group=1)
+    assert bool(ok_u)
+    # a single flipped signature byte (valid encodings) must reject
+    s2 = np.array(sigs)
+    s2[1, 2, 40] ^= 0x01
+    ok2, enc2 = ed25519.verify_rlc(
+        pk_l, msg_l, jnp.asarray(s2.reshape(B * n, 64)), z, pk_group=n
+    )
+    assert not bool(ok2) and bool(jnp.all(enc2))
+    # an out-of-range S is flagged per-lane (exact check) and rejects
+    s3 = np.array(sigs)
+    s3[2, 1, 32:] = 0xFF
+    ok3, enc3 = ed25519.verify_rlc(
+        pk_l, msg_l, jnp.asarray(s3.reshape(B * n, 64)), z, pk_group=n
+    )
+    enc3 = np.asarray(enc3)
+    assert not bool(ok3) and not enc3[2 * n + 1] and enc3.sum() == B * n - 1
+
+
+def test_verify_received_rlc_matches_exact_mask():
+    from ba_tpu.crypto.signed import verify_received, verify_received_rlc
+
+    rng = np.random.default_rng(25)
+    B, n = 4, 4
+    pks, msgs, sigs, *_ = _rlc_fixture(rng, B, n)
+    # all-valid: the RLC fast path must return the all-true mask
+    got = np.asarray(verify_received_rlc(pks, msgs, sigs))
+    assert got.all() and got.shape == (B, n)
+    # corrupt one copy: the fallback must reproduce the exact mask
+    s2 = np.array(sigs)
+    s2[3, 0, 0] ^= 0xFF
+    want = np.asarray(verify_received(pks, msgs, s2))
+    got2 = np.asarray(verify_received_rlc(pks, msgs, s2))
+    np.testing.assert_array_equal(got2, want)
+    assert not got2[3, 0] and got2.sum() == B * n - 1
+
+
+def test_verify_rlc_cofactored_accepts_torsion_malleated_sig():
+    # The documented one-sided divergence between the RLC batch check and
+    # the cofactorless per-signature path: a signer offsets its own R by a
+    # small-order point T (R' = rB + T) and recomputes S for the new hash.
+    # Per-signature verify (oracle, jnp) must REJECT — the defect -T is a
+    # torsion component.  verify_rlc with z = 8u (fresh_rlc_coeffs's
+    # contract) runs the standard COFACTORED batch equation, which
+    # annihilates T and must ACCEPT, deterministically.  If this test
+    # ever starts failing on the accept side, the cofactored contract in
+    # verify_rlc's docstring is stale.
+    import hashlib
+
+    from ba_tpu.crypto import oracle
+    from ba_tpu.crypto.signed import (
+        commander_keys,
+        fresh_rlc_coeffs,
+        order_message,
+    )
+
+    # A small-order point: scan y, keep curve-valid points whose [L]Q is
+    # not the identity.
+    T = None
+    for y in range(2, 200):
+        try:
+            q = oracle.decode_point(int(y).to_bytes(32, "little"))
+        except ValueError:
+            continue
+        x, yy = q
+        if (-x * x + yy * yy - 1 - oracle.D * x * x * yy * yy) % oracle.P:
+            continue  # not on the curve
+        cand = oracle.scalarmult(q, oracle.L)
+        if cand != (0, 1):
+            T = cand
+            break
+    assert T is not None, "no small-order point found in scan range"
+
+    sks, pks = commander_keys(2, seed=7)
+    msg0 = order_message(0, 1)
+    sig0 = np.frombuffer(
+        oracle.sign(sks[0], pks[0].tobytes(), msg0), np.uint8
+    )
+    # Malleate lane 1's signature: same RFC nonce r, R' = rB + T.
+    msg1 = order_message(1, 0)
+    h = hashlib.sha512(sks[1]).digest()
+    a = oracle._clamp(h[:32])
+    r = oracle._hint(h[32:] + msg1) % oracle.L
+    r_pt = oracle.edwards_add(oracle.scalarmult(oracle.BASE, r), T)
+    r_enc = oracle.encode_point(r_pt)
+    pk1 = pks[1].tobytes()
+    hp = oracle._hint(r_enc + pk1 + msg1) % oracle.L
+    s = (r + hp * a) % oracle.L
+    sig1 = np.frombuffer(r_enc + s.to_bytes(32, "little"), np.uint8)
+
+    assert not oracle.verify(pk1, msg1, bytes(sig1))  # cofactorless: reject
+    pk_l = jnp.asarray(pks)
+    msg_l = jnp.asarray(
+        np.stack([np.frombuffer(msg0, np.uint8),
+                  np.frombuffer(msg1, np.uint8)])
+    )
+    sig_l = jnp.asarray(np.stack([sig0, sig1]))
+    per_sig = np.asarray(ed25519.verify(pk_l, msg_l, sig_l))
+    np.testing.assert_array_equal(per_sig, [True, False])
+
+    z = jnp.asarray(fresh_rlc_coeffs(2))
+    ok, enc = ed25519.verify_rlc(pk_l, msg_l, sig_l, z, pk_group=1)
+    assert bool(jnp.all(enc))  # encodings are valid either way
+    assert bool(ok)  # cofactored batch: the torsion defect annihilates
+
+    # ...and WITHOUT the 8-multiple contract the defect must be caught
+    # (odd z cannot annihilate an order-8 component).
+    z_odd = np.asarray(z).copy()
+    z_odd[:, 0] |= 1
+    ok_odd, _ = ed25519.verify_rlc(
+        pk_l, msg_l, sig_l, jnp.asarray(z_odd), pk_group=1
+    )
+    assert not bool(ok_odd)

@@ -78,3 +78,82 @@ def test_dead_relays_excluded():
     out = eig_agreement(jr.key(2), state, 2)
     assert np.all(np.asarray(out["total"]) == 5)
     assert np.all(np.asarray(out["decision"]) == RETREAT)
+
+
+# -- fused deepest level vs the dense path ------------------------------------
+
+
+def _with_env(key, val):
+    import os
+
+    class _Ctx:
+        def __enter__(self):
+            self.old = os.environ.get(key)
+            os.environ[key] = val
+
+        def __exit__(self, *a):
+            if self.old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = self.old
+
+    return _Ctx()
+
+
+def test_fused_deepest_no_traitors_bit_exact():
+    # Zero traitors => no coins anywhere => the fused einsum/Binomial path
+    # must equal the dense path bit-for-bit despite different key splits.
+    for m, n in ((2, 12), (3, 7)):
+        state = make_state(32, n, order=ATTACK)
+        with _with_env("BA_TPU_EIG_FUSED", "0"):
+            want = np.asarray(eig_round(jr.key(3), state, m))
+        with _with_env("BA_TPU_EIG_FUSED", "1"):
+            got = np.asarray(eig_round(jr.key(3), state, m))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_fused_deepest_equivocating_leader_histograms():
+    # The genuinely stochastic regime: a faulty LEADER equivocates, faulty
+    # lieutenants lie per path — per-general majority histograms from the
+    # fused path must sit in the dense path's 6-sigma band (the tallies
+    # have identical joint law: Binomial(k, 1/2) == sum of k fair coins).
+    B, n, m = 4096, 9, 2
+    faulty = np.zeros((B, n), bool)
+    faulty[:, 0] = True  # the leader equivocates
+    faulty[:, 4] = True
+    state = make_state(B, n, order=ATTACK, faulty=jnp.asarray(faulty))
+    with _with_env("BA_TPU_EIG_FUSED", "0"):
+        want = np.asarray(eig_round(jr.key(4), state, m))
+    with _with_env("BA_TPU_EIG_FUSED", "1"):
+        got = np.asarray(eig_round(jr.key(5), state, m, 2))
+    band = 6 * np.sqrt(B * n)
+    h_want = np.bincount(want.ravel(), minlength=3)
+    h_got = np.bincount(got.ravel(), minlength=3)
+    assert (np.abs(h_want - h_got) < band).all(), (h_want, h_got)
+    # repeated-digit degenerate paths exist at m=2 depth-1? depth m-1=1 has
+    # none; exercise m=3 (depth-2 paths include (j,j)) the same way.
+    B3, n3 = 2048, 6
+    faulty = np.zeros((B3, n3), bool)
+    faulty[:, 0] = True
+    faulty[:, 3] = True
+    state = make_state(B3, n3, order=ATTACK, faulty=jnp.asarray(faulty))
+    with _with_env("BA_TPU_EIG_FUSED", "0"):
+        want = np.asarray(eig_round(jr.key(6), state, 3))
+    with _with_env("BA_TPU_EIG_FUSED", "1"):
+        got = np.asarray(eig_round(jr.key(7), state, 3, 2))
+    band = 6 * np.sqrt(B3 * n3)
+    h_want = np.bincount(want.ravel(), minlength=3)
+    h_got = np.bincount(got.ravel(), minlength=3)
+    assert (np.abs(h_want - h_got) < band).all(), (h_want, h_got)
+
+
+def test_binomial_half_exact_moments_and_bounds():
+    from ba_tpu.core.eig import _binomial_half
+
+    k = jnp.asarray([0, 1, 31, 32, 33, 64])
+    for t in range(3):
+        d = np.asarray(_binomial_half(jr.key(t), k, 64))
+        assert d[0] == 0 and (d >= 0).all() and (d <= np.asarray(k)).all()
+    ks = jnp.full((20000,), 8)
+    draws = np.asarray(_binomial_half(jr.key(9), ks, 8))
+    assert abs(draws.mean() - 4) < 0.06 and abs(draws.var() - 2) < 0.12

@@ -596,16 +596,16 @@ def test_fused_sharded_sweep_matches_unsharded():
 
 
 def test_fused_multi_round_bounds():
-    # The 2-bits-per-round packing caps rounds at 15; the wrapper must
-    # reject out-of-range values loudly at trace time (CPU-safe: the check
-    # runs before the pallas_call is built).
+    # 15 rounds pack per int32 column and the unrolled trace is guarded at
+    # 240; the wrapper must reject out-of-range values loudly at trace
+    # time (CPU-safe: the check runs before the pallas_call is built).
     from ba_tpu.ops.sweep_step import fused_signed_sweep_step
 
     o = jnp.zeros((8,), jnp.int8)
     ldr = jnp.zeros((8,), jnp.int32)
     f = jnp.zeros((8, 16), bool)
     ok = jnp.ones((8, 2), bool)
-    for bad in (0, 16):
+    for bad in (0, 241):
         with pytest.raises(ValueError, match="rounds"):
             fused_signed_sweep_step(
                 jnp.asarray([1], jnp.int32), o, ldr, f, f, ok, 1, bad
@@ -690,3 +690,27 @@ def test_fused_multi_round_rounds_are_independent():
     assert any(
         (multi[:, r] != multi[:, 0]).any() for r in range(1, R)
     )  # fresh coins per round
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="in-kernel PRNG needs real TPU")
+def test_fused_multi_round_multi_column():
+    # rounds > 15 spill into additional packed output columns; with zero
+    # traitors every one of the 35 columns (15+15+5 split) must match the
+    # XLA composition bit-for-bit, which pins both the per-column packing
+    # width and the cross-column round order.
+    import jax.random as jr
+
+    from ba_tpu.ops.sweep_step import fused_signed_sweep_step
+    from ba_tpu.parallel import make_sweep_state
+
+    B, cap, m, R = 512, 128, 3, 35
+    state = make_sweep_state(jr.key(40), B, cap, max_traitor_frac=0.0)
+    ok = jnp.ones((B, 2), bool)
+    want = np.asarray(_xla_sweep_step(jr.key(41), state, ok, m))
+    multi = np.asarray(fused_signed_sweep_step(
+        jnp.asarray([42], jnp.int32), state.order, state.leader,
+        state.faulty, state.alive, ok, m, R,
+    ))
+    assert multi.shape == (B, R)
+    for r in range(R):
+        np.testing.assert_array_equal(multi[:, r], want)
