@@ -314,24 +314,24 @@ def verify_rlc(
         [sum_i z_i S_i mod L] B  ==  sum_i [z_i] R_i  +  sum_j [W_j] A_j,
         W_j = sum_{i in group j} z_i h_i mod L,
 
-    with caller-supplied random coefficients z [B, 16] uint8.  If every
-    signature is valid the equation holds identically; if any has a
-    defect d_i = S_i B - R_i - h_i A_i with a PRIME-ORDER component, the
-    combined check fails except with probability ~2^-125 over z (the
-    standard RLC soundness argument).  Callers MUST supply z_i that are
-    multiples of 8 (``crypto/signed.fresh_rlc_coeffs`` does): that makes
-    the combined equation the standard COFACTORED batch-Ed25519 check —
-    small-order (torsion) defect components are annihilated
-    deterministically rather than surviving with probability 1/8 over
-    unrestricted z.  Consequence, stated plainly: a signer can craft
+    with caller-supplied random 128-bit coefficients z [B, 16] uint8,
+    and the COMPARISON COFACTORED: both sides are multiplied by 8 (three
+    doublings) before the equality, so every small-order (torsion)
+    component — from a malleated R, a torsion-carrying public key, or
+    the mod-L-reduced W_j — is annihilated deterministically.  This is
+    the standard batch-Ed25519 convention.  If every signature is valid
+    the equation holds identically; if any has a defect
+    d_i = S_i B - R_i - h_i A_i with a PRIME-ORDER component, the check
+    fails except with probability ~2^-128 over z (the RLC soundness
+    argument).  Consequence, stated plainly: a signer can craft
     R' = rB + T with T small-order so that the signature fails the
     cofactorless per-signature ``verify`` but passes this cofactored
-    batch check; the divergence is one-sided (batch-accept is implied by
-    per-signature-accept, never narrower), deterministic, affects only
-    the signer's OWN malleated signatures (unforgeability of other
-    messages is untouched — the binding of commander to claimed value
-    stands either way), and is pinned by
-    test_verify_rlc_cofactored_accepts_torsion_malleated_sig.
+    batch check; the divergence is one-sided (per-signature-accept
+    implies batch-accept for every lane, so batch-reject always means
+    some lane is per-signature-invalid), affects only the signer's OWN
+    malleated signatures (unforgeability of other messages is untouched
+    — the binding of commander to claimed value stands either way), and
+    is pinned by test_verify_rlc_cofactored_accepts_torsion_malleated_sig.
 
     NOT a per-signature verdict: returns ``(batch_ok, enc_ok)`` where
     batch_ok is a scalar bool ("all B valid") and enc_ok [B] flags the
@@ -382,6 +382,9 @@ def verify_rlc(
     wa = batch_point_sum(_mult(a_pt, F.bytes_to_bits(w)))
     left = fixed_base_mult(c[None, :])
     right = point_add(zr, wa)
+    for _ in range(3):  # cofactor-clear: [8]P on both single-lane points
+        left = point_add(left, left)
+        right = point_add(right, right)
     batch_ok = point_eq(left, right)[0] & jnp.all(enc_ok)
     return batch_ok, enc_ok
 

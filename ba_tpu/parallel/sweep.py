@@ -155,17 +155,28 @@ def decision_histogram(decision: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def agreement_step(keys: jax.Array, state: SimState, m: int = 1):
+def agreement_step(
+    keys: jax.Array,
+    state: SimState,
+    m: int = 1,
+    max_liars: int | None = None,
+):
     """One agreement round per instance with per-instance PRNG keys.
 
     The jittable heart of the sweep (and of bench.py): vmapped over the
     batch so each instance draws independent fault coins — the vectorised
     analogue of "fresh randomness per RPC call" (ba.py:44-49).
+    ``max_liars`` (known traitor cap) shrinks the fused deepest EIG
+    level's popcount draw for m >= 2 — derive it from the CONCRETE state
+    before jitting (it cannot be computed from a tracer); None is always
+    safe (n-1 words).
     """
 
     def one(k, order, leader, faulty, alive, ids):
         st = SimState(order[None], leader[None], faulty[None], alive[None], ids[None])
-        maj = om1_round(k, st) if m == 1 else eig_round(k, st, m)
+        maj = (
+            om1_round(k, st) if m == 1 else eig_round(k, st, m, max_liars)
+        )
         return maj[0]
 
     majorities = jax.vmap(one)(
@@ -188,6 +199,7 @@ def failover_sweep(
     state: SimState,
     kill_schedule: jnp.ndarray,
     m: int = 1,
+    max_liars: int | None = None,
 ):
     """Multi-round sweep with on-device leader failover: the tensor-scale
     detect -> elect -> continue loop of the reference's run thread
@@ -220,7 +232,9 @@ def failover_sweep(
         elected = elect_lowest_id(state.ids, alive)
         leader = jnp.where(leader_dead, elected, leader)
         st = SimState(state.order, leader, state.faulty, alive, state.ids)
-        majorities = om1_round(k, st) if m == 1 else eig_round(k, st, m)
+        majorities = (
+            om1_round(k, st) if m == 1 else eig_round(k, st, m, max_liars)
+        )
         n_a, n_r, n_u = majority_counts(majorities, alive)
         decision, needed, total = quorum_decision(n_a, n_r, n_u)
         return (leader, alive), (leader, decision, decision_histogram(decision))

@@ -132,6 +132,10 @@ class JaxBackend:
                     return sm_round(key, state, m)
                 if m == 1:
                     return om1_round(key, state)
+                # max_liars stays at its safe n-1 default: faulty flags
+                # change interactively (g-state) under one compiled step,
+                # so no tighter static cap exists here — and interactive
+                # n is tens, where the extra popcount words are noise.
                 return eig_round(key, state, m)
 
             self._compiled = jax.jit(step)

@@ -441,13 +441,21 @@ def test_verify_rlc_cofactored_accepts_torsion_malleated_sig():
     z = jnp.asarray(fresh_rlc_coeffs(2))
     ok, enc = ed25519.verify_rlc(pk_l, msg_l, sig_l, z, pk_group=1)
     assert bool(jnp.all(enc))  # encodings are valid either way
-    assert bool(ok)  # cofactored batch: the torsion defect annihilates
+    assert bool(ok)  # cofactored comparison: the torsion defect annihilates
 
-    # ...and WITHOUT the 8-multiple contract the defect must be caught
-    # (odd z cannot annihilate an order-8 component).
+    # The clearing happens at the COMPARISON (both sides x8), so it is
+    # z-independent: odd coefficients accept identically...
     z_odd = np.asarray(z).copy()
     z_odd[:, 0] |= 1
     ok_odd, _ = ed25519.verify_rlc(
         pk_l, msg_l, sig_l, jnp.asarray(z_odd), pk_group=1
     )
-    assert not bool(ok_odd)
+    assert bool(ok_odd)
+    # ...while a PRIME-ORDER defect on the same malleated lane (S bumped
+    # by 1) must still reject — cofactoring hides torsion only.
+    s_bad = (s + 1) % oracle.L
+    sig_bad = np.frombuffer(r_enc + s_bad.to_bytes(32, "little"), np.uint8)
+    ok_bad, _ = ed25519.verify_rlc(
+        pk_l, msg_l, jnp.asarray(np.stack([sig0, sig_bad])), z, pk_group=1
+    )
+    assert not bool(ok_bad)
