@@ -216,12 +216,29 @@ def verify_received(pks, msgs, sigs):
     chunk-sized pieces (padding the tail so one compiled kernel serves
     every call), then reshapes back; see ``_verify_chunk`` for sizing.
 
+    ``BA_TPU_VERIFY_RLC=1`` routes through the random-linear-combination
+    BATCH check first (``verify_received_rlc``: one cofactored combined
+    equation, ~2x same-window when all signatures are valid — the hot
+    path) with this exact per-signature path as the fallback on reject;
+    see verify_received_rlc's docstring for the one documented
+    cofactored-acceptance divergence.  Default off: exact cofactorless
+    per-signature semantics.
+
     On the CPU backend the jnp ladder is pathologically slow (~0.3k/s;
     the Pallas kernels are TPU-only), so there the batch routes through
     the C++ library instead (~12k/s/core, byte-identical accept set) —
     ``BA_TPU_VERIFY_NATIVE=0`` forces the jnp path, ``=1`` forces native
     everywhere.
     """
+    if os.environ.get("BA_TPU_VERIFY_RLC", "0") == "1":
+        return verify_received_rlc(pks, msgs, sigs)
+    return _verify_received_exact(pks, msgs, sigs)
+
+
+def _verify_received_exact(pks, msgs, sigs):
+    """The per-signature body of ``verify_received`` (also the RLC
+    fallback — calling it directly sidesteps the env knob so the two
+    can never recurse)."""
     import jax
     import jax.numpy as jnp
 
@@ -340,7 +357,7 @@ def verify_received_rlc(pks, msgs, sigs):
     )
     if bool(batch_ok):
         return jnp.ones((B, n), bool)
-    return verify_received(pks, msgs, sigs)
+    return _verify_received_exact(pks, msgs, sigs)
 
 
 def setup_signed_tables_overlapped(
@@ -394,7 +411,11 @@ def setup_signed_tables_overlapped(
             pk_c = np.concatenate([pk_c, np.tile(pk_c[:1], (pad, 1))])
             m_c = np.concatenate([m_c, np.tile(m_c[:1], (pad, 1, 1))])
             s_c = np.concatenate([s_c, np.tile(s_c[:1], (pad, 1, 1))])
-        oks.append(verify_received(pk_c, m_c, s_c)[: hi - lo])
+        # ALWAYS the exact per-signature path, knob or no knob: the
+        # overlap depends on this dispatch returning on ACK, and the RLC
+        # route's accept/fallback decision is a blocking host fetch that
+        # would serialize the loop back to sign + verify.
+        oks.append(_verify_received_exact(pk_c, m_c, s_c)[: hi - lo])
     t_signed = time.perf_counter()
     ok = jnp.concatenate(oks) if len(oks) > 1 else oks[0]
     jax.device_get(ok)  # host fetch: genuinely drain the verify queue
@@ -423,7 +444,7 @@ def warm_signed_tables(batch: int, chunks: int = 4) -> None:
     m_c, s_c = sign_value_tables(sks, pks)
     import jax
 
-    jax.device_get(verify_received(pks, m_c, s_c))
+    jax.device_get(_verify_received_exact(pks, m_c, s_c))
 
 
 def sig_valid_from_tables(ok, received):

@@ -459,3 +459,23 @@ def test_verify_rlc_cofactored_accepts_torsion_malleated_sig():
         pk_l, msg_l, jnp.asarray(np.stack([sig0, sig_bad])), z, pk_group=1
     )
     assert not bool(ok_bad)
+
+
+def test_verify_received_rlc_env_knob(monkeypatch):
+    # BA_TPU_VERIFY_RLC=1 must be observably identical to the exact path
+    # on both all-valid and mixed batches (reject -> exact fallback).
+    from ba_tpu.crypto.signed import verify_received
+
+    rng = np.random.default_rng(26)
+    B, n = 4, 4
+    pks, msgs, sigs, *_ = _rlc_fixture(rng, B, n)
+    monkeypatch.setenv("BA_TPU_VERIFY_RLC", "1")
+    got = np.asarray(verify_received(pks, msgs, sigs))
+    assert got.all() and got.shape == (B, n)
+    s2 = np.array(sigs)
+    s2[0, 3, 10] ^= 0x04
+    got2 = np.asarray(verify_received(pks, msgs, s2))
+    monkeypatch.setenv("BA_TPU_VERIFY_RLC", "0")
+    want2 = np.asarray(verify_received(pks, msgs, s2))
+    np.testing.assert_array_equal(got2, want2)
+    assert not got2[0, 3] and got2.sum() == B * n - 1
