@@ -580,7 +580,7 @@ def bench_sweep10k_signed(jax, jnp, jr):
     # -> 24.7M/31.2M/37.3M rounds/s at K=15/30/60 same-window); compile
     # cost grows with K, so the knob stays a knob.  The XLA path is one
     # round per call, so K applies only when fused.
-    fused_rounds = int(os.environ.get("BA_TPU_FUSED_ROUNDS", 60))
+    fused_rounds = int(os.environ.get("BA_TPU_FUSED_ROUNDS", 120))
     rounds_per_step = fused_rounds if use_fused else 1
     if use_fused:
         from ba_tpu.ops.sweep_step import fused_signed_sweep_step
