@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from ba_tpu.crypto import field as F
 from ba_tpu.crypto.oracle import B_X, B_Y, D, L, P, SQRT_M1
 from ba_tpu.crypto.scalar import reduce_mod_l
-from ba_tpu.crypto.sha512 import sha512
+from ba_tpu.crypto.sha512 import sha512, sha512_mod_l
 
 
 from ba_tpu.utils.platform import use_pallas as _use_pallas  # shared flag
@@ -211,9 +211,20 @@ def point_eq(p: Point, q: Point) -> jnp.ndarray:
 
 
 def compress(p: Point) -> jnp.ndarray:
-    """Point -> 32-byte encoding (y with the sign of x in the top bit)."""
+    """Point -> 32-byte encoding (y with the sign of x in the top bit).
+
+    The modular inverse of Z dominates (one Fermat exponentiation per
+    lane); on the Pallas path it runs the p-2 addition-chain kernel
+    (ops/powchain.inv_chain: 254 squarings + 13 muls, VMEM-resident) —
+    the hot piece of the device signer's R encoding.
+    """
     x, y, z, _ = p
-    zi = F.inv(z)
+    if _use_pallas() and z.ndim == 2:
+        from ba_tpu.ops.powchain import pow_planes
+
+        zi = pow_planes(z, P - 2)
+    else:
+        zi = F.inv(z)
     xa = F.canonical(F.mul(x, zi))
     ya = F.canonical(F.mul(y, zi))
     by = F.to_bytes(ya)
@@ -365,16 +376,13 @@ def verify_rlc(
     enc_ok = jnp.repeat(ok_a, pk_group, axis=0) & ok_r & ok_s
     z = jnp.where(enc_ok[:, None], z, 0).astype(jnp.uint8)
 
-    h_bytes = sha512(jnp.concatenate([r_enc, pk, msg], axis=-1))
     if _use_pallas():
         from ba_tpu.ops.ladder import window_mult
-        from ba_tpu.ops.modl import reduce_mod_l_planes as _modl
 
         _mult = window_mult
     else:
-        _modl = reduce_mod_l
         _mult = scalar_mult
-    h = _modl(h_bytes)  # [B, 32]
+    h = sha512_mod_l(jnp.concatenate([r_enc, pk, msg], axis=-1))  # [B, 32]
     w = sum_mod_l(mul_mod_l(h, z).reshape(K, pk_group, 32))  # [K, 32]
     c = sum_mod_l(mul_mod_l(s_enc, z))  # combined S coefficient [32]
 
@@ -387,6 +395,55 @@ def verify_rlc(
         right = point_add(right, right)
     batch_ok = point_eq(left, right)[0] & jnp.all(enc_ok)
     return batch_ok, enc_ok
+
+
+def clamp_scalar(h32: jnp.ndarray) -> jnp.ndarray:
+    """RFC 8032 5.1.5 clamp of the low digest half -> the secret scalar a:
+    clear the 3 low bits (cofactor), clear bit 255, set bit 254."""
+    a = h32.at[..., 0].set(h32[..., 0] & 0xF8)
+    return a.at[..., 31].set((h32[..., 31] & 0x3F) | 0x40)
+
+
+def sign(sk: jnp.ndarray, pk: jnp.ndarray, msg: jnp.ndarray) -> jnp.ndarray:
+    """Batched Ed25519 SIGNING on device: sk [B, 32], pk [B, 32],
+    msg [B, L] (L static) uint8 -> sig [B, 64] uint8, byte-identical to
+    ``oracle.sign`` per lane (Ed25519 is deterministic; pinned by
+    tests/test_crypto.py's differential).
+
+    RFC 8032 5.1.6 with every stage batched on the accelerator — the
+    sign-side half of the north star's "batched Ed25519 sign/verify
+    kernel" obligation (SURVEY.md section 2.3; the reference signs
+    nothing, /root/reference/ba.py:39-57, so this is blueprint-driven):
+
+    - key expansion + nonce + challenge are three ``sha512`` calls (the
+      80-round Mosaic kernel on TPU, ops/sha512_kernel.py);
+    - r and h reduce mod L on device (ops/modl.py kernel);
+    - R = [r]B is the SAME fixed-base window path verification uses
+      (one-hot int8 MXU einsums + the 63-add VMEM fold, ``fixed_base_mult``)
+      — no ladder anywhere: signing is fixed-base only;
+    - R's encoding inverts Z via the p-2 addition-chain kernel
+      (``compress`` -> ops/powchain.inv_chain);
+    - S = (r + h*a) mod L is one 32x32-limb MXU convolution
+      (scalar.muladd_bytes) + a mod-L reduction.
+
+    The oracle feeds the unreduced 512-bit nonce to [r]B; reducing r mod
+    L first yields the same point (B generates the prime-order subgroup)
+    and the same S (arithmetic mod L), hence the same bytes.
+    """
+    from ba_tpu.crypto.scalar import muladd_bytes
+
+    if _use_pallas():
+        from ba_tpu.ops.modl import reduce_mod_l_planes as _modl
+    else:
+        _modl = reduce_mod_l
+    h1 = sha512(sk)
+    a = clamp_scalar(h1[..., :32])
+    prefix = h1[..., 32:]
+    r = sha512_mod_l(jnp.concatenate([prefix, msg], axis=-1))
+    r_enc = compress(fixed_base_mult(r))
+    k = sha512_mod_l(jnp.concatenate([r_enc, pk, msg], axis=-1))
+    s = _modl(muladd_bytes(k, a, r))
+    return jnp.concatenate([r_enc, s], axis=-1)
 
 
 def verify(pk: jnp.ndarray, msg: jnp.ndarray, sig: jnp.ndarray) -> jnp.ndarray:
@@ -405,16 +462,19 @@ def verify(pk: jnp.ndarray, msg: jnp.ndarray, sig: jnp.ndarray) -> jnp.ndarray:
     r_pt = tuple(c[B:] for c in pts)
     ok_a, ok_r = oks[:B], oks[B:]
     ok_s = _lt_const(s_enc, L)
-    h_bytes = sha512(jnp.concatenate([r_enc, pk, msg], axis=-1))
-    if _use_pallas():
-        from ba_tpu.ops.ladder import window_mult
-        from ba_tpu.ops.modl import reduce_mod_l_planes
-
-        h_bits = F.bytes_to_bits(reduce_mod_l_planes(h_bytes))
-        ha = window_mult(a_pt, h_bits)
-    else:
-        h_bits = F.bytes_to_bits(reduce_mod_l(h_bytes))  # [B, 256]
-        ha = scalar_mult(a_pt, h_bits)
     left = fixed_base_mult(s_enc)
+    h_bits = F.bytes_to_bits(
+        sha512_mod_l(jnp.concatenate([r_enc, pk, msg], axis=-1))
+    )  # [B, 256]
+    if _use_pallas():
+        # Fused tail (r5): h = H(R||A||M) mod L in one sha+modl kernel,
+        # then [h]A + the completion add + the projective equality in one
+        # window kernel — the two non-ladder stages VERDICT r4 flagged
+        # (mod_l 569 ns/sig, finish_add_eq 584 ns/sig standalone) stop
+        # existing as dispatches.
+        from ba_tpu.ops.ladder import window_verify
+
+        return ok_a & ok_r & ok_s & window_verify(a_pt, h_bits, r_pt, left)
+    ha = scalar_mult(a_pt, h_bits)
     right = point_add(r_pt, ha)
     return ok_a & ok_r & ok_s & point_eq(left, right)

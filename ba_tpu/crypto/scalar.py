@@ -49,6 +49,12 @@ for _i in range(32):
     for _j in range(16):
         _CONV_32x16[_i * 16 + _j, _i + _j] = 1
 
+# Full 32x32-limb variant for the device signer's k * a (both 256-bit).
+_CONV_32x32 = np.zeros((32 * 32, 63), np.int32)
+for _i in range(32):
+    for _j in range(32):
+        _CONV_32x32[_i * 32 + _j, _i + _j] = 1
+
 
 def _const_limbs(v: int, n: int) -> np.ndarray:
     out = np.zeros(n, np.int32)
@@ -161,11 +167,16 @@ def reduce_mod_l(h_bytes: jnp.ndarray) -> jnp.ndarray:
     return v.astype(jnp.uint8)
 
 
-def _bytes_from_signed_limbs(v: jnp.ndarray, total: int) -> jnp.ndarray:
+def _bytes_from_signed_limbs(
+    v: jnp.ndarray, total: int, extra: int = 2
+) -> jnp.ndarray:
     """Signed int32 limbs of a NON-NEGATIVE value -> canonical uint8
     [..., total] (zero-padded).  Carries are settled with parallel passes
-    then one exact chain; ``total`` must cover the value's byte length."""
-    v = _carry(v, passes=3, extra=2)
+    then one exact chain; ``total`` must cover the value's byte length and
+    ``extra`` must give the settled value's top limbs room — the value must
+    fit ``8 * (v.shape[-1] + extra)`` bits, else the top carry is silently
+    dropped (callers size ``extra`` from their static bounds)."""
+    v = _carry(v, passes=3, extra=extra)
     v = _exact_chain(v)
     pad = total - v.shape[-1]
     if pad > 0:
@@ -194,12 +205,46 @@ def mul_mod_l(a_bytes: jnp.ndarray, z_bytes: jnp.ndarray) -> jnp.ndarray:
     return reduce_mod_l(_bytes_from_signed_limbs(conv, 64))
 
 
+def muladd_bytes(
+    k_bytes: jnp.ndarray, a_bytes: jnp.ndarray, r_bytes: jnp.ndarray
+) -> jnp.ndarray:
+    """Batched ``k * a + r`` settled to canonical bytes: k, a, r uint8
+    [..., 32] little-endian -> uint8 [..., 64] (UNREDUCED — the value is
+    < 2^508 + 2^256, which the 64-byte ``reduce_mod_l`` input covers).
+
+    The device signer's S-side arithmetic (ed25519.sign): S = (r + k*a)
+    mod L with k the challenge scalar, a the clamped secret scalar
+    (< 2^255), r the per-signature nonce.  Split from the mod-L reduction
+    so callers pick the reduction substrate (``reduce_mod_l`` here, the
+    ops/modl.py Pallas kernel on TPU).  Schoolbook terms peak at
+    32 * 255^2 + 255 ~ 2.08e6 — int32-safe; the settled value fits 64
+    bytes with the default 2-limb carry headroom (63 + 2 limbs = 520
+    bits > 509).  Differential contract in tests/test_crypto.py.
+    """
+    k = k_bytes.astype(jnp.int32)
+    a = a_bytes.astype(jnp.int32)
+    outer = k[..., :, None] * a[..., None, :]
+    flat = outer.reshape(*outer.shape[:-2], 32 * 32)
+    conv = jnp.matmul(flat, jnp.asarray(_CONV_32x32))  # [..., 63]
+    conv = conv.at[..., :32].add(r_bytes.astype(jnp.int32))
+    return _bytes_from_signed_limbs(conv, 64)
+
+
 def sum_mod_l(v_bytes: jnp.ndarray, axis: int = -2) -> jnp.ndarray:
     """Batched ``sum mod L`` over ``axis``: uint8 [..., G, 32] -> [..., 32].
 
-    Limb-wise int32 sums stay exact for G <= ~8.4M (G * 255 < 2^31); the
-    summed value is < G * L < 2^(253 + 23), which the 64-byte
-    ``reduce_mod_l`` input covers with room to spare.
+    Exact for G <= ~8.4M (G * 255 < 2^31 keeps limb-wise int32 sums
+    exact; asserted below from the static shape).  The settled sum is
+    < G * L < 2^(253 + 23), so the carry headroom passed to
+    ``_bytes_from_signed_limbs`` is sized from the static G — the fixed
+    default (2 extra limbs = 34 bytes) only covers G <= ~2^20, beyond
+    which the top carry would be silently dropped (ADVICE r4 medium;
+    test_sum_mod_l_above_default_headroom pins the large-G case).  The
+    64-byte ``reduce_mod_l`` input covers the result either way.
     """
+    G = v_bytes.shape[axis]
+    assert G * 255 < 2**31, f"G={G} overflows int32 limb sums (G > ~8.4M)"
+    # Capacity: value < G * L < 2^(252 + bitlen(G)); limbs hold 8 bits each.
+    extra = max(2, (252 + G.bit_length() + 7) // 8 + 1 - 32)
     s = v_bytes.astype(jnp.int32).sum(axis=axis)
-    return reduce_mod_l(_bytes_from_signed_limbs(s, 64))
+    return reduce_mod_l(_bytes_from_signed_limbs(s, 64, extra=extra))

@@ -199,8 +199,9 @@ def _pad_layout(nbytes: int) -> tuple[int, np.ndarray]:
     return total // 128, tail
 
 
-def sha512(msg: jnp.ndarray) -> jnp.ndarray:
-    """Batched SHA-512: uint8 [B, L] -> uint8 [B, 64].  L is static."""
+def _message_words(msg: jnp.ndarray):
+    """Pad + split a static-length message batch into big-endian word
+    halves: uint8 [B, L] -> (wh, wl) [B, n_blocks, 16] uint32, n_blocks."""
     B, nbytes = msg.shape
     n_blocks, tail = _pad_layout(nbytes)
     padded = jnp.concatenate(
@@ -211,8 +212,35 @@ def sha512(msg: jnp.ndarray) -> jnp.ndarray:
     by = padded.reshape(B, n_blocks * 32, 4).astype(jnp.uint32)
     words = (by[..., 0] << 24) | (by[..., 1] << 16) | (by[..., 2] << 8) | by[..., 3]
     words = words.reshape(B, n_blocks, 16, 2)
-    wh = words[..., 0]
-    wl = words[..., 1]
+    return words[..., 0], words[..., 1], n_blocks
+
+
+def sha512_mod_l(msg: jnp.ndarray) -> jnp.ndarray:
+    """Batched ``SHA-512(msg) mod L``: uint8 [B, L] -> uint8 [B, 32].
+
+    The scalar-derivation composite both verification (h = H(R||A||M))
+    and signing (r = H(prefix||M)) need: on TPU it is ONE fused Mosaic
+    kernel (ops/sha512_kernel.sha512_blocks_mod_l — the digest bytes
+    never leave registers on their way into the mod-L fold chain); the
+    jnp fallback composes the two stages, so the accept set is identical
+    on every platform.
+    """
+    from ba_tpu.utils.platform import use_pallas
+
+    if use_pallas():
+        from ba_tpu.ops.sha512_kernel import sha512_blocks_mod_l
+
+        wh, wl, n_blocks = _message_words(msg)
+        return sha512_blocks_mod_l(wh, wl, n_blocks)
+    from ba_tpu.crypto.scalar import reduce_mod_l
+
+    return reduce_mod_l(sha512(msg))
+
+
+def sha512(msg: jnp.ndarray) -> jnp.ndarray:
+    """Batched SHA-512: uint8 [B, L] -> uint8 [B, 64].  L is static."""
+    B = msg.shape[0]
+    wh, wl, n_blocks = _message_words(msg)
 
     from ba_tpu.utils.platform import use_pallas
 

@@ -128,16 +128,7 @@ def sign_value_tables(
     void the anti-cross-instance-replay binding (module docstring).
     """
     B = len(sks)
-    # Vectorized order_message: byte-identical to the per-call encoder
-    # (pinned by test_sign_value_tables_match_order_message) but O(1)
-    # numpy ops instead of 2B Python calls — at sweep scale the loop was
-    # a measurable slice of the signing setup the north star amortizes.
-    msgs = np.zeros((B, n_values, MSG_LEN), np.uint8)
-    msgs[:, :, 0:4] = np.frombuffer(_MAGIC, np.uint8)
-    msgs[:, :, 4:8] = (
-        np.arange(base, base + B, dtype="<u4").view(np.uint8).reshape(B, 1, 4)
-    )
-    msgs[:, :, 8] = np.arange(n_values, dtype=np.uint8)[None, :]
+    msgs = _value_table_msgs(B, n_values, base)
     nat = _native_or_none()
     if nat is not None:
         sk_arr = np.repeat(
@@ -154,6 +145,61 @@ def sign_value_tables(
                 host_sign(sk, pk, msgs[b, v].tobytes()), np.uint8
             )
     return msgs, sigs
+
+
+def _value_table_msgs(B: int, n_values: int, base: int) -> np.ndarray:
+    """Vectorized order_message over the table grid: byte-identical to the
+    per-call encoder (pinned by test_sign_value_tables_match_order_message)
+    but O(1) numpy ops instead of 2B Python calls — at sweep scale the
+    loop was a measurable slice of the signing setup."""
+    msgs = np.zeros((B, n_values, MSG_LEN), np.uint8)
+    msgs[:, :, 0:4] = np.frombuffer(_MAGIC, np.uint8)
+    msgs[:, :, 4:8] = (
+        np.arange(base, base + B, dtype="<u4").view(np.uint8).reshape(B, 1, 4)
+    )
+    msgs[:, :, 8] = np.arange(n_values, dtype=np.uint8)[None, :]
+    return msgs
+
+
+_sign_jit = None  # lazily-created jitted ed25519.sign (shared cache)
+
+
+def sign_value_tables_device(
+    sks: list[bytes], pks: np.ndarray, n_values: int = 2, base: int = 0
+):
+    """``sign_value_tables`` with the signing itself ON THE DEVICE: the
+    sign-side half of the north star's batched-kernel obligation
+    (ba_tpu.crypto.ed25519.sign — SHA-512, mod-L, fixed-base [r]B and the
+    inv-chain compress all run as TPU kernels).
+
+    Returns ``(msgs, sigs)`` where msgs is host numpy uint8
+    [B, V, MSG_LEN] and sigs is a DEVICE array uint8 [B, V, 64] — the
+    dispatch returns on ACK (tunnel semantics), so callers overlap
+    downstream device work (the table verify) for free and fetch sigs
+    once at drain time (``setup_signed_tables_overlapped`` does).  Bytes
+    are identical to the host/oracle tables (Ed25519 determinism; pinned
+    by test_setup_device_sign_matches_host).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ba_tpu.crypto import ed25519
+
+    global _sign_jit
+    if _sign_jit is None:
+        _sign_jit = jax.jit(ed25519.sign)
+    B = len(sks)
+    msgs = _value_table_msgs(B, n_values, base)
+    sk_arr = np.repeat(
+        np.stack([np.frombuffer(s, np.uint8) for s in sks]), n_values, axis=0
+    )
+    pk_arr = np.repeat(np.asarray(pks, np.uint8), n_values, axis=0)
+    sigs = _sign_jit(
+        jnp.asarray(sk_arr),
+        jnp.asarray(pk_arr),
+        jnp.asarray(msgs.reshape(B * n_values, MSG_LEN)),
+    )
+    return msgs, sigs.reshape(B, n_values, 64)
 
 
 def sign_received(
@@ -311,28 +357,27 @@ def fresh_rlc_coeffs(total: int) -> np.ndarray:
     )
 
 
-def verify_received_rlc(pks, msgs, sigs):
-    """Batched verification via ONE random-linear-combination check, with
-    an exact per-signature fallback on reject: -> [B, n] bool mask.
+def rlc_batch_ok(pks, msgs, sigs):
+    """Dispatch the chunked RLC batch check and return the DEVICE scalar
+    verdict ("every signature valid") WITHOUT fetching it.
 
-    The common case of every hot path is all-valid signatures (honest
-    commanders sign correctly; the adversary model corrupts *values*, not
-    usually encodings), and there ``ed25519.verify_rlc`` replaces B*n
-    independent verifies with one combined equation at roughly half the
-    per-lane ladder work and no per-lane fixed-base multiply (the [W]A
-    ladders also collapse n-fold because each instance's n copies share a
-    commander key).  On a reject — any invalid signature — the exact
-    per-signature ``verify_received`` runs and its mask is returned; only
-    the (rare) mixed-validity case pays both dispatches.  Soundness: a
-    batch containing a signature with a prime-order defect passes the
-    combined check with probability ~2^-125 over the fresh coefficients.
-    One DOCUMENTED divergence from the per-signature path: the batch
-    check is cofactored (the batch-Ed25519 standard), so a signer's own
-    torsion-malleated signature — R deliberately offset by a small-order
-    point — is accepted here but rejected by the cofactorless per-lane
-    path; see ed25519.verify_rlc's contract for why this does not weaken
-    the commander-to-value binding.  Callers that need strict
-    cofactorless semantics must use ``verify_received`` directly.
+    The overlap primitive behind both RLC routes: dispatches return on
+    ACK (tunnel semantics), so callers queue the check behind other
+    device work and fetch the verdict once at drain time
+    (``setup_signed_tables_overlapped`` under ``BA_TPU_VERIFY_RLC=1``);
+    ``verify_received_rlc`` is the blocking wrapper.
+
+    Chunking (ADVICE r4): large batches pad to a fixed multiple of the
+    per-dispatch chunk (a multiple of ``n`` so the pk-group layout
+    survives), so one compiled program serves every production-scale
+    call instead of a monolithic kernel per (B, n) shape; calls SMALLER
+    than a chunk dispatch at their own lane count — same policy as
+    ``_verify_received_exact``, because padding a 20-lane call to the
+    64k production chunk would multiply its cost ~3000x, not cap it.
+    Padding replicates the leading pk-group: replicated-valid lanes fold
+    to the identity defect (no effect), replicated-invalid lanes keep a
+    nonzero defect (still reject, and a reject only ever routes to the
+    exact fallback) — so padding never flips a verdict that matters.
     """
     import jax
     import jax.numpy as jnp
@@ -350,12 +395,69 @@ def verify_received_rlc(pks, msgs, sigs):
     B, n = msgs.shape[:2]
     total = B * n
     pk_bn = jnp.broadcast_to(pks[:, None, :], (B, n, 32)).reshape(total, 32)
-    z = jnp.asarray(fresh_rlc_coeffs(total))
-    batch_ok, _ = _verify_rlc_jit(
-        pk_bn, msgs.reshape(total, -1), sigs.reshape(total, 64), z,
-        pk_group=n,
-    )
-    if bool(batch_ok):
+    msgs_f = msgs.reshape(total, -1)
+    sigs_f = sigs.reshape(total, 64)
+    chunk = min(max(n, (_verify_chunk() // n) * n), total)
+    pad = (-total) % chunk
+    if pad:
+        reps = pad // n  # pad whole pk-groups to keep group-major layout
+        pk_bn = jnp.concatenate([pk_bn, jnp.tile(pk_bn[:n], (reps, 1))])
+        msgs_f = jnp.concatenate([msgs_f, jnp.tile(msgs_f[:n], (reps, 1))])
+        sigs_f = jnp.concatenate([sigs_f, jnp.tile(sigs_f[:n], (reps, 1))])
+    z = jnp.asarray(fresh_rlc_coeffs(total + pad))
+    oks = [
+        _verify_rlc_jit(
+            pk_bn[o : o + chunk],
+            msgs_f[o : o + chunk],
+            sigs_f[o : o + chunk],
+            z[o : o + chunk],
+            pk_group=n,
+        )[0]
+        for o in range(0, total + pad, chunk)
+    ]
+    return oks[0] if len(oks) == 1 else jnp.stack(oks).all()
+
+
+def verify_received_rlc(pks, msgs, sigs):
+    """Batched verification via the random-linear-combination check, with
+    an exact per-signature fallback on reject: -> [B, n] bool mask.
+
+    The common case of every hot path is all-valid signatures (honest
+    commanders sign correctly; the adversary model corrupts *values*, not
+    usually encodings), and there ``ed25519.verify_rlc`` replaces B*n
+    independent verifies with one combined equation per chunk at roughly
+    half the per-lane ladder work and no per-lane fixed-base multiply
+    (the [W]A ladders also collapse n-fold because each instance's n
+    copies share a commander key).  On a reject — any invalid signature —
+    the exact per-signature ``verify_received`` runs and its mask is
+    returned; only the (rare) mixed-validity case pays both dispatches.
+    Soundness: a batch containing a signature with a prime-order defect
+    passes the combined check with probability ~2^-125 over the fresh
+    coefficients.
+
+    DOCUMENTED divergences from the per-signature path (see
+    ed25519.verify_rlc's contract for why neither weakens the
+    commander-to-value binding):
+
+    - the batch check is cofactored (the batch-Ed25519 standard), so a
+      signer's own torsion-malleated signature — R deliberately offset
+      by a small-order point — is accepted here but rejected by the
+      cofactorless per-lane path;
+    - consequently RLC-mode acceptance of such a signature is
+      BATCH-DEPENDENT (ADVICE r4): in an all-otherwise-valid batch the
+      cofactored check accepts it, but if ANY other lane is invalid the
+      batch rejects and the cofactorless fallback rejects the malleated
+      lane too.  The divergence stays one-sided either way (only ever
+      *extra* accepts of a signer's own malleated encoding, never a
+      forgery), and only RLC mode exhibits the batch dependence.
+
+    Callers that need strict cofactorless semantics must use
+    ``verify_received`` directly.
+    """
+    import jax.numpy as jnp
+
+    B, n = np.shape(msgs)[:2]
+    if bool(rlc_batch_ok(pks, msgs, sigs)):
         return jnp.ones((B, n), bool)
     return _verify_received_exact(pks, msgs, sigs)
 
@@ -380,10 +482,20 @@ def setup_signed_tables_overlapped(
     the chunk's own lane count — no padding to the 64k production chunk);
     callers warm that shape off the clock with ``warm_signed_tables``.
 
+    ``BA_TPU_SIGN_DEVICE=1`` moves the signing itself onto the TPU
+    (``sign_value_tables_device``): each chunk's sign program queues
+    behind the previous chunk's verify, the host loop only builds
+    messages and dispatches, and everything drains at the final fetch —
+    host CPU leaves the critical path entirely (the r4 measurement that
+    motivated this: host sign_s 0.29-0.31 s was the dominant setup cost,
+    SETUP_AB_r4.json).
+
     Returns ``(sks, pks, msgs_t, sigs_t, ok, timings)`` where timings has
-    ``keys_s`` (keygen), ``sign_s`` (host signing, sum over chunks),
-    ``drain_s`` (wall time from last sign to verified mask on host — the
-    un-overlapped residual), and ``total_s`` (whole setup wall clock).
+    ``keys_s`` (keygen), ``sign_s`` (host signing work: with device
+    signing this is just message-building + dispatch), ``drain_s`` (wall
+    time from last dispatch to verified mask + signature bytes on host —
+    the un-overlapped residual), and ``total_s`` (whole setup wall
+    clock).
     """
     import time
 
@@ -392,33 +504,57 @@ def setup_signed_tables_overlapped(
 
     if not 1 <= chunks <= batch:
         raise ValueError(f"chunks={chunks} out of range for batch={batch}")
+    device_sign = os.environ.get("BA_TPU_SIGN_DEVICE", "0") == "1"
+    # RLC table-verify (BA_TPU_VERIFY_RLC=1) is DEFERRED-FETCH here: each
+    # chunk dispatches its combined check without fetching the verdict
+    # (rlc_batch_ok returns a device scalar), so the overlap with signing
+    # survives; ALL verdicts fetch in one drain, and only a rejecting
+    # chunk — impossible for self-signed tables, so never on this path in
+    # production — pays the exact per-signature fallback.  r4 excluded
+    # RLC from setup because the old wrapper's accept/fallback decision
+    # was a blocking fetch per chunk that serialized the loop (VERDICT r4
+    # item 3a); splitting dispatch from fetch dissolves that objection.
+    rlc = os.environ.get("BA_TPU_VERIFY_RLC", "0") == "1"
     t_start = time.perf_counter()
     sks, pks = commander_keys(batch, seed)
     t_keys = time.perf_counter() - t_start
     per = -(-batch // chunks)
     sign_s = 0.0
-    msgs_parts, sigs_parts, oks = [], [], []
+    msgs_parts, sigs_parts, oks, deferred = [], [], [], []
     for lo in range(0, batch, per):
         hi = min(batch, lo + per)
         t0 = time.perf_counter()
-        m_c, s_c = sign_value_tables(sks[lo:hi], pks[lo:hi], base=lo)
+        if device_sign:
+            m_c, s_c = sign_value_tables_device(sks[lo:hi], pks[lo:hi], base=lo)
+        else:
+            m_c, s_c = sign_value_tables(sks[lo:hi], pks[lo:hi], base=lo)
         sign_s += time.perf_counter() - t0
         msgs_parts.append(m_c)
         sigs_parts.append(s_c)
         pk_c = pks[lo:hi]
         if hi - lo < per:  # pad the tail chunk so every dispatch shares
             pad = per - (hi - lo)  # one compiled shape (warmed off-clock)
+            xp = jnp if device_sign else np
             pk_c = np.concatenate([pk_c, np.tile(pk_c[:1], (pad, 1))])
             m_c = np.concatenate([m_c, np.tile(m_c[:1], (pad, 1, 1))])
-            s_c = np.concatenate([s_c, np.tile(s_c[:1], (pad, 1, 1))])
-        # ALWAYS the exact per-signature path, knob or no knob: the
-        # overlap depends on this dispatch returning on ACK, and the RLC
-        # route's accept/fallback decision is a blocking host fetch that
-        # would serialize the loop back to sign + verify.
-        oks.append(_verify_received_exact(pk_c, m_c, s_c)[: hi - lo])
+            s_c = xp.concatenate([s_c, xp.tile(s_c[:1], (pad, 1, 1))])
+        if rlc:
+            deferred.append((rlc_batch_ok(pk_c, m_c, s_c), pk_c, m_c, s_c))
+        else:
+            oks.append(_verify_received_exact(pk_c, m_c, s_c)[: hi - lo])
     t_signed = time.perf_counter()
+    if rlc:
+        flags = jax.device_get([d[0] for d in deferred])  # ONE drain fetch
+        for flag, (_, pk_c, m_c, s_c) in zip(flags, deferred):
+            keep = min(per, batch - per * len(oks))
+            if flag:
+                oks.append(jnp.ones((keep, m_c.shape[1]), bool))
+            else:  # rare: an invalid table signature slipped in
+                oks.append(_verify_received_exact(pk_c, m_c, s_c)[:keep])
     ok = jnp.concatenate(oks) if len(oks) > 1 else oks[0]
     jax.device_get(ok)  # host fetch: genuinely drain the verify queue
+    if device_sign:  # signature bytes live on device until fetched
+        sigs_parts = [np.asarray(s) for s in sigs_parts]
     t_end = time.perf_counter()
     msgs_t = np.concatenate(msgs_parts)
     sigs_t = np.concatenate(sigs_parts)
@@ -428,6 +564,7 @@ def setup_signed_tables_overlapped(
         "drain_s": t_end - t_signed,
         "total_s": t_end - t_start,
         "chunks": len(oks),
+        "device_sign": device_sign,
     }
     return sks, pks, msgs_t, sigs_t, ok, timings
 
@@ -441,9 +578,17 @@ def warm_signed_tables(batch: int, chunks: int = 4) -> None:
     """
     per = -(-batch // chunks)
     sks, pks = commander_keys(per, seed=987654321)
-    m_c, s_c = sign_value_tables(sks, pks)
+    if os.environ.get("BA_TPU_SIGN_DEVICE", "0") == "1":
+        m_c, s_c = sign_value_tables_device(sks, pks)  # warm the signer too
+    else:
+        m_c, s_c = sign_value_tables(sks, pks)
     import jax
 
+    if os.environ.get("BA_TPU_VERIFY_RLC", "0") == "1":
+        # Warm the program the setup will actually dispatch (the deferred
+        # RLC route); the exact program stays warm too — it is the
+        # fallback on reject.
+        jax.device_get(rlc_batch_ok(pks, m_c, s_c))
     jax.device_get(_verify_received_exact(pks, m_c, s_c))
 
 

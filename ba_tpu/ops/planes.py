@@ -31,15 +31,21 @@ def const_planes(v: int) -> list[int]:
     return [int(x) for x in _np_limbs(v % P_INT)]
 
 
+def p_fold_pass(x: list) -> list:
+    """field._fold_pass() on planes: one parallel carry pass, limb 21's
+    carry wrapping to limb 0 * FOLD (exact for negative limbs)."""
+    c = [v >> BITS for v in x]
+    r = [v - (cc << BITS) for v, cc in zip(x, c)]
+    return [
+        r[k] + (c[k - 1] if k > 0 else c[LIMBS - 1] * FOLD)
+        for k in range(LIMBS)
+    ]
+
+
 def p_carry(x: list) -> list:
     """field.carry() on planes: 5 parallel fold passes, same contract."""
     for _ in range(5):
-        c = [v >> BITS for v in x]
-        r = [v - (cc << BITS) for v, cc in zip(x, c)]
-        x = [
-            r[k] + (c[k - 1] if k > 0 else c[LIMBS - 1] * FOLD)
-            for k in range(LIMBS)
-        ]
+        x = p_fold_pass(x)
     return x
 
 
@@ -92,6 +98,49 @@ def p_select(mask, a: list, b: list) -> list:
 def p_point_select(mask, p: tuple, q: tuple) -> tuple:
     """Point-level select: (X, Y, Z, T) plane-list tuples."""
     return tuple(p_select(mask, a, b) for a, b in zip(p, q))
+
+
+_16P_PLANES = [int(x) for x in _np_limbs(16 * P_INT)]
+_P_PLANES = [int(x) for x in _np_limbs(P_INT)]
+
+
+def p_canonical(a: list) -> list:
+    """field.canonical() on planes: the unique representative in [0, p),
+    every limb in [0, 4096).  Same pass structure limb for limb (so the
+    two stay differentially testable); sequential chains are free here —
+    "limbs" are vector registers inside a kernel."""
+    a = [x + c for x, c in zip(p_carry(a), _16P_PLANES)]
+    a = p_carry(a)
+    for _ in range(3):
+        top = a[LIMBS - 1] >> 4
+        a[LIMBS - 1] = a[LIMBS - 1] - (top << 4)
+        a[0] = a[0] + top * 38
+        a = p_fold_pass(a)
+    for _ in range(3):
+        borrow = a[0] * 0
+        limbs = []
+        for i in range(LIMBS):
+            v = a[i] - _P_PLANES[i] + borrow
+            borrow = v >> BITS
+            limbs.append(v - (borrow << BITS))
+        ge = borrow >= 0
+        a = [jnp.where(ge, l, x) for l, x in zip(limbs, a)]
+    c = a[0] * 0
+    out = []
+    for i in range(LIMBS):
+        v = a[i] + c
+        c = v >> BITS
+        out.append(v - (c << BITS))
+    return out
+
+
+def p_eq(a: list, b: list):
+    """field.eq() on planes: canonical equality -> a bool array."""
+    ok = None
+    for x, y in zip(p_canonical(a), p_canonical(b)):
+        e = x == y
+        ok = e if ok is None else (ok & e)
+    return ok
 
 
 # -- Edwards points as 4 plane lists (X, Y, Z, T) -----------------------------

@@ -45,16 +45,12 @@ from ba_tpu.ops.planes import const_planes, p_carry, p_mul, p_select
 _ONE_PLANES = const_planes(1)
 
 
-def sqrt_chain(z, mul, sq_n):
-    """z ** (2^252 - 3) via the 2^k-1 addition-chain tower.
+def _tower_250(z, mul, sq_n):
+    """The shared 2^k-1 tower: returns t_250 = z^(2^250 - 1).
 
-    Generic over the arithmetic: ``mul(a, b)`` multiplies, ``sq_n(x, n)``
-    squares n times (n static).  The kernel instantiates it with plane ops
-    + fori_loop; tests instantiate it with ba_tpu.crypto.field on plain
-    arrays to pin the algebra against pow_const.
-
-    Invariant: t_k = z^(2^k - 1); t_{2k} = t_k^(2^k) * t_k;
-    the result is t_250^(2^2) * z = z^((2^250-1)*4 + 1) = z^(2^252 - 3).
+    Invariant: t_k = z^(2^k - 1); t_{2k} = t_k^(2^k) * t_k.  Generic over
+    the arithmetic so the kernels (plane ops + fori_loop) and the CPU
+    algebra tests (ba_tpu.crypto.field on plain arrays) share one chain.
     """
     t1 = z
     t2 = mul(sq_n(t1, 1), t1)
@@ -66,8 +62,27 @@ def sqrt_chain(z, mul, sq_n):
     t50 = mul(sq_n(t40, 10), t10)
     t100 = mul(sq_n(t50, 50), t50)
     t200 = mul(sq_n(t100, 100), t100)
-    t250 = mul(sq_n(t200, 50), t50)
-    return mul(sq_n(t250, 2), z)
+    return mul(sq_n(t200, 50), t50)
+
+
+def sqrt_chain(z, mul, sq_n):
+    """z ** (2^252 - 3) via the 2^k-1 addition-chain tower: the result is
+    t_250^(2^2) * z = z^((2^250-1)*4 + 1) = z^(2^252 - 3)."""
+    return mul(sq_n(_tower_250(z, mul, sq_n), 2), z)
+
+
+def inv_chain(z, mul, sq_n):
+    """z ** (p - 2) = 1/z via the same tower: p - 2 = 2^255 - 21 =
+    (2^250 - 1) * 2^5 + 11, so the result is t_250^(2^5) * z^11 — 254
+    squarings + 13 multiplies vs ~505 muls for bit-chain square-and-
+    multiply.  The device signer's point compression is the caller
+    (ba_tpu.crypto.ed25519.compress): one modular inverse per signature
+    to land the projective R on affine coordinates before encoding.
+    """
+    z2 = sq_n(z, 1)
+    z9 = mul(sq_n(z2, 2), z)  # z^8 * z
+    z11 = mul(z9, z2)
+    return mul(sq_n(_tower_250(z, mul, sq_n), 5), z11)
 
 
 def p_sq_n(x, n):
@@ -79,6 +94,13 @@ def p_sq_n(x, n):
 def _sqrt_chain_kernel(a_ref, out_ref):
     z = p_carry([a_ref[i] for i in range(LIMBS)])
     result = sqrt_chain(z, p_mul, p_sq_n)
+    for i in range(LIMBS):
+        out_ref[i] = result[i]
+
+
+def _inv_chain_kernel(a_ref, out_ref):
+    z = p_carry([a_ref[i] for i in range(LIMBS)])
+    result = inv_chain(z, p_mul, p_sq_n)
     for i in range(LIMBS):
         out_ref[i] = result[i]
 
@@ -101,6 +123,7 @@ def _pow_kernel(nbits, a_ref, words_ref, out_ref):
 
 
 _SQRT_EXP = (2**255 - 19 - 5) // 8  # (p-5)/8 = 2^252 - 3
+_INV_EXP = 2**255 - 19 - 2  # p - 2 (Fermat inversion)
 
 
 @functools.partial(jax.jit, static_argnames=("e", "interpret"))
@@ -108,17 +131,17 @@ def pow_planes(a: jnp.ndarray, e: int, *, interpret: bool = False):
     """Drop-in Pallas replacement for ``field.pow_const``: a[B, 22] ** e.
 
     ``e`` is static; output is in carried form like pow_const's.  The
-    decompression exponent (p-5)/8 routes through the addition-chain
-    kernel (~1.9x less work); every other exponent runs the generic
-    bit-chain.
+    decompression exponent (p-5)/8 and the inversion exponent p-2 route
+    through their addition-chain kernels (~1.9x less work); every other
+    exponent runs the generic bit-chain.
     """
     B = a.shape[0]
     batch_pad = -(-B // TILE) * TILE
     grid = batch_pad // TILE
     tiles = _to_tiles(a, batch_pad)
-    if e == _SQRT_EXP:
+    if e in (_SQRT_EXP, _INV_EXP):
         out = pl.pallas_call(
-            _sqrt_chain_kernel,
+            _sqrt_chain_kernel if e == _SQRT_EXP else _inv_chain_kernel,
             grid=(grid,),
             in_specs=[plane_spec(LIMBS)],
             out_specs=plane_spec(LIMBS),

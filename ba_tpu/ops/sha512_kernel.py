@@ -41,6 +41,15 @@ ROWS = TILE_ROWS
 
 
 def _sha_kernel(n_blocks, wh_ref, wl_ref, out_ref):
+    state = _sha_state(n_blocks, wh_ref, wl_ref)
+    for i, (sh, sl) in enumerate(state):
+        out_ref[2 * i] = sh
+        out_ref[2 * i + 1] = sl
+
+
+def _sha_state(n_blocks, wh_ref, wl_ref):
+    """The compression body shared by the plain and fused kernels:
+    returns the 8 final (hi, lo) uint32 state plane pairs."""
     shape = (ROWS, LANES)
     state = [
         (
@@ -83,9 +92,55 @@ def _sha_kernel(n_blocks, wh_ref, wl_ref, out_ref):
             _add64(sh, sl, nh, nl)
             for (sh, sl), (nh, nl) in zip(state, regs)
         ]
-    for i, (sh, sl) in enumerate(state):
-        out_ref[2 * i] = sh
-        out_ref[2 * i + 1] = sl
+    return state
+
+
+def _sha_modl_kernel(n_blocks, wh_ref, wl_ref, out_ref):
+    """SHA-512 -> digest mod L, fused: the challenge/nonce scalar path of
+    verification and signing (h = H(R||A||M) mod L, r = H(prefix||M) mod
+    L) never writes the 64-byte digest to HBM — the state words split
+    into byte planes in registers and flow straight into the mod-L fold
+    chain (ops/modl.modl_core)."""
+    from ba_tpu.ops.modl import modl_core
+
+    state = _sha_state(n_blocks, wh_ref, wl_ref)
+    v = []
+    for sh, sl in state:
+        # Digest bytes are the big-endian bytes of hi then lo per word;
+        # extract in uint32 (logical shifts), convert the in-range bytes.
+        for word in (sh, sl):
+            v.extend(
+                ((word >> s) & 0xFF).astype(jnp.int32)
+                for s in (24, 16, 8, 0)
+            )
+    modl_core(v, out_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("n_blocks", "interpret"))
+def sha512_blocks_mod_l(wh: jnp.ndarray, wl: jnp.ndarray, n_blocks: int,
+                        *, interpret: bool = False) -> jnp.ndarray:
+    """Fused compress + mod-L: same inputs as ``sha512_blocks`` but the
+    output is the digest reduced mod L — uint8 [B, 32]."""
+    B = wh.shape[0]
+    batch_pad = -(-B // TILE) * TILE
+    nw = n_blocks * 16
+
+    spec = lambda k: pl.BlockSpec((k, ROWS, LANES), lambda i: (0, i, 0),
+                                  memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_sha_modl_kernel, n_blocks),
+        grid=(batch_pad // TILE,),
+        in_specs=[spec(nw), spec(nw)],
+        out_specs=spec(32),
+        out_shape=jax.ShapeDtypeStruct(
+            (32, batch_pad // LANES, LANES), jnp.int32
+        ),
+        interpret=interpret,
+    )(
+        _to_tiles(wh.reshape(B, nw), batch_pad),
+        _to_tiles(wl.reshape(B, nw), batch_pad),
+    )
+    return _from_tiles(out, B).astype(jnp.uint8)
 
 
 @functools.partial(jax.jit, static_argnames=("n_blocks", "interpret"))

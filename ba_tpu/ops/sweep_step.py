@@ -20,11 +20,14 @@ whole step for a [TILE, n] block of instances inside VMEM:
 - the per-round reductions (honest-held flags, traitor-holder counts) and
   the final majority/quorum math are row reductions over the lane axis,
   fused with everything else;
-- ``rounds`` chains up to 15 independent agreement rounds in ONE dispatch
-  (state planes read once, PRNG stream continuing, one packed decision
-  column written), dividing the per-dispatch tunnel/grid overhead by the
-  round count — the r4 answer to SWEEP_STAGES_r3.json's finding that
-  dispatch, not compute, bounds the fused step.
+- ``rounds`` chains independent agreement rounds in ONE dispatch via an
+  in-kernel fori_loop (state planes read once, PRNG stream continuing,
+  decisions packed 15-per-int32-column into a register accumulator),
+  dividing the per-dispatch tunnel/grid overhead by the round count — the
+  r4 answer to SWEEP_STAGES_r3.json's finding that dispatch, not compute,
+  bounds the fused step; the r5 loop form makes compile cost O(1) in the
+  round count (the r4 unrolled trace hit a >25 min remote-compile
+  frontier at 240 rounds, ROUNDS_AB_r4.json).
 
 Semantics mirror the XLA path op-for-op (round1_broadcast ->
 sig_valid_from_tables -> _initial_seen & sig_valid ->
@@ -56,6 +59,14 @@ import os
 TILE = int(os.environ.get("BA_TPU_FUSED_TILE", 64))
 LANES = 128
 
+# Rounds traced per fori_loop iteration: the compile-time/throughput dial.
+# Trace size is O(unroll) regardless of K (the r4 frontier was O(K)); 5
+# keeps cross-round ILP visible to Mosaic's scheduler without bloating the
+# body.  BA_TPU_FUSED_UNROLL overrides for tuning.
+_UNROLL = int(os.environ.get("BA_TPU_FUSED_UNROLL", 5))
+if _UNROLL < 1:  # same loud-at-import policy as the tile/rounds guards
+    raise ValueError(f"BA_TPU_FUSED_UNROLL={_UNROLL} must be >= 1")
+
 
 def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
                  ok_r_ref, ok_a_ref, dec_ref, *, m: int, rounds: int):
@@ -81,14 +92,22 @@ def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
     # the state planes are read once, the PRNG stream simply continues
     # across rounds (iid draws), and each round's decision packs into 2
     # bits of an int32 output column (decisions are in {0, 1, 2}; 15
-    # rounds per column, ceil(rounds/15) columns).  Each column is stored
-    # the moment it fills — accumulating them for one final concatenate
-    # measured 1.6 MB over the 16 MB scoped-VMEM limit at 2 columns.
-    # Round 0's draw order is identical to the single-round kernel, so
-    # rounds=1 is bit-compatible with r3's kernel.
-    col = 0
-    acc = jnp.zeros((T, 1), jnp.int32)
-    for _rr in range(rounds):
+    # rounds per column, ceil(rounds/15) columns).  The round loop is an
+    # IN-KERNEL fori_loop (r4 ran a Python loop traced into straight-line
+    # Mosaic, which hit a compile frontier: K=240 sat in the remote
+    # compiler >25 min, ROUNDS_AB_r4.json) — trace and compile cost are
+    # now O(unroll), not O(K).  All columns live in one [T, n_cols] int32
+    # register accumulator (tile 64 x 128 lanes = 32 KB — nowhere near
+    # the 16 MB scoped-VMEM limit that the r4 unrolled trace's per-column
+    # concatenate blew); a filled column lands in it via a lane select,
+    # and one store writes everything at the end.  Round 0's draw order
+    # is identical to the single-round kernel, so rounds=1 stays
+    # bit-compatible with r3's kernel.
+    n_cols = dec_ref.shape[1]
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (T, n_cols), 1)
+
+    def _one_round(rr, carry):
+        acc_col, acc_all = carry
         # Round 1: honest leader pushes order; faulty leader flips a coin
         # per recipient (ba.py:268-273); the leader holds the true order.
         coin = (
@@ -156,11 +175,24 @@ def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
             jnp.where(needed <= n_a, jnp.int32(ATTACK), jnp.int32(UNDEFINED)),
         )
         dec = jnp.where(total == 0, jnp.int32(UNDEFINED), dec)
-        acc = acc * 4 + dec
-        if (_rr + 1) % 15 == 0 or _rr == rounds - 1:
-            dec_ref[:, col : col + 1] = acc
-            col += 1
-            acc = jnp.zeros((T, 1), jnp.int32)
+        acc_col = acc_col * 4 + dec
+        # Column bookkeeping, all vector selects: when round rr fills its
+        # column ((rr+1) % 15 == 0 or it is the last round), park acc_col
+        # in lane rr // 15 of the accumulator and reset it.
+        filled = ((rr + 1) % 15 == 0) | (rr == rounds - 1)
+        hit = filled & (col_iota == rr // 15)
+        acc_all = jnp.where(hit, acc_col, acc_all)
+        acc_col = jnp.where(filled, 0, acc_col)
+        return acc_col, acc_all
+
+    _, acc_all = jax.lax.fori_loop(
+        0,
+        rounds,
+        _one_round,
+        (jnp.zeros((T, 1), jnp.int32), jnp.zeros((T, n_cols), jnp.int32)),
+        unroll=min(rounds, _UNROLL),
+    )
+    dec_ref[:] = acc_all
 
 
 @functools.partial(
@@ -188,7 +220,10 @@ def fused_signed_sweep_step(
     ``rounds``; the kernel packs each round's {0,1,2} decision into 2
     bits of an int32 output column, 15 rounds per column (measured r4:
     dispatch overhead still dominated at 15, so the column axis extends
-    the chain — ROUNDS_AB_r4.json).  Kept <= 240 as a trace-size guard.
+    the chain — ROUNDS_AB_r4.json).  The round loop is in-kernel (r5), so
+    compile cost no longer grows with ``rounds``; the cap is one padded
+    lane register of packed columns (15 * 128), far past the measured
+    marginal-cost asymptote.
 
     seed: int32 [1] (vary per step — the kernel folds in the tile index);
     order [B] int8/int32; leader [B] int32; faulty/alive [B, n] bool;
@@ -197,9 +232,9 @@ def fused_signed_sweep_step(
     tile = TILE if tile is None else tile  # explicit 0 is a loud error below
     if tile <= 0:
         raise ValueError(f"tile={tile} must be positive")
-    if not 1 <= rounds <= 240:
-        raise ValueError(f"rounds={rounds} outside [1, 240] (unrolled "
-                         "trace-size guard; 15 rounds per packed column)")
+    if not 1 <= rounds <= 1920:
+        raise ValueError(f"rounds={rounds} outside [1, 1920] (15 rounds "
+                         "per packed column, one 128-lane column register)")
     B, n = faulty.shape
     n_cols = -(-rounds // 15)
     b_pad = -(-B // tile) * tile

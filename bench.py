@@ -417,6 +417,85 @@ def bench_hbm_copy_peak(jax, jnp, jr):
     }
 
 
+def bench_mxu_int8_peak(jax, jnp, jr, eig_shape=(16, 1024, 1024)):
+    """Achievable int8 MXU throughput: the falsifiable same-window
+    denominator for every "MXU-bound" claim (VERDICT r4 weak #3:
+    eig_n1024's einsum bound shipped with einsum_tmacs_per_sec but NO
+    measured denominator).  Same discipline as bench_hbm_copy_peak:
+    barrier-chained passes so one dispatch carries enough work to be
+    compute-bound, distinct content per dispatch (tunnel memoization).
+
+    Two probes:
+
+    - ``square``: z <- int8((z @ w) & 127), N=2048 — a near-ideal MXU
+      shape, the chip-level ceiling estimate;
+    - ``eig_shape``: the bij,bjp einsum at eig_n1024's EXACT dims, chained
+      through an int8 re-bind of the output — what THIS einsum shape can
+      achieve, the denominator pct_of_mxu_peak uses.
+
+    The int32->int8 re-bind between passes fuses into the dot epilogue;
+    per-pass HBM traffic is ~2 int8 planes against hundreds of MACs per
+    byte, so both probes sit far from the bandwidth roof.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(31)
+    inner, iters, reps = 24, 3, 3
+    n_var = 1 + iters * reps
+
+    def run(f, variants, macs_pass):
+        elapsed = _timed(
+            f, lambda i: (variants[i % len(variants)],), iters, reps=reps
+        )
+        return round(macs_pass * inner * iters / elapsed / 1e12, 2), elapsed
+
+    N = 2048
+    w = jnp.asarray(rng.integers(-64, 64, (N, N)), jnp.int8)
+
+    @jax.jit
+    def f_sq(z):
+        for _ in range(inner):
+            y = jnp.matmul(z, w, preferred_element_type=jnp.int32)
+            z = jax.lax.optimization_barrier((y & 127).astype(jnp.int8))
+        return z.sum(dtype=jnp.int32)
+
+    sq_vars = [
+        jnp.asarray(rng.integers(-64, 64, (N, N)), jnp.int8)
+        for _ in range(n_var)
+    ]
+    sq_tmacs, sq_el = run(f_sq, sq_vars, N**3)
+
+    B, n, P = eig_shape
+    att0 = jnp.asarray(rng.integers(0, 2, (B, n, P)), jnp.int8)
+
+    @jax.jit
+    def f_eig(m1):
+        att = att0
+        for _ in range(inner):
+            y = jnp.einsum(
+                "bij,bjp->bip", m1, att, preferred_element_type=jnp.int32
+            )
+            att = jax.lax.optimization_barrier((y & 1).astype(jnp.int8))
+        return att.sum(dtype=jnp.int32)
+
+    eig_vars = [
+        jnp.asarray(rng.integers(0, 2, (B, n, n)), jnp.int8)
+        for _ in range(n_var)
+    ]
+    eig_tmacs, eig_el = run(f_eig, eig_vars, B * n * n * P)
+    return {
+        "square_int8_tmacs": sq_tmacs,
+        "square_shape": [N, N],
+        "eig_shape_int8_tmacs": eig_tmacs,
+        "eig_shape": list(eig_shape),
+        "passes_per_dispatch": inner,
+        "elapsed_s": [round(sq_el, 4), round(eig_el, 4)],
+        "note": "barrier-chained int8 matmul/einsum probes; eig_shape_* "
+                "is the same-window ceiling for eig_n1024's fused-level "
+                "einsum claim",
+    }
+
+
 def bench_eig_n1024(jax, jnp, jr):
     """BASELINE config #4's dense-substrate answer (VERDICT r2 missing #5):
     the EIG tree itself at its single-chip feasible frontier, n=1024.
@@ -449,22 +528,70 @@ def bench_eig_n1024(jax, jnp, jr):
     key = make_key(8)
     iters = 5
     elapsed = _timed(step, lambda i: (jr.fold_in(key, i),), iters)
+
+    # Stage decomposition of the fused deepest level (VERDICT r4 weak #3:
+    # which part actually binds — the MXU einsum or the per-digit
+    # corrections?).  Same-window timings of (a) the fused level alone on
+    # device-resident inputs and (b) just its mask-build + einsum, both
+    # through the step's own internals so the decomposition is honest.
+    from ba_tpu.core.eig import eig_deepest_fused, eig_send
+    from ba_tpu.core.types import ATTACK as _ATT
+
+    k_lv = make_key(9)
+    levels = [jax.device_put(lv) for lv in eig_send(k_lv, state, m - 1)]
+    eye = jnp.eye(n, dtype=bool)
+
+    @jax.jit
+    def fused_level(key):
+        out = eig_deepest_fused(key, state, levels, m, max_liars)
+        return out.astype(jnp.int32).sum()
+
+    @jax.jit
+    def einsum_only(salt):
+        prev = levels[m - 1].reshape(batch, n, n ** (m - 1))
+        att = (prev == _ATT).astype(jnp.int8)
+        is_leader = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0
+        eligible = state.alive & ~is_leader
+        m1 = eligible[:, None, :] & (~state.faulty[:, None, :] | eye[None])
+        y = jnp.einsum(
+            "bij,bjp->bip", m1.astype(jnp.int8), att,
+            preferred_element_type=jnp.int32,
+        )
+        return y.sum() + salt  # salt: distinct dispatch content (memo)
+
+    t_level = _timed(fused_level, lambda i: (jr.fold_in(key, 100 + i),), iters)
+    t_einsum = _timed(einsum_only, lambda i: (jnp.int32(i),), iters)
+    mxu = bench_mxu_int8_peak(jax, jnp, jr, eig_shape=(batch, n, n ** (m - 1)))
     hbm = bench_hbm_copy_peak(jax, jnp, jr)
     # Fused traffic: the [B, n, n] level-1 tensor (written + read by the
     # einsum), the [B, n, n] popcount words (4B each), einsum output int32.
     bytes_round = batch * n * n * (1 + 1 + 4 + 4)
     macs_round = batch * n * n * n  # the deepest-level int8 einsum
+    tmacs = macs_round * iters / elapsed / 1e12
     return {
         "rounds_per_sec": round(batch * iters / elapsed, 1),
         "batch": batch, "n": n, "m": m, "iters": iters,
         "elapsed_s": round(elapsed, 4),
         "bytes_per_round_est": bytes_round,
         "achieved_gbps_est": round(bytes_round * iters / elapsed / 1e9, 2),
-        "einsum_tmacs_per_sec": round(macs_round * iters / elapsed / 1e12, 3),
+        "einsum_tmacs_per_sec": round(tmacs, 3),
+        "pct_of_mxu_peak": round(
+            100 * tmacs / max(mxu["eig_shape_int8_tmacs"], 1e-9), 1
+        ),
+        "stages": {
+            "full_step_s_per_dispatch": round(elapsed / iters, 4),
+            "fused_level_s_per_dispatch": round(t_level / iters, 4),
+            "einsum_only_s_per_dispatch": round(t_einsum / iters, 4),
+            "note": "fused_level minus einsum_only ~= per-digit "
+                    "corrections + popcount draws + majority; full_step "
+                    "minus fused_level ~= send levels + shallow resolves",
+        },
+        "mxu_int8_peak": mxu,
         "hbm_copy_peak": hbm,
         "bound": "MXU int8 einsum + elementwise corrections (fused "
                  "deepest level; the r3 HBM-bound dense form is "
-                 "BA_TPU_EIG_FUSED=0)",
+                 "BA_TPU_EIG_FUSED=0); pct_of_mxu_peak now has a "
+                 "same-window measured denominator",
     }
 
 
@@ -574,12 +701,13 @@ def bench_sweep10k_signed(jax, jnp, jr):
     use_fused = fused_env == "1" or (fused_env == "auto" and use_pallas())
     # Rounds per fused dispatch (BA_TPU_FUSED_ROUNDS): the state planes
     # stay VMEM-resident and the per-dispatch overhead divides by K
-    # (ops/sweep_step.py multi-round kernel).  K=60 is the measured
-    # default: dispatch overhead dominates through K=15 and the marginal
-    # per-round cost flattens past K~30 (ROUNDS_AB_r4.json: 2.2M at K=1
-    # -> 24.7M/31.2M/37.3M rounds/s at K=15/30/60 same-window); compile
-    # cost grows with K, so the knob stays a knob.  The XLA path is one
-    # round per call, so K applies only when fused.
+    # (ops/sweep_step.py multi-round kernel).  Dispatch overhead dominates
+    # through K=15 and the marginal per-round cost flattens past K~30
+    # (ROUNDS_AB_r4.json: 2.2M at K=1 -> 24.7M/31.2M/37.3M/43.4M rounds/s
+    # at K=15/30/60/120 same-window).  r5's in-kernel round loop made
+    # compile cost O(1) in K (the r4 unrolled trace hit a >25 min compile
+    # frontier at K=240), so K is purely a batching dial now.  The XLA
+    # path is one round per call, so K applies only when fused.
     fused_rounds = int(os.environ.get("BA_TPU_FUSED_ROUNDS", 120))
     rounds_per_step = fused_rounds if use_fused else 1
     if use_fused:
@@ -657,9 +785,15 @@ def bench_sweep10k_signed(jax, jnp, jr):
         "setup_verify_s": round(setup_verify_s, 2),
         "setup_total_s": round(setup_total, 2),
         "setup_chunks": setup_t["chunks"],
+        "setup_device_sign": setup_t.get("device_sign", False),
         "setup_verifies_per_sec_incl_sign": round(
             setup_verifies_per_sec_incl_sign, 1
         ),
+        "setup_congestion_note": "in-suite setup drains behind the whole "
+            "bench queue, so setup_verify_s here rides window congestion; "
+            "standalone same-window measurements put the drain residual "
+            "at 0.08-0.10 s (SETUP_AB_r4.json) — compare setups via the "
+            "SETUP_AB artifacts, not this in-suite figure",
         "rounds_per_sec_incl_setup": incl,
         "incl_setup_crossover_1M_iters": crossover_iters,
         "bytes_per_round_est": bytes_round,
@@ -1255,6 +1389,40 @@ def main() -> None:
             "incl_setup_crossover_1M_iters"
         ]
         compact["setup_verify_s"] = sweep["setup_verify_s"]
+        # Window-spread disclosure (VERDICT r4 item 6): fold the attempt
+        # log's north-star rates (bench_refresh.sh appends one per
+        # attempt) plus THIS run into n/min/median/max, so the driver
+        # artifact carries the distribution, not just a point estimate.
+        import glob
+
+        # Numeric round sort: lexicographic would order r10 before r4.
+        logs = sorted(
+            glob.glob("BENCH_attempts_r*.jsonl"),
+            key=lambda p: int(p.rsplit("_r", 1)[1].split(".")[0]),
+        )
+        log = os.environ.get(
+            "BA_TPU_BENCH_ATTEMPTS_LOG", logs[-1] if logs else ""
+        )
+        rates = [sweep["rounds_per_sec"]]
+        if log and os.path.exists(log):
+            for rec in open(log):
+                try:
+                    rates.append(
+                        json.loads(rec)["configs"]["sweep10k_signed"][
+                            "rounds_per_sec"
+                        ]
+                    )
+                except (ValueError, KeyError):
+                    pass
+        rates.sort()
+        compact["north_star_window"] = {
+            "n": len(rates),
+            "min": rates[0],
+            "median": rates[len(rates) // 2],
+            "max": rates[-1],
+            "log": log or None,
+            "note": "incl. this run",
+        }
     print(json.dumps(compact))
 
 

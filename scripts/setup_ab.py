@@ -1,8 +1,13 @@
-"""Same-window A/B over the overlapped key-set setup's chunk count.
+"""Same-window A/B over the overlapped key-set setup's chunk count and
+(r5) its substrate modes.
 
 chunks=1 is the r3-style sequential setup (sign everything, one verify
 dispatch); higher counts overlap host signing with device verify but pay
-one tunnel dispatch+upload ACK per chunk.  Which wins depends on the
+one tunnel dispatch+upload ACK per chunk.  SETUP_AB_MODES (r5) adds the
+substrate axis: comma-separated combos of ``host``/``dev`` (who signs —
+BA_TPU_SIGN_DEVICE) x ``exact``/``rlc`` (how tables verify —
+BA_TPU_VERIFY_RLC, the deferred-fetch route), e.g.
+``host-exact,dev-exact,host-rlc,dev-rlc``.  Which wins depends on the
 window's dispatch latency, so: interleaved, min-of-reps, one process.
 Run ALONE."""
 
@@ -14,6 +19,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+_KNOBS = {"host": ("BA_TPU_SIGN_DEVICE", "0"), "dev": ("BA_TPU_SIGN_DEVICE", "1"),
+          "exact": ("BA_TPU_VERIFY_RLC", "0"), "rlc": ("BA_TPU_VERIFY_RLC", "1")}
+
+
+def _set_mode(mode: str) -> None:
+    for part in mode.split("-"):
+        k, v = _KNOBS[part]
+        os.environ[k] = v
+
 
 def main() -> None:
     from ba_tpu.crypto.signed import (
@@ -24,27 +38,39 @@ def main() -> None:
     batch = int(os.environ.get("SETUP_AB_BATCH", 10240))
     chunk_counts = [int(c) for c in
                     os.environ.get("SETUP_AB_CHUNKS", "1,2,4,8").split(",")]
+    modes = os.environ.get("SETUP_AB_MODES", "host-exact").split(",")
     reps = 3
-    for c in chunk_counts:  # compile each chunk shape off the clock
-        warm_signed_tables(batch, c)
-
-    best = {c: None for c in chunk_counts}
-    for r in range(reps):
+    for mode in modes:  # compile every (mode, chunk shape) off the clock
+        _set_mode(mode)
         for c in chunk_counts:
-            # Fresh keys per attempt (seed varies): content-distinct
-            # dispatches, and keygen+signing stay on the clock as in the
-            # bench's setup accounting.
-            *_, t = setup_signed_tables_overlapped(
-                batch, seed=1000 + r * 100 + c, chunks=c
-            )
-            if best[c] is None or t["total_s"] < best[c]["total_s"]:
-                best[c] = t
+            warm_signed_tables(batch, c)
+
+    best: dict[tuple[str, int], dict | None] = {
+        (m, c): None for m in modes for c in chunk_counts
+    }
+    for r in range(reps):
+        for mi, m in enumerate(modes):
+            _set_mode(m)
+            for c in chunk_counts:
+                # Fresh keys per attempt — the seed varies with rep, MODE
+                # and chunk count, so no two timed setups ever dispatch
+                # byte-identical content (Ed25519 determinism would
+                # otherwise make a later mode's dispatches byte-identical
+                # repeats of an earlier one's from the same seed, and the
+                # tunnel memoizes those); keygen+signing stay on the
+                # clock as in the bench's setup accounting.
+                *_, t = setup_signed_tables_overlapped(
+                    batch, seed=1000 + r * 1000 + mi * 100 + c, chunks=c
+                )
+                key = (m, c)
+                if best[key] is None or t["total_s"] < best[key]["total_s"]:
+                    best[key] = t
     print(json.dumps({
         "metric": "setup-chunks-ab", "batch": batch, "reps": reps,
         "variants": {
-            str(c): {k: round(v, 4) if isinstance(v, float) else v
-                     for k, v in t.items()}
-            for c, t in best.items()
+            f"{m}/chunks={c}": {k: round(v, 4) if isinstance(v, float) else v
+                                for k, v in t.items()}
+            for (m, c), t in best.items()
         },
     }))
 

@@ -218,3 +218,30 @@ def test_overlapped_setup_matches_sequential_tables():
     np.testing.assert_array_equal(got_sigs, want_sigs)
     assert np.asarray(ok).all()
     assert got_msgs[B - 1, 1].tobytes() == order_message(B - 1, 1)
+
+
+def test_setup_device_sign_matches_host(monkeypatch):
+    # BA_TPU_SIGN_DEVICE=1 routes table signing through the on-device
+    # Ed25519 signer (ed25519.sign); Ed25519 determinism means the
+    # resulting tables must be BYTE-identical to the host path, verified
+    # mask included — incl. the padded tail chunk (jnp concat branch) and
+    # the global instance-id binding.
+    from ba_tpu.crypto.signed import (
+        commander_keys,
+        setup_signed_tables_overlapped,
+        sign_value_tables,
+    )
+
+    B = 21  # uneven: exercises the device-array tail-pad branch
+    sks, pks = commander_keys(B)
+    want_msgs, want_sigs = sign_value_tables(sks, pks)
+    monkeypatch.setenv("BA_TPU_SIGN_DEVICE", "1")
+    _, pks2, got_msgs, got_sigs, ok, timings = setup_signed_tables_overlapped(
+        B, chunks=2
+    )
+    np.testing.assert_array_equal(pks2, pks)
+    np.testing.assert_array_equal(got_msgs, want_msgs)
+    np.testing.assert_array_equal(got_sigs, want_sigs)
+    assert isinstance(got_sigs, np.ndarray)  # fetched to host at drain
+    assert np.asarray(ok).all()
+    assert timings["device_sign"] is True

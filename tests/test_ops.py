@@ -90,6 +90,99 @@ def test_plane_point_dbl_matches_point_add():
     np.testing.assert_array_equal(np.asarray(_stack(got_not[3])), 0)
 
 
+def test_plane_canonical_and_eq_match_field():
+    # p_canonical/p_eq back the fused verify epilogue's projective
+    # equality (ops/ladder._window_verify_kernel); pin them limb for limb
+    # against field.canonical/eq on lazy/negative/edge inputs.
+    rng = np.random.default_rng(9)
+    a = rng.integers(-8000, 8000, (64, F.LIMBS)).astype(np.int32)
+    a[0] = 0
+    a[1] = F._np_limbs(F.P_INT - 1)
+    a[2] = F._np_limbs(F.P_INT - 1) * 2  # 2p - 2: needs full reduction
+    aj = jnp.asarray(a)
+    got = _stack(planes.p_canonical(_unstack(aj)))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(F.canonical(aj)))
+    b = np.array(a)
+    b[4] += 7  # differ in one limb
+    bj = jnp.asarray(b)
+    got_eq = planes.p_eq(_unstack(aj), _unstack(bj))
+    np.testing.assert_array_equal(np.asarray(got_eq), np.asarray(F.eq(aj, bj)))
+    # same value, different lazy encodings: must compare equal
+    shifted = _unstack(aj + jnp.asarray(F._np_limbs(F.P_INT)))
+    assert bool(jnp.all(planes.p_eq(_unstack(aj), shifted)))
+
+
+def test_sha512_mod_l_jnp_matches_bigints():
+    # The fallback composition (the fused kernel's accept-set anchor):
+    # digest-as-little-endian-int mod L, vs hashlib + Python bigints.
+    import hashlib
+
+    from ba_tpu.crypto.oracle import L
+    from ba_tpu.crypto.sha512 import sha512_mod_l
+
+    rng = np.random.default_rng(19)
+    msgs = rng.integers(0, 256, (8, 80)).astype(np.uint8)
+    got = np.asarray(jax.jit(sha512_mod_l)(jnp.asarray(msgs)))
+    for i in range(8):
+        want = (
+            int.from_bytes(hashlib.sha512(msgs[i].tobytes()).digest(), "little")
+            % L
+        )
+        assert int.from_bytes(got[i].tobytes(), "little") == want, i
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="Mosaic kernel needs real TPU")
+def test_sha512_mod_l_fused_kernel_tpu():
+    # On TPU sha512_mod_l routes through the FUSED sha+modl kernel; same
+    # differential as the jnp test (interpret mode would run the 80
+    # unrolled rounds under Python, like the plain sha kernel's policy).
+    import hashlib
+
+    from ba_tpu.crypto.oracle import L
+    from ba_tpu.crypto.sha512 import sha512_mod_l
+
+    rng = np.random.default_rng(20)
+    for B, ln in ((64, 80), (16, 200)):  # 1- and 2-block messages
+        msgs = rng.integers(0, 256, (B, ln)).astype(np.uint8)
+        got = np.asarray(jax.jit(sha512_mod_l)(jnp.asarray(msgs)))
+        for i in range(B):
+            want = (
+                int.from_bytes(
+                    hashlib.sha512(msgs[i].tobytes()).digest(), "little"
+                )
+                % L
+            )
+            assert int.from_bytes(got[i].tobytes(), "little") == want, (B, i)
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="Mosaic kernel needs real TPU")
+def test_window_verify_fused_matches_parts_tpu():
+    # The fused verify tail (window mult + completion add + projective
+    # eq in one kernel) against its composed parts, on valid AND
+    # deliberately-failing lanes.
+    from ba_tpu.ops.ladder import window_mult, window_verify
+
+    rng = np.random.default_rng(21)
+    B = 8
+    bits = jnp.asarray(rng.integers(0, 2, (B, 256)), jnp.int32)
+    a_pt = E.scalar_mult(E.base_point((B,)), jnp.asarray(
+        rng.integers(0, 2, (B, 16)), jnp.int32))
+    r_pt = E.scalar_mult(E.base_point((B,)), jnp.asarray(
+        rng.integers(0, 2, (B, 16)), jnp.int32))
+    ha = window_mult(a_pt, bits)
+    right = E.point_add(r_pt, ha)
+    want = np.asarray(E.point_eq(right, right))
+    # left == the true sum on even lanes; a perturbed point on odd ones.
+    wrong = E.point_add(right, E.base_point((B,)))
+    odd = (np.arange(B) % 2) == 1
+    left = tuple(
+        jnp.where(jnp.asarray(odd)[:, None], w, r)
+        for w, r in zip(wrong, right)
+    )
+    got = np.asarray(window_verify(a_pt, bits, r_pt, left))
+    np.testing.assert_array_equal(got, ~odd & want)
+
+
 # -- the ladder ---------------------------------------------------------------
 
 
@@ -180,6 +273,31 @@ def test_sqrt_chain_algebra_matches_pow_const():
 
     got = sqrt_chain(a, F.mul, sq_n)
     ref = F.pow_const(a, (P - 5) // 8)
+    np.testing.assert_array_equal(
+        np.asarray(F.canonical(got)), np.asarray(F.canonical(ref))
+    )
+
+
+def test_inv_chain_algebra_matches_pow_const():
+    # The p-2 inversion chain (device signer's compress), instantiated
+    # with plain field ops on CPU: pins the tower + z^11 epilogue algebra
+    # without Mosaic.  The kernel plumbing it shares with the sqrt chain
+    # (p_sq_n runs, limb writeback) is covered by the interpret test
+    # above; the fused routing is pinned on hardware by the sign
+    # differential in test_crypto.py running under BA_TPU_TESTS_ON_TPU.
+    from ba_tpu.crypto.oracle import P
+    from ba_tpu.ops.powchain import inv_chain
+
+    rng = np.random.default_rng(16)
+    a = jnp.asarray(rng.integers(0, 4096, (4, F.LIMBS)), jnp.int32)
+
+    def sq_n(x, n):
+        for _ in range(n):
+            x = F.square(x)
+        return x
+
+    got = inv_chain(a, F.mul, sq_n)
+    ref = F.pow_const(a, P - 2)
     np.testing.assert_array_equal(
         np.asarray(F.canonical(got)), np.asarray(F.canonical(ref))
     )
@@ -596,16 +714,17 @@ def test_fused_sharded_sweep_matches_unsharded():
 
 
 def test_fused_multi_round_bounds():
-    # 15 rounds pack per int32 column and the unrolled trace is guarded at
-    # 240; the wrapper must reject out-of-range values loudly at trace
-    # time (CPU-safe: the check runs before the pallas_call is built).
+    # 15 rounds pack per int32 column, one 128-lane column register caps
+    # rounds at 1920; the wrapper must reject out-of-range values loudly
+    # at trace time (CPU-safe: the check runs before the pallas_call is
+    # built).
     from ba_tpu.ops.sweep_step import fused_signed_sweep_step
 
     o = jnp.zeros((8,), jnp.int8)
     ldr = jnp.zeros((8,), jnp.int32)
     f = jnp.zeros((8, 16), bool)
     ok = jnp.ones((8, 2), bool)
-    for bad in (0, 241):
+    for bad in (0, 1921):
         with pytest.raises(ValueError, match="rounds"):
             fused_signed_sweep_step(
                 jnp.asarray([1], jnp.int32), o, ldr, f, f, ok, 1, bad
