@@ -59,10 +59,12 @@ import os
 TILE = int(os.environ.get("BA_TPU_FUSED_TILE", 64))
 LANES = 128
 
-# Rounds traced per fori_loop iteration: the compile-time/throughput dial.
-# Trace size is O(unroll) regardless of K (the r4 frontier was O(K)); 5
-# keeps cross-round ILP visible to Mosaic's scheduler without bloating the
-# body.  BA_TPU_FUSED_UNROLL overrides for tuning.
+# Rounds traced per loop iteration: the compile-time/throughput dial.
+# Mosaic lowers fori_loop only at unroll=1 or full unroll, so partial
+# unrolling is done BY HAND — the loop body is a Python-unrolled block of
+# _UNROLL rounds, keeping trace size O(unroll) regardless of K (the r4
+# frontier was O(K)) while cross-round ILP stays visible to Mosaic's
+# scheduler.  BA_TPU_FUSED_UNROLL overrides for tuning.
 _UNROLL = int(os.environ.get("BA_TPU_FUSED_UNROLL", 5))
 if _UNROLL < 1:  # same loud-at-import policy as the tile/rounds guards
     raise ValueError(f"BA_TPU_FUSED_UNROLL={_UNROLL} must be >= 1")
@@ -178,19 +180,28 @@ def _step_kernel(seed_ref, order_ref, leader_ref, faulty_ref, alive_ref,
         acc_col = acc_col * 4 + dec
         # Column bookkeeping, all vector selects: when round rr fills its
         # column ((rr+1) % 15 == 0 or it is the last round), park acc_col
-        # in lane rr // 15 of the accumulator and reset it.
+        # in lane rr // 15 of the accumulator and reset it.  The rr <
+        # rounds guard masks the hand-unroll's padded tail rounds (their
+        # draws advance the PRNG stream harmlessly, but an unguarded park
+        # at the 15-boundary would overwrite the last real column).
         filled = ((rr + 1) % 15 == 0) | (rr == rounds - 1)
-        hit = filled & (col_iota == rr // 15)
+        hit = filled & (rr < rounds) & (col_iota == rr // 15)
         acc_all = jnp.where(hit, acc_col, acc_all)
         acc_col = jnp.where(filled, 0, acc_col)
         return acc_col, acc_all
 
+    unroll = min(rounds, _UNROLL)
+
+    def _block(b, carry):  # hand-unrolled: Mosaic has no partial unroll
+        for u in range(unroll):
+            carry = _one_round(b * unroll + u, carry)
+        return carry
+
     _, acc_all = jax.lax.fori_loop(
         0,
-        rounds,
-        _one_round,
+        -(-rounds // unroll),
+        _block,
         (jnp.zeros((T, 1), jnp.int32), jnp.zeros((T, n_cols), jnp.int32)),
-        unroll=min(rounds, _UNROLL),
     )
     dec_ref[:] = acc_all
 
