@@ -706,9 +706,13 @@ def bench_sweep10k_signed(jax, jnp, jr):
     # (ROUNDS_AB_r4.json: 2.2M at K=1 -> 24.7M/31.2M/37.3M/43.4M rounds/s
     # at K=15/30/60/120 same-window).  r5's in-kernel round loop made
     # compile cost O(1) in K (the r4 unrolled trace hit a >25 min compile
-    # frontier at K=240), so K is purely a batching dial now.  The XLA
-    # path is one round per call, so K applies only when fused.
-    fused_rounds = int(os.environ.get("BA_TPU_FUSED_ROUNDS", 120))
+    # frontier at K=240), so K is purely a batching dial now: the r5
+    # ladder runs 39.8M/45.3M/48.6M/50.4M/51.3M rounds/s at
+    # K=60/120/240/480/960 same-window (ROUNDS_AB_r5.json), so the
+    # default sits at 480 — within ~2% of the K=960 asymptote while one
+    # dispatch stays under 0.1 s.  The XLA path is one round per call,
+    # so K applies only when fused.
+    fused_rounds = int(os.environ.get("BA_TPU_FUSED_ROUNDS", 480))
     rounds_per_step = fused_rounds if use_fused else 1
     if use_fused:
         from ba_tpu.ops.sweep_step import fused_signed_sweep_step
