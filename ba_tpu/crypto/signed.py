@@ -462,6 +462,22 @@ def verify_received_rlc(pks, msgs, sigs):
     return _verify_received_exact(pks, msgs, sigs)
 
 
+def sign_on_device() -> bool:
+    """Resolve the BA_TPU_SIGN_DEVICE knob: 1 forces the TPU signer, 0
+    forces host signing, default "auto" signs on-device exactly when the
+    Pallas kernels are live (``utils.platform.use_pallas`` — real TPU).
+    Auto is safe because SETUP_AB_r5 measured setup total_s parity
+    (device 0.4196 s vs best host 0.4197 s at batch 10240) with host
+    sign_s 13x lower; on CPU backends the host signer stays the right
+    substrate (the device path would run emulated)."""
+    env = os.environ.get("BA_TPU_SIGN_DEVICE", "auto")
+    if env in ("0", "1"):
+        return env == "1"
+    from ba_tpu.utils.platform import use_pallas
+
+    return use_pallas()
+
+
 def setup_signed_tables_overlapped(
     batch: int,
     seed: int = 0,
@@ -482,13 +498,19 @@ def setup_signed_tables_overlapped(
     the chunk's own lane count — no padding to the 64k production chunk);
     callers warm that shape off the clock with ``warm_signed_tables``.
 
-    ``BA_TPU_SIGN_DEVICE=1`` moves the signing itself onto the TPU
+    ``BA_TPU_SIGN_DEVICE`` moves the signing itself onto the TPU
     (``sign_value_tables_device``): each chunk's sign program queues
     behind the previous chunk's verify, the host loop only builds
     messages and dispatches, and everything drains at the final fetch —
     host CPU leaves the critical path entirely (the r4 measurement that
     motivated this: host sign_s 0.29-0.31 s was the dominant setup cost,
-    SETUP_AB_r4.json).
+    SETUP_AB_r4.json).  Default "auto" signs on-device exactly when the
+    Pallas kernels are live (real TPU): SETUP_AB_r5 measured total_s
+    parity with the best host mode (0.4196 vs 0.4197 s, batch 10240) —
+    host sign_s drops 13x (0.21 -> 0.016 s) and the device drain absorbs
+    it, so offloading costs nothing and frees the host.  ``1``/``0``
+    force; host CPU remains the right substrate when the backend is CPU
+    jax (the kernels would run in slow interpret/emulated form).
 
     Returns ``(sks, pks, msgs_t, sigs_t, ok, timings)`` where timings has
     ``keys_s`` (keygen), ``sign_s`` (host signing work: with device
@@ -504,7 +526,7 @@ def setup_signed_tables_overlapped(
 
     if not 1 <= chunks <= batch:
         raise ValueError(f"chunks={chunks} out of range for batch={batch}")
-    device_sign = os.environ.get("BA_TPU_SIGN_DEVICE", "0") == "1"
+    device_sign = sign_on_device()
     # RLC table-verify (BA_TPU_VERIFY_RLC=1) is DEFERRED-FETCH here: each
     # chunk dispatches its combined check without fetching the verdict
     # (rlc_batch_ok returns a device scalar), so the overlap with signing
@@ -578,7 +600,7 @@ def warm_signed_tables(batch: int, chunks: int = 4) -> None:
     """
     per = -(-batch // chunks)
     sks, pks = commander_keys(per, seed=987654321)
-    if os.environ.get("BA_TPU_SIGN_DEVICE", "0") == "1":
+    if sign_on_device():
         m_c, s_c = sign_value_tables_device(sks, pks)  # warm the signer too
     else:
         m_c, s_c = sign_value_tables(sks, pks)

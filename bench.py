@@ -654,7 +654,16 @@ def bench_sweep10k_signed(jax, jnp, jr):
     # XLA/Mosaic verify compile are process-lifetime costs (the host-side
     # analogue of the device warmup below).  Per-KEY-SET costs (keygen +
     # 2 signs/instance + table verify) stay on the clock.
-    setup_chunks = int(os.environ.get("BA_TPU_BENCH_SETUP_CHUNKS", 2))
+    # Chunk default follows the signing substrate: with device signing
+    # there is no host/device overlap to exploit, so extra chunks only
+    # add dispatch+ACK latency — chunks=1 won the dev-sign column of the
+    # same-window A/B (SETUP_AB_r5.json: dev-exact 0.42/0.51/0.70 s at
+    # chunks 1/2/4) while 2 remains the host-sign winner (SETUP_AB_r4).
+    from ba_tpu.crypto.signed import sign_on_device
+
+    setup_chunks = int(os.environ.get(
+        "BA_TPU_BENCH_SETUP_CHUNKS", 1 if sign_on_device() else 2
+    ))
     warm_signed_tables(batch, setup_chunks)
 
     # One-time setup, ON the clock: per-instance keys, 2 signs each, and
