@@ -510,12 +510,18 @@ def bench_eig_n1024(jax, jnp, jr):
     path remains available (BA_TPU_EIG_FUSED=0) and differential-tested.
     A/B'd against the measured copy-kernel bandwidth (bench_hbm_copy_peak)
     so the old bound claim is falsifiable in the same window.
+
+    Batch default 128 (r5, was 16): throughput scales near-linearly with
+    batch — 949 / 2481 / 3946 rounds/s at 16/64/128, einsum 1.0 / 2.7 /
+    4.2 TMACs/s (EIG_BATCH_r5.json) — and 256 is NOT a chip limit but a
+    tunnel one (the remote-compile upload exceeds the endpoint's body
+    limit, HTTP 413), so 128 is this backend's single-chip frontier.
     """
     from ba_tpu.core import eig_agreement, make_state
     from ba_tpu.core.types import ATTACK
 
     n, m = 1024, 2
-    batch = int(os.environ.get("BA_TPU_BENCH_EIG1024_BATCH", 16))
+    batch = int(os.environ.get("BA_TPU_BENCH_EIG1024_BATCH", 128))
     faulty = jnp.zeros((batch, n), bool).at[:, [3, 7]].set(True)
     state = make_state(batch, n, order=ATTACK, faulty=faulty)
     max_liars = int(faulty.sum(-1).max())  # derived, never hardcoded
