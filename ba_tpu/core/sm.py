@@ -166,7 +166,7 @@ def sm_relay_rounds_collapsed(
     # Mosaic/XLA compile from ~1 min to >14 min (r3), and that config is
     # sequential-latency-bound, so unrolling buys nothing there.
     seen, _ = jax.lax.scan(
-        one_round, seen, jnp.arange(1, m + 1), unroll=m if m <= 4 else 1
+        one_round, seen, jnp.arange(1, m + 1), unroll=max(m, 1) if m <= 4 else 1
     )
     return seen
 

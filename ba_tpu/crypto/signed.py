@@ -465,17 +465,25 @@ def verify_received_rlc(pks, msgs, sigs):
 def sign_on_device() -> bool:
     """Resolve the BA_TPU_SIGN_DEVICE knob: 1 forces the TPU signer, 0
     forces host signing, default "auto" signs on-device exactly when the
-    Pallas kernels are live (``utils.platform.use_pallas`` — real TPU).
-    Auto is safe because SETUP_AB_r5 measured setup total_s parity
-    (device 0.4196 s vs best host 0.4197 s at batch 10240) with host
-    sign_s 13x lower; on CPU backends the host signer stays the right
-    substrate (the device path would run emulated)."""
+    Pallas kernels are live AND the platform really is TPU.  Auto is safe
+    because SETUP_AB_r5 measured setup total_s parity (device 0.4196 s vs
+    best host 0.4197 s at batch 10240) with host sign_s 13x lower; on CPU
+    backends the host signer stays the right substrate — which is why
+    auto checks the actual platform, not just ``use_pallas()``:
+    ``BA_TPU_PALLAS=1`` on CPU (the interpret-mode test configuration)
+    must NOT silently flip the signing default to the emulated device
+    path (ADVICE r5).  Forcing ``BA_TPU_SIGN_DEVICE=1`` still wins for
+    callers who want interpret-mode device signing deliberately."""
     env = os.environ.get("BA_TPU_SIGN_DEVICE", "auto")
     if env in ("0", "1"):
         return env == "1"
     from ba_tpu.utils.platform import use_pallas
 
-    return use_pallas()
+    if not use_pallas():
+        return False
+    import jax
+
+    return jax.devices()[0].platform == "tpu"
 
 
 def setup_signed_tables_overlapped(

@@ -325,6 +325,7 @@ def fused_sharded_sweep_step(
     from jax.sharding import PartitionSpec as P
 
     from ba_tpu.parallel.mesh import cached_jit
+    from ba_tpu.parallel.mesh import shard_map as _shard_map
     from ba_tpu.parallel.multihost import put_global
 
     pspec = P("data")
@@ -340,7 +341,7 @@ def fused_sharded_sweep_step(
                 order, leader, faulty, alive, ok, m, rounds,
             )
 
-        return jax.shard_map(
+        return _shard_map(
             local,
             mesh=mesh,
             in_specs=(P(), pspec, pspec, row, row, row),

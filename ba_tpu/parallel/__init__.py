@@ -24,6 +24,14 @@ a slice (its all_gathers want ICI bandwidth).
 
 from ba_tpu.parallel.mesh import make_mesh
 from ba_tpu.parallel.multihost import init_distributed, make_global_mesh, put_global
+from ba_tpu.parallel.pipeline import (
+    KeySchedule,
+    fresh_copy,
+    make_key_schedule,
+    pipeline_megastep,
+    pipeline_sweep,
+    round_keys,
+)
 from ba_tpu.parallel.sweep import (
     bucketed_sweep_states,
     failover_sweep,
@@ -39,6 +47,12 @@ __all__ = [
     "init_distributed",
     "make_global_mesh",
     "put_global",
+    "KeySchedule",
+    "fresh_copy",
+    "make_key_schedule",
+    "pipeline_megastep",
+    "pipeline_sweep",
+    "round_keys",
     "failover_sweep",
     "sharded_sweep",
     "make_sweep_state",

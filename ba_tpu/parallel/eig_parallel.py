@@ -31,7 +31,7 @@ from ba_tpu.core.quorum import quorum_decision, strict_majority
 from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
-from ba_tpu.parallel.mesh import cached_jit
+from ba_tpu.parallel.mesh import cached_jit, shard_map
 from ba_tpu.parallel.multihost import put_global, round1_jit
 
 
@@ -121,7 +121,7 @@ def eig_node_sharded(mesh: Mesh, key: jax.Array, state: SimState, m: int):
 
     fn = cached_jit(
         ("eig", mesh, n, m),
-        lambda: jax.shard_map(
+        lambda: shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(

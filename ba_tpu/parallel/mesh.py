@@ -19,6 +19,28 @@ _COMPILED: OrderedDict = OrderedDict()
 _COMPILED_CAP = 64
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across jax versions (single compat seam).
+
+    Newer jax exposes it as public ``jax.shard_map`` with a ``check_vma``
+    flag; 0.4.x ships ``jax.experimental.shard_map.shard_map`` where the
+    same knob is ``check_rep``.  Every node-sharded protocol and the
+    fused sweep kernel route through here so the version split lives in
+    exactly one place.
+    """
+    if check_vma is None:
+        kw = {}
+    elif hasattr(jax, "shard_map"):
+        kw = {"check_vma": check_vma}
+    else:
+        kw = {"check_rep": check_vma}
+    if hasattr(jax, "shard_map"):
+        sm = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def cached_jit(key, build):
     """jax.jit(build()) memoized under ``key`` in the shared bounded LRU.
 

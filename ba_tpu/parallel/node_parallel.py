@@ -29,7 +29,7 @@ from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.quorum import quorum_decision, strict_majority
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
-from ba_tpu.parallel.mesh import cached_jit
+from ba_tpu.parallel.mesh import cached_jit, shard_map
 from ba_tpu.parallel.multihost import put_global
 
 
@@ -92,7 +92,7 @@ def om1_node_sharded(mesh: Mesh, key: jax.Array, state: SimState):
 
     fn = cached_jit(
         ("om1", mesh, n),
-        lambda: jax.shard_map(
+        lambda: shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(

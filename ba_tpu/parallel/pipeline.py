@@ -1,0 +1,307 @@
+"""Pipelined multi-round sweep engine: donated buffers, on-device key
+schedule, depth-k host/device overlap.
+
+The blocking per-round driver this replaces (bench.py's inherited form of
+the reference's disease, ba.py:287-301) pays three host costs every round:
+
+1. a host-side per-round key split to derive the round's per-instance
+   keys (the key upload rides every dispatch);
+2. fresh allocations for every round's state/key buffers;
+3. a blocking fetch (host-get or a block-until-ready sync) before the
+   next round may even be *dispatched*, so host work and device compute
+   strictly alternate.
+
+This engine removes all three:
+
+- **On-device key schedule** (:class:`KeySchedule`): the sweep carries one
+  base key (raw uint32 data) plus an int32 round counter ON DEVICE.  Each
+  round derives its per-instance keys inside the compiled program —
+  ``fold_in(base, counter)`` then a vmapped ``fold_in`` over the instance
+  index — so the host never touches PRNG state after launch.  The
+  schedule is deterministic and host-reproducible: round ``r``,
+  instance ``i`` draws from exactly ``fold_in(fold_in(base, r), i)``
+  (threefry derivation is backend-independent), which is what the
+  bit-exact equivalence tests pin.
+- **Donated buffers**: the round megastep is jitted with
+  ``donate_argnums`` on the :class:`SimState` and the key schedule, and
+  returns both (state unchanged, counter advanced), so XLA aliases every
+  steady-state buffer in place — rounds allocate only their small
+  per-round outputs (decision row + 3-bin histogram).  DONATION CONTRACT:
+  the state and schedule passed to a dispatch are CONSUMED — callers must
+  thread the returned ones and never touch the donated inputs again
+  (JAX deletes them; use-after-donate raises, and the tests prove it).
+- **Depth-k in-flight dispatch**: the host loop keeps up to ``depth``
+  megastep dispatches in flight with NO intermediate sync — JAX dispatch
+  is async, and the only blocking operation is *retiring* the oldest
+  in-flight dispatch's outputs once the window is full (a fetch of the
+  tiny histogram block, which waits on round ``d - depth`` while rounds
+  through ``d`` are already queued).  Host work — signing-table prep,
+  metrics emission (``utils/metrics.py``) — runs in the ``host_work``
+  callback between dispatches, overlapping device compute.
+- **``lax.scan`` megastep** with configurable ``unroll``: each dispatch
+  covers ``rounds_per_dispatch`` rounds in one compiled scan, the
+  whole-sweep generalization of the fused-K idea from the Pallas kernel
+  (ops/sweep_step.py) — per-dispatch overhead divides by K with compile
+  cost O(unroll), not O(K).
+
+Mesh composition: ``sharded_sweep``'s layout applies unchanged — pass a
+mesh and the state shards on its "data" axis while the schedule
+replicates; the compiled megastep is the same program, sharding is
+propagated by the compiler.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ba_tpu.core.state import SimState
+from ba_tpu.parallel.multihost import put_global
+from ba_tpu.parallel.sweep import agreement_step
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class KeySchedule:
+    """Device-resident PRNG schedule: base key data + rounds consumed.
+
+    ``key_data`` is the raw uint32 form of one typed base key (raw so it
+    donates/shards like any other buffer and crosses process meshes the
+    way ``sharded_sweep`` already ships keys); ``counter`` is a scalar
+    int32 advanced by the compiled step itself.  Round ``counter``'s
+    instance-``i`` key is ``fold_in(fold_in(base, counter), i)`` —
+    derived entirely on device, never uploaded.
+    """
+
+    key_data: jax.Array
+    counter: jax.Array
+
+
+def fresh_copy(tree):
+    """A live copy of a pytree of arrays (SimState, KeySchedule, ...).
+
+    The one sanctioned way to keep a usable handle on buffers about to
+    enter the engine's donation thread: dispatches CONSUME their inputs,
+    so a caller that needs the pre-run state afterwards copies it first.
+    """
+    return jax.tree.map(lambda x: x.copy(), tree)
+
+
+def make_key_schedule(key: jax.Array, counter: int = 0) -> KeySchedule:
+    """Stage a :class:`KeySchedule` for ``key`` starting at round ``counter``.
+
+    The key data is COPIED: the schedule enters the donation thread (the
+    engine's dispatches consume and re-emit it), and the caller's ``key``
+    must survive that — only the state and the schedule itself are part of
+    the donation contract.
+    """
+    return KeySchedule(
+        key_data=jnp.array(jr.key_data(key), copy=True),
+        counter=jnp.asarray(counter, jnp.int32),
+    )
+
+
+def round_keys(sched: KeySchedule, batch: int) -> jax.Array:
+    """The current round's per-instance typed keys, derived on device.
+
+    Trace-time only (call under jit): one ``fold_in`` of the carried
+    counter, then one vmapped ``fold_in`` over the instance index — the
+    device-side replacement for the blocking driver's host-side per-round
+    key split.  Same threefry derivation strength, and the instance-index
+    fold keeps this module free of the banned host-split idiom the
+    hot-path lint (scripts/ci.sh) greps for.
+    """
+    base = jr.wrap_key_data(sched.key_data)
+    kr = jr.fold_in(base, sched.counter)
+    return jax.vmap(jr.fold_in, in_axes=(None, 0))(
+        kr, jnp.arange(batch, dtype=jnp.uint32)
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rounds", "m", "max_liars", "unroll", "collect_decisions"),
+    donate_argnums=(0, 1),
+)
+def pipeline_megastep(
+    state: SimState,
+    sched: KeySchedule,
+    *,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+):
+    """``rounds`` agreement rounds in one donated ``lax.scan`` dispatch.
+
+    Returns ``(state, sched, histograms[, decisions])``: the state rides
+    through unchanged and the schedule advances by ``rounds``, both
+    aliased onto the donated inputs so steady-state dispatches allocate
+    nothing but the outputs (``histograms`` [rounds, 3] int32 and, when
+    ``collect_decisions``, ``decisions`` [rounds, B] int8).
+
+    Bit-compat contract: round ``sched.counter + r`` computes exactly
+    ``agreement_step(round_keys(<schedule at counter + r>, B), state)`` —
+    the round-by-round blocking driver under the same key schedule
+    produces identical decisions and histograms (tests/test_pipeline.py).
+    """
+
+    def body(carry, _):
+        st, sc = carry
+        keys = round_keys(sc, st.batch)
+        out = agreement_step(keys, st, m=m, max_liars=max_liars)
+        nxt = KeySchedule(sc.key_data, sc.counter + 1)
+        ys = (
+            (out["histogram"], out["decision"])
+            if collect_decisions
+            else out["histogram"]
+        )
+        return (st, nxt), ys
+
+    (state, sched), ys = jax.lax.scan(
+        body, (state, sched), None, length=rounds, unroll=unroll
+    )
+    if collect_decisions:
+        return state, sched, ys[0], ys[1]
+    return state, sched, ys
+
+
+def pipeline_sweep(
+    key: jax.Array,
+    state: SimState,
+    rounds: int,
+    *,
+    m: int = 1,
+    max_liars: int | None = None,
+    depth: int = 2,
+    rounds_per_dispatch: int = 1,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+    host_work=None,
+    mesh: Mesh | None = None,
+    on_event=None,
+):
+    """Run ``rounds`` sweep rounds through the depth-k pipelined engine.
+
+    Dispatches ``ceil(rounds / rounds_per_dispatch)`` donated megasteps
+    (the last one sized to the remainder), keeping ``depth`` of them
+    un-retired between loop iterations — so immediately after a new
+    dispatch (and before its retire check) up to ``depth + 1`` are
+    momentarily in flight, which is what ``stats["max_in_flight"]``
+    reports.  Between a dispatch and the retire check the
+    ``host_work(dispatch_index)`` callback runs host-side work overlapped
+    with device compute.  ``on_event(kind, index)`` (kinds ``"dispatch"``
+    / ``"retire"``) instruments the schedule for the dispatch-count tests.
+
+    DONATION: ``state`` is consumed by the first dispatch — use the
+    returned ``final_state``.  With ``mesh`` set the engine first lays the
+    batch out on the mesh's "data" axis (``sharded_sweep``'s placement,
+    multi-process safe via ``put_global``) and donation recycles the
+    sharded copies instead.
+
+    Returns a dict:
+
+    - ``histograms`` [rounds, 3] host int32 — per-round [retreat, attack,
+      undefined] decision counts (fetched at retire time, never earlier);
+    - ``decisions`` [rounds, B] host int8 when ``collect_decisions``;
+    - ``final_state`` / ``final_schedule`` — the live (un-donated) pair,
+      ready to continue the sweep;
+    - ``stats`` — dispatch bookkeeping: ``dispatches``, ``depth``,
+      ``rounds_per_dispatch``, ``max_in_flight``, and
+      ``retires_before_drain`` (how many retires the steady-state loop
+      performed; the rest drained at the end).
+    """
+    if rounds < 1:
+        raise ValueError(f"rounds={rounds} must be >= 1")
+    if depth < 1:
+        raise ValueError(f"depth={depth} must be >= 1")
+    if rounds_per_dispatch < 1:
+        raise ValueError(
+            f"rounds_per_dispatch={rounds_per_dispatch} must be >= 1"
+        )
+    if unroll < 1:
+        raise ValueError(f"unroll={unroll} must be >= 1")
+
+    sched = make_key_schedule(key)
+    if mesh is not None:
+        state = jax.tree.map(
+            lambda x: put_global(
+                mesh, x, P("data", *([None] * (x.ndim - 1)))
+            ),
+            state,
+        )
+        sched = jax.tree.map(
+            lambda x: put_global(mesh, x, P(*([None] * x.ndim))), sched
+        )
+
+    chunks = [rounds_per_dispatch] * (rounds // rounds_per_dispatch)
+    if rounds % rounds_per_dispatch:
+        chunks.append(rounds % rounds_per_dispatch)
+
+    inflight: collections.deque = collections.deque()
+    retired = []  # (histograms, decisions|None) host tuples, dispatch order
+    max_in_flight = 0
+    retires_before_drain = 0
+
+    def retire():
+        d, ys = inflight.popleft()
+        # The ONLY blocking operation in the engine: fetch dispatch d's
+        # outputs, which waits on a dispatch `depth` behind the queue head
+        # while later rounds keep the device busy.
+        retired.append(jax.device_get(ys))
+        if on_event is not None:
+            on_event("retire", d)
+
+    for d, nr in enumerate(chunks):
+        out = pipeline_megastep(
+            state,
+            sched,
+            rounds=nr,
+            m=m,
+            max_liars=max_liars,
+            unroll=min(unroll, nr),
+            collect_decisions=collect_decisions,
+        )
+        state, sched = out[0], out[1]
+        ys = out[2:]
+        if on_event is not None:
+            on_event("dispatch", d)
+        inflight.append((d, ys))
+        max_in_flight = max(max_in_flight, len(inflight))
+        if host_work is not None:
+            host_work(d)  # overlaps the rounds still executing on device
+        while len(inflight) > depth:
+            retire()
+            retires_before_drain += 1
+    while inflight:
+        retire()
+
+    # Assemble per-round outputs on the host.  The per-dispatch blocks are
+    # already host arrays (fetched at retire), so this is host-side
+    # concatenation, not a device sync.
+    import numpy as _host_np
+
+    histograms = _host_np.concatenate([ys[0] for ys in retired])
+    result = {
+        "histograms": histograms,
+        "final_state": state,
+        "final_schedule": sched,
+        "stats": {
+            "rounds": rounds,
+            "dispatches": len(chunks),
+            "depth": depth,
+            "rounds_per_dispatch": rounds_per_dispatch,
+            "max_in_flight": max_in_flight,
+            "retires_before_drain": retires_before_drain,
+        },
+    }
+    if collect_decisions:
+        result["decisions"] = _host_np.concatenate([ys[1] for ys in retired])
+    return result

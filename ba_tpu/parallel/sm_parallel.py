@@ -38,7 +38,7 @@ from ba_tpu.core.sm import choice_from_seen
 from ba_tpu.core.rng import coin_bits, or_coin_threshold8, uniform_u8
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
-from ba_tpu.parallel.mesh import cached_jit
+from ba_tpu.parallel.mesh import cached_jit, shard_map
 from ba_tpu.parallel.multihost import put_global, round1_jit
 
 
@@ -124,7 +124,7 @@ def sm_node_sharded(
 
             seen_l, _ = jax.lax.scan(
                 one_round, seen_l, jnp.arange(1, m + 1),
-                unroll=m if m <= 4 else 1,  # same policy as core/sm.py
+                unroll=max(m, 1) if m <= 4 else 1,  # same policy as core/sm.py
             )
         else:
             for r in range(1, m + 1):
@@ -178,7 +178,7 @@ def sm_node_sharded(
             # [m, B, receiver, sender, value]: receivers shard with their
             # owning chips, senders/values replicated.
             in_specs.append(P(None, "data", "node", None, None))
-        return jax.shard_map(
+        return shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=tuple(in_specs),

@@ -161,6 +161,121 @@ class Cluster:
         round_idx = self._round
         self._round += 1
 
+        res = self._tally(command, leader_idx, majorities)
+        metrics.emit(
+            {
+                "event": "agreement_round",
+                "round": round_idx,
+                "n": len(self.generals),
+                "leader_id": self.leader_id,
+                "order": command,
+                "decision": res.decision,
+                "n_attack": res.n_attack,
+                "n_retreat": res.n_retreat,
+                "n_undefined": res.n_undefined,
+                "needed": res.needed,
+                "total": res.total,
+                "nr_faulty": res.nr_faulty,
+                "round_elapsed_s": round(round_elapsed, 6),
+            }
+        )
+        return res
+
+    def actual_order_rounds(self, command: str, rounds: int):
+        """``rounds`` agreement rounds in one pipelined device run.
+
+        The multi-round form of ``actual_order``: backends exposing
+        ``run_rounds`` (the JAX path, oral messages) execute all R rounds
+        through the pipelined sweep engine — on-device key schedule,
+        donated buffers, depth-k dispatches in flight — with metrics
+        emission riding the engine's ``host_work`` hook so the JSON lines
+        are written while the device is still computing later rounds.
+        Backends without it (the Python oracle; the signed path, which
+        host-signs between device programs) fall back to R sequential
+        ``actual_order`` calls.
+
+        Returns ``(last RoundResult, counts, stats)``: the final round's
+        full result (what ``run-rounds`` prints as the per-general
+        block), a ``{"attack": a, "retreat": r, "undefined": u}`` count of
+        the R per-round quorum decisions, and the engine's dispatch stats
+        (None on the fallback path).  None when the cluster is empty.
+        """
+        if rounds < 1:
+            raise ValueError(f"rounds={rounds} must be >= 1")
+        if not self.generals:
+            return None  # the reference would crash here (SURVEY.md Q4)
+        self.tick()
+        order_code = command_from_name(command)
+        leader_idx = next(
+            i for i, g in enumerate(self.generals) if g.id == self.leader_id
+        )
+        run_rounds = getattr(self.backend, "run_rounds", None)
+        if command not in ("attack", "retreat"):
+            # Non-canonical orders hit the leader raw-string parity quirk
+            # (ba.py:284-285: the leader's majority is the raw string,
+            # bucketed as undefined) which the device quorum cannot see —
+            # take the sequential path so both outputs stay quirk-exact.
+            run_rounds = None
+        pipelined = None
+        round_base = self._round
+        t0 = time.perf_counter()
+        if run_rounds is not None:
+
+            def host_work(dispatch):
+                # Runs between dispatches while the device is busy: the
+                # overlap model's host lane (utils/metrics.py sink).
+                metrics.emit(
+                    {
+                        "event": "pipeline_dispatch",
+                        "dispatch": dispatch,
+                        "round_base": round_base,
+                        "n": len(self.generals),
+                        "order": command,
+                    }
+                )
+
+            pipelined = run_rounds(
+                self.generals,
+                leader_idx,
+                order_code,
+                self._round_seed(),
+                rounds,
+                host_work=host_work,
+            )
+        if pipelined is None:
+            res = None
+            counts = {"attack": 0, "retreat": 0, "undefined": 0}
+            for _ in range(rounds):
+                res = self.actual_order(command)
+                counts[res.decision] += 1
+            return res, counts, None
+        majorities, decisions, stats = pipelined
+        elapsed = time.perf_counter() - t0
+        self._round += rounds
+        res = self._tally(command, leader_idx, majorities)
+        names = {ATTACK: "attack", RETREAT: "retreat"}
+        counts = {"attack": 0, "retreat": 0, "undefined": 0}
+        for d in decisions:
+            counts[names.get(d, "undefined")] += 1
+        metrics.emit(
+            {
+                "event": "agreement_rounds_pipelined",
+                "round_base": round_base,
+                "rounds": rounds,
+                "n": len(self.generals),
+                "leader_id": self.leader_id,
+                "order": command,
+                "decision_counts": counts,
+                "dispatches": stats["dispatches"],
+                "depth": stats["depth"],
+                "elapsed_s": round(elapsed, 6),
+            }
+        )
+        return res, counts, stats
+
+    def _tally(self, command: str, leader_idx: int, majorities) -> RoundResult:
+        """REPL-level bookkeeping for one round's majorities (ba.py:383-399
+        + 197-255), shared by the per-round and pipelined paths."""
         per_general = []
         n_attack = n_retreat = n_undefined = 0
         nr_faulty = 0
@@ -190,23 +305,6 @@ class Cluster:
             decision = "attack"
         else:
             decision = "undefined"
-        metrics.emit(
-            {
-                "event": "agreement_round",
-                "round": round_idx,
-                "n": len(self.generals),
-                "leader_id": self.leader_id,
-                "order": command,
-                "decision": decision,
-                "n_attack": n_attack,
-                "n_retreat": n_retreat,
-                "n_undefined": n_undefined,
-                "needed": needed,
-                "total": total,
-                "nr_faulty": nr_faulty,
-                "round_elapsed_s": round(round_elapsed, 6),
-            }
-        )
         return RoundResult(
             per_general=per_general,
             nr_faulty=nr_faulty,

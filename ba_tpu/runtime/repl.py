@@ -14,6 +14,11 @@ Command surface and exact output formats follow SURVEY.md section 3.1
 - ``List`` — ``P{id}, {True|False}`` primary flags (ba.py:439-445).
 - ``Exit`` — leave the loop (ba.py:373-374).
 
+Framework extension: ``run-rounds <cmd> <R>`` — R agreement rounds in one
+pipelined device run (the last round's block in ``actual-order`` format,
+plus a ``Rounds: ...`` decision tally).  No reference analogue; the six
+reference commands stay byte-identical.
+
 Divergences (all guarded crashes in the reference, documented in SURVEY.md
 section 3.3): unknown ids and an empty cluster are ignored instead of
 raising (Q4), and ``actual-order`` immediately after killing the leader
@@ -70,6 +75,33 @@ def handle_command(cluster: Cluster, line: str, out) -> bool:
             status = "primary" if is_primary else "secondary"
             out(f"G{gid}, {status}, majority={maj}, state={_fmt_state(faulty)}")
         out(quorum_line(res))
+
+    elif command == "run-rounds":
+        # Framework extension (no reference analogue): R agreement rounds
+        # in one pipelined device run (cluster.actual_order_rounds — the
+        # depth-k engine with metrics overlapping device compute).  Prints
+        # the LAST round's per-general block + quorum line in the
+        # actual-order format, then a decision tally over all R rounds.
+        if len(cmd) < 3:
+            return True
+        try:
+            rounds = int(cmd[2])
+        except ValueError:
+            return True
+        if rounds < 1:
+            return True
+        ran = cluster.actual_order_rounds(cmd[1], rounds)
+        if ran is None:
+            return True
+        res, counts, _stats = ran
+        for gid, is_primary, maj, faulty in res.per_general:
+            status = "primary" if is_primary else "secondary"
+            out(f"G{gid}, {status}, majority={maj}, state={_fmt_state(faulty)}")
+        out(quorum_line(res))
+        out(
+            f"Rounds: {rounds} - attack={counts['attack']}, "
+            f"retreat={counts['retreat']}, undefined={counts['undefined']}"
+        )
 
     elif command == "g-state":
         if len(cmd) == 3:

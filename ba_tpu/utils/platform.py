@@ -37,6 +37,55 @@ def use_pallas() -> bool:
     return jax.devices()[0].platform == "tpu"
 
 
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Turn on JAX's persistent XLA compilation cache (idempotent).
+
+    The interactive paths (REPL/cluster via ``JaxBackend``) and the bench
+    driver re-pay every jit compile each process start — through the TPU
+    tunnel a single Mosaic compile costs seconds to minutes, so a fresh
+    REPL session used to burn its first ``actual-order`` on a compile the
+    previous session already did.  The persistent cache keys on (HLO,
+    compile options, backend), so re-compiles of unchanged programs become
+    disk reads.
+
+    ``BA_TPU_COMPILE_CACHE`` controls it: ``0`` disables, a path overrides
+    the location, unset/``1`` uses ``path`` or ``~/.cache/ba_tpu/xla``.
+    Thresholds are zeroed so even the small interactive B=1 programs are
+    cached (the default min-compile-time gate would skip exactly the
+    programs the REPL re-pays most often).  Returns the cache dir in use,
+    or None when disabled or unsupported by the installed jax.
+    """
+    env = os.environ.get("BA_TPU_COMPILE_CACHE", "")
+    if env == "0":
+        return None
+    if env not in ("", "1"):
+        path = env
+    if path is None:
+        path = os.path.join(
+            os.path.expanduser("~"), ".cache", "ba_tpu", "xla"
+        )
+    import jax
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except (AttributeError, OSError):
+        return None  # jax without the cache, or unwritable cache dir
+    # Threshold knobs are best-effort AFTER the dir is live: a jax that has
+    # the cache but not a threshold knob keeps its default gate (some small
+    # programs skip the cache) — the cache is still correctly reported as
+    # enabled, never half-configured-but-claimed-off.
+    for knob, val in (
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except AttributeError:
+            pass
+    return path
+
+
 def force_virtual_cpu_devices(n: int = 8, *, override_tpu_guard: bool = False) -> None:
     """Ensure >= n virtual CPU devices and select the CPU platform.
 

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# CI entry: hot-path lint + the tier-1 suite (ROADMAP.md, verbatim).
+#
+# The lint guards the pipelined sweep engine's contract (ISSUE 1): the
+# round-loop modules under ba_tpu/parallel/ must never re-grow
+#
+#   - block_until_ready      — on the tunnel backend it acks the dispatch
+#                              without awaiting execution (README
+#                              methodology note), and in a round loop ANY
+#                              host sync serializes host and device; the
+#                              engine's only sync is the depth-delayed
+#                              device_get retire;
+#   - host np. conversions   — np.asarray/np.array on device values drain
+#                              the queue through the host (multihost.py's
+#                              documented put_global ingestion is the one
+#                              sanctioned np user in the package);
+#   - host per-round key splits in pipeline.py — keys are derived ON
+#                              DEVICE from the folded counter
+#                              (KeySchedule); a jr.split reappearing
+#                              there means the host is back in the
+#                              per-round loop.
+#
+# Greps are over source text (comments included) by design: cheap, zero
+# deps, and the banned idioms have no legitimate spelling in these files.
+
+set -u
+cd "$(dirname "$0")/.."
+
+fail=0
+
+echo "== hot-path lint: ba_tpu/parallel =="
+if grep -rn "block_until_ready" ba_tpu/parallel/ --include='*.py'; then
+    echo "LINT FAIL: block_until_ready inside ba_tpu/parallel/" >&2
+    fail=1
+fi
+# \b keeps jnp.asarray (device-side) out of the match; scope is the
+# round-loop modules (mesh/multihost build host-side topology and are
+# the package's sanctioned numpy users).
+if grep -rn "\bnp\.asarray(\|\bnp\.array(\|\bnumpy\.asarray(" \
+        ba_tpu/parallel/pipeline.py ba_tpu/parallel/sweep.py; then
+    echo "LINT FAIL: host numpy conversion in a parallel round-loop module" >&2
+    fail=1
+fi
+if grep -n "jr\.split\|random\.split" ba_tpu/parallel/pipeline.py; then
+    echo "LINT FAIL: host key split in pipeline.py (keys must derive" \
+         "on device from the KeySchedule counter)" >&2
+    fail=1
+fi
+if [ "$fail" -ne 0 ]; then
+    echo "hot-path lint failed" >&2
+    exit 1
+fi
+echo "hot-path lint OK"
+
+echo "== tier-1 suite =="
+# Verbatim from ROADMAP.md ("Tier-1 verify"); keep the two in sync.
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

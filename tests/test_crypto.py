@@ -590,3 +590,24 @@ def test_verify_received_rlc_env_knob(monkeypatch):
     want2 = np.asarray(verify_received(pks, msgs, s2))
     np.testing.assert_array_equal(got2, want2)
     assert not got2[0, 3] and got2.sum() == B * n - 1
+
+
+def test_sign_on_device_auto_gates_on_real_tpu(monkeypatch):
+    # ADVICE r5 (signed.py:465): auto mode must NOT flip the signing
+    # default to the emulated device path just because BA_TPU_PALLAS=1 is
+    # forced on a CPU backend — the platform itself has to be TPU.  The
+    # explicit knob still overrides in both directions.
+    from ba_tpu.crypto.signed import sign_on_device
+
+    if jax.devices()[0].platform == "tpu":
+        pytest.skip("CPU-platform gating test")
+    monkeypatch.delenv("BA_TPU_SIGN_DEVICE", raising=False)
+    monkeypatch.setenv("BA_TPU_PALLAS", "1")  # the silent-flip case
+    assert sign_on_device() is False
+    monkeypatch.setenv("BA_TPU_PALLAS", "0")
+    assert sign_on_device() is False
+    monkeypatch.setenv("BA_TPU_SIGN_DEVICE", "1")  # deliberate override
+    assert sign_on_device() is True
+    monkeypatch.setenv("BA_TPU_SIGN_DEVICE", "0")
+    monkeypatch.setenv("BA_TPU_PALLAS", "1")
+    assert sign_on_device() is False

@@ -61,6 +61,15 @@ def worker_results(tmp_path_factory):
             pytest.fail("multihost worker timed out (distributed join hung?)")
         logs.append(stdout)
     for p, log in zip(procs, logs):
+        if p.returncode != 0 and (
+            "Multiprocess computations aren't implemented" in log
+        ):
+            # jaxlib builds without multiprocess CPU collectives (e.g.
+            # 0.4.x) cannot run the two-process gloo mesh at all — an
+            # environment incapability, not a framework regression (the
+            # single-process 8-device mesh tests still cover the sharded
+            # code paths bit-for-bit).
+            pytest.skip("jaxlib lacks multiprocess CPU collectives")
         assert p.returncode == 0, f"worker failed:\n{log}"
     with open(out) as f:
         return json.load(f)

@@ -1,0 +1,299 @@
+"""Pipelined sweep engine tests (ISSUE 1 tentpole, parallel/pipeline.py).
+
+Three contracts, each pinned independently:
+
+1. **Bit-exact equivalence** — under the engine's own key schedule, the
+   pipelined multi-round run produces byte-identical decisions and
+   histograms to the round-by-round ``agreement_step`` driver (and the
+   megastep/unroll/depth dials must not change results, only scheduling).
+2. **Donation safety** — the input state and schedule are consumed
+   (deleted) by dispatch, the engine never touches a donated buffer
+   afterwards, and the returned final state/schedule are live and
+   continue the sweep.
+3. **Depth-k overlap** — the engine keeps up to ``depth`` dispatches in
+   flight and performs NO host sync between dispatches: the first retire
+   happens only after the in-flight window fills, and
+   ``jax.block_until_ready`` is never called (it is monkeypatched to
+   raise for the duration).
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from ba_tpu.core.types import ATTACK, RETREAT
+from ba_tpu.parallel import make_mesh, make_sweep_state, pipeline_sweep
+from ba_tpu.parallel.pipeline import (
+    KeySchedule,
+    fresh_copy as _fresh,
+    make_key_schedule,
+    pipeline_megastep,
+    round_keys,
+)
+from ba_tpu.parallel.sweep import agreement_step
+
+
+def _reference_rounds(key, state, rounds, batch, m=1):
+    """The blocking round-by-round driver under the SAME key schedule."""
+    step = jax.jit(agreement_step, static_argnames=("m", "max_liars"))
+    keys_fn = jax.jit(round_keys, static_argnums=1)
+    decisions, hists = [], []
+    for r in range(rounds):
+        keys = keys_fn(make_key_schedule(key, r), batch)
+        out = step(keys, state, m=m)
+        decisions.append(np.asarray(out["decision"]))
+        hists.append(np.asarray(out["histogram"]))
+    return np.stack(decisions), np.stack(hists)
+
+
+def test_pipeline_matches_blocking_driver_bit_exact():
+    B, cap, R = 48, 16, 9
+    key = jr.key(7)
+    state = make_sweep_state(jr.key(0), B, cap, order=ATTACK)
+    want_dec, want_hist = _reference_rounds(key, _fresh(state), R, B)
+    out = pipeline_sweep(
+        key, state, R, depth=2, rounds_per_dispatch=1,
+        collect_decisions=True,
+    )
+    np.testing.assert_array_equal(out["decisions"], want_dec)
+    np.testing.assert_array_equal(out["histograms"], want_hist)
+    # Honest-leader sweep sanity: every round's histogram covers the batch.
+    assert (out["histograms"].sum(axis=1) == B).all()
+
+
+def test_megastep_and_unroll_do_not_change_results():
+    # K rounds per dispatch (lax.scan megastep) with unroll, plus a ragged
+    # remainder dispatch: pure scheduling — results stay bit-identical.
+    B, cap, R = 32, 8, 10
+    key = jr.key(11)
+    state = make_sweep_state(jr.key(1), B, cap, order=RETREAT)
+    want_dec, want_hist = _reference_rounds(key, _fresh(state), R, B)
+    for kpd, unroll, depth in ((4, 2, 1), (3, 3, 2), (10, 1, 3)):
+        out = pipeline_sweep(
+            key, _fresh(state), R,
+            depth=depth, rounds_per_dispatch=kpd, unroll=unroll,
+            collect_decisions=True,
+        )
+        np.testing.assert_array_equal(out["decisions"], want_dec)
+        np.testing.assert_array_equal(out["histograms"], want_hist)
+        assert out["stats"]["dispatches"] == -(-R // kpd)
+
+
+def test_pipeline_eig_m2():
+    # The m>1 EIG path threads through the same engine.
+    B, cap, R = 16, 8, 4
+    key = jr.key(13)
+    state = make_sweep_state(
+        jr.key(2), B, cap, min_n=8, max_traitor_frac=0.25, order=ATTACK
+    )
+    want_dec, _ = _reference_rounds(key, _fresh(state), R, B, m=2)
+    out = pipeline_sweep(key, state, R, m=2, collect_decisions=True)
+    np.testing.assert_array_equal(out["decisions"], want_dec)
+    # OM(2) validity: honest leader + t <= n/4 decides the order every round.
+    assert (out["histograms"][:, 1] == B).all()
+
+
+def test_donation_consumes_inputs_and_returns_live_state():
+    B, cap, R = 16, 8, 5
+    key = jr.key(17)
+    state = make_sweep_state(jr.key(3), B, cap, order=ATTACK)
+    sched = make_key_schedule(key)
+    out_state, out_sched, hists = pipeline_megastep(state, sched, rounds=R)
+    # Donated inputs are deleted: any further use must raise.
+    assert state.faulty.is_deleted() and sched.key_data.is_deleted()
+    with pytest.raises(RuntimeError):
+        _ = state.faulty + 0
+    with pytest.raises(RuntimeError):
+        _ = sched.counter + 0
+    # The returned pair is live and carries the thread forward.
+    assert int(out_sched.counter) == R
+    assert hists.shape == (R, 3)
+    out2 = pipeline_sweep(key, out_state, 2)
+    assert out2["histograms"].shape == (2, 3)
+
+
+def test_caller_key_survives_donation():
+    # make_key_schedule copies the key data: the caller's key must stay
+    # usable even though the schedule it seeded was donated.
+    key = jr.key(19)
+    state = make_sweep_state(jr.key(4), 8, 8)
+    pipeline_sweep(key, state, 3)
+    jr.fold_in(key, 0)  # would raise RuntimeError if donated
+
+
+def test_depth_k_inflight_no_intermediate_blocking(monkeypatch):
+    # The engine must never call block_until_ready (its only sync is the
+    # depth-delayed retire fetch), and the retire schedule must show k
+    # dispatches genuinely in flight before the first fetch.
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    B, cap, R, depth = 8, 8, 7, 3
+    state = make_sweep_state(jr.key(5), B, cap)
+    events = []
+    out = pipeline_sweep(
+        jr.key(23), state, R,
+        depth=depth, rounds_per_dispatch=1,
+        on_event=lambda kind, i: events.append((kind, i)),
+    )
+    dispatches = [i for kind, i in events if kind == "dispatch"]
+    retires = [i for kind, i in events if kind == "retire"]
+    assert dispatches == list(range(R))
+    assert retires == list(range(R))  # FIFO, all retired by return
+    # Steady state: retire r happens only after dispatch r + depth — the
+    # in-flight window is full before the engine ever blocks.
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [("dispatch", i) for i in range(depth + 1)]
+    for r in range(R - depth):
+        assert events.index(("retire", r)) > events.index(("dispatch", r + depth))
+    assert out["stats"]["dispatches"] == R
+    assert out["stats"]["max_in_flight"] == depth + 1
+    assert out["stats"]["retires_before_drain"] == R - depth
+
+
+def test_pipeline_host_work_overlaps_dispatches():
+    # host_work runs once per dispatch, after it is queued and before the
+    # engine may block on a retire — the metrics-emission overlap hook.
+    state = make_sweep_state(jr.key(6), 8, 8)
+    order = []
+    out = pipeline_sweep(
+        jr.key(29), state, 4,
+        depth=2, rounds_per_dispatch=2,
+        host_work=lambda d: order.append(("work", d)),
+        on_event=lambda kind, i: order.append((kind, i)),
+    )
+    assert [e for e in order if e[0] == "work"] == [("work", 0), ("work", 1)]
+    # Each dispatch's host work precedes any retire the same iteration does.
+    assert order.index(("work", 0)) < order.index(("retire", 0))
+    assert out["stats"]["dispatches"] == 2
+
+
+def test_pipeline_mesh_composes_bit_exact(eight_devices):
+    # sharded_sweep's data-axis layout applies unchanged, and sharding
+    # must not change a single bit of the results.
+    mesh = make_mesh((8, 1), ("data", "node"))
+    key = jr.key(31)
+    state = make_sweep_state(jr.key(7), 64, 16, order=ATTACK)
+    plain = pipeline_sweep(
+        key, _fresh(state), 6, rounds_per_dispatch=3, collect_decisions=True
+    )
+    sharded = pipeline_sweep(
+        key, state, 6, rounds_per_dispatch=3, collect_decisions=True,
+        mesh=mesh,
+    )
+    np.testing.assert_array_equal(plain["decisions"], sharded["decisions"])
+    np.testing.assert_array_equal(plain["histograms"], sharded["histograms"])
+
+
+def test_pipeline_validates_arguments():
+    state = make_sweep_state(jr.key(8), 8, 8)
+    with pytest.raises(ValueError):
+        pipeline_sweep(jr.key(0), state, 0)
+    with pytest.raises(ValueError):
+        pipeline_sweep(jr.key(0), state, 4, depth=0)
+    with pytest.raises(ValueError):
+        pipeline_sweep(jr.key(0), state, 4, rounds_per_dispatch=0)
+    with pytest.raises(ValueError):
+        pipeline_sweep(jr.key(0), state, 4, unroll=0)
+
+
+def test_key_schedule_resume_midstream():
+    # A schedule resumed at counter=r reproduces the tail of a full run:
+    # the continuation contract behind final_schedule.
+    B, cap = 24, 8
+    key = jr.key(37)
+    state = make_sweep_state(jr.key(9), B, cap, order=ATTACK)
+    full = pipeline_sweep(key, _fresh(state), 6, collect_decisions=True)
+    head = pipeline_sweep(key, _fresh(state), 3, collect_decisions=True)
+    sched = head["final_schedule"]
+    assert int(jax.device_get(sched.counter)) == 3
+    tail_state, tail_sched, hists, decs = pipeline_megastep(
+        head["final_state"], sched, rounds=3, collect_decisions=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(decs), full["decisions"][3:]
+    )
+    np.testing.assert_array_equal(np.asarray(hists), full["histograms"][3:])
+
+
+# -- runtime wiring (cluster/repl use the engine for multi-round runs) --------
+
+
+def test_cluster_run_rounds_pipelined_matches_repl_format():
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    cluster = Cluster(4, JaxBackend(platform="cpu"), seed=0)
+    out = []
+    assert handle_command(cluster, "run-rounds attack 5", out.append)
+    assert out[:4] == [
+        "G1, primary, majority=attack, state=NF",
+        "G2, secondary, majority=attack, state=NF",
+        "G3, secondary, majority=attack, state=NF",
+        "G4, secondary, majority=attack, state=NF",
+    ]
+    assert out[4] == (
+        "Execute order: attack! Non-faulty nodes in the system"
+        " - 3 out of 4 quorum suggests attack"
+    )
+    assert out[5] == "Rounds: 5 - attack=5, retreat=0, undefined=0"
+    assert cluster._round == 5  # future seeds advance past the whole run
+
+
+def test_cluster_run_rounds_fallback_py_backend():
+    from ba_tpu.runtime.backends import PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    cluster = Cluster(4, PyBackend(), seed=0)
+    res, counts, stats = cluster.actual_order_rounds("retreat", 3)
+    assert res.decision == "retreat"
+    assert counts == {"attack": 0, "retreat": 3, "undefined": 0}
+    assert stats is None  # sequential fallback, no pipeline stats
+    assert cluster._round == 3
+
+
+def test_cluster_run_rounds_noncanonical_command_takes_quirk_path():
+    # A non-attack/retreat order hits the leader raw-string parity quirk
+    # (ba.py:284-285), which the device quorum cannot represent — the
+    # cluster must take the sequential path so the per-general block and
+    # the decision tally stay quirk-exact (and mutually consistent).
+    from ba_tpu.runtime.backends import JaxBackend, PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    jx = Cluster(4, JaxBackend(platform="cpu"), seed=0)
+    res, counts, stats = jx.actual_order_rounds("charge", 2)
+    assert stats is None  # sequential fallback, not the pipeline
+    py = Cluster(4, PyBackend(), seed=0)
+    want, want_counts, _ = py.actual_order_rounds("charge", 2)
+    assert counts == want_counts
+    assert res.decision == want.decision
+    # The leader's printed majority is the raw string in both.
+    assert res.per_general[0][2] == "charge" == want.per_general[0][2]
+
+
+def test_cluster_run_rounds_emits_overlapped_metrics(tmp_path):
+    import json
+
+    from ba_tpu.utils import metrics
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    sink = tmp_path / "metrics.jsonl"
+    old = metrics._default
+    metrics._default = metrics.MetricsSink(str(sink))
+    try:
+        cluster = Cluster(4, JaxBackend(platform="cpu"), seed=0)
+        res, counts, stats = cluster.actual_order_rounds("attack", 20)
+    finally:
+        metrics._default = old
+    assert stats is not None and stats["dispatches"] >= 2
+    records = [json.loads(l) for l in sink.read_text().splitlines()]
+    per_dispatch = [r for r in records if r["event"] == "pipeline_dispatch"]
+    summary = [r for r in records if r["event"] == "agreement_rounds_pipelined"]
+    assert len(per_dispatch) == stats["dispatches"]
+    assert len(summary) == 1 and summary[0]["rounds"] == 20
+    assert summary[0]["decision_counts"] == counts
