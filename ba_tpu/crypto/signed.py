@@ -38,6 +38,7 @@ import os
 
 import numpy as np
 
+from ba_tpu import obs
 from ba_tpu.crypto import oracle
 
 try:  # native Ed25519 (baked-in wheel); oracle is the fallback + oracle
@@ -129,21 +130,31 @@ def sign_value_tables(
     """
     B = len(sks)
     msgs = _value_table_msgs(B, n_values, base)
-    nat = _native_or_none()
-    if nat is not None:
-        sk_arr = np.repeat(
-            np.stack([np.frombuffer(s, np.uint8) for s in sks]), n_values, axis=0
-        )
-        pk_arr = np.repeat(np.asarray(pks, np.uint8), n_values, axis=0)
-        sigs = nat.sign_batch(sk_arr, pk_arr, msgs.reshape(B * n_values, MSG_LEN))
-        return msgs, sigs.reshape(B, n_values, 64)
-    sigs = np.zeros((B, n_values, 64), np.uint8)
-    for b, sk in enumerate(sks):
-        pk = pks[b].tobytes()
-        for v in range(n_values):
-            sigs[b, v] = np.frombuffer(
-                host_sign(sk, pk, msgs[b, v].tobytes()), np.uint8
+    # Host signing is exactly the lane the pipelined engine's host_work
+    # hook overlaps with device compute (ROADMAP sign-ahead item), so it
+    # is span-traced + histogrammed: the trace shows whether signing fits
+    # inside the device window or spills past it.
+    with obs.timed_span("host_sign", "host_sign_s", batch=B, values=n_values):
+        nat = _native_or_none()
+        if nat is not None:
+            sk_arr = np.repeat(
+                np.stack([np.frombuffer(s, np.uint8) for s in sks]),
+                n_values,
+                axis=0,
             )
+            pk_arr = np.repeat(np.asarray(pks, np.uint8), n_values, axis=0)
+            sigs = nat.sign_batch(
+                sk_arr, pk_arr, msgs.reshape(B * n_values, MSG_LEN)
+            ).reshape(B, n_values, 64)
+        else:
+            sigs = np.zeros((B, n_values, 64), np.uint8)
+            for b, sk in enumerate(sks):
+                pk = pks[b].tobytes()
+                for v in range(n_values):
+                    sigs[b, v] = np.frombuffer(
+                        host_sign(sk, pk, msgs[b, v].tobytes()), np.uint8
+                    )
+    obs.default_registry().counter("host_signs_total").inc(B * n_values)
     return msgs, sigs
 
 
@@ -194,11 +205,14 @@ def sign_value_tables_device(
         np.stack([np.frombuffer(s, np.uint8) for s in sks]), n_values, axis=0
     )
     pk_arr = np.repeat(np.asarray(pks, np.uint8), n_values, axis=0)
-    sigs = _sign_jit(
-        jnp.asarray(sk_arr),
-        jnp.asarray(pk_arr),
-        jnp.asarray(msgs.reshape(B * n_values, MSG_LEN)),
-    )
+    with obs.span("device_sign_dispatch", batch=B, values=n_values):
+        # Host-side dispatch cost only: the sign program executes
+        # asynchronously and drains at the caller's fetch.
+        sigs = _sign_jit(
+            jnp.asarray(sk_arr),
+            jnp.asarray(pk_arr),
+            jnp.asarray(msgs.reshape(B * n_values, MSG_LEN)),
+        )
     return msgs, sigs.reshape(B, n_values, 64)
 
 
@@ -573,18 +587,19 @@ def setup_signed_tables_overlapped(
         else:
             oks.append(_verify_received_exact(pk_c, m_c, s_c)[: hi - lo])
     t_signed = time.perf_counter()
-    if rlc:
-        flags = jax.device_get([d[0] for d in deferred])  # ONE drain fetch
-        for flag, (_, pk_c, m_c, s_c) in zip(flags, deferred):
-            keep = min(per, batch - per * len(oks))
-            if flag:
-                oks.append(jnp.ones((keep, m_c.shape[1]), bool))
-            else:  # rare: an invalid table signature slipped in
-                oks.append(_verify_received_exact(pk_c, m_c, s_c)[:keep])
-    ok = jnp.concatenate(oks) if len(oks) > 1 else oks[0]
-    jax.device_get(ok)  # host fetch: genuinely drain the verify queue
-    if device_sign:  # signature bytes live on device until fetched
-        sigs_parts = [np.asarray(s) for s in sigs_parts]
+    with obs.span("signed_setup_drain", batch=batch, chunks=chunks):
+        if rlc:
+            flags = jax.device_get([d[0] for d in deferred])  # ONE drain fetch
+            for flag, (_, pk_c, m_c, s_c) in zip(flags, deferred):
+                keep = min(per, batch - per * len(oks))
+                if flag:
+                    oks.append(jnp.ones((keep, m_c.shape[1]), bool))
+                else:  # rare: an invalid table signature slipped in
+                    oks.append(_verify_received_exact(pk_c, m_c, s_c)[:keep])
+        ok = jnp.concatenate(oks) if len(oks) > 1 else oks[0]
+        jax.device_get(ok)  # host fetch: genuinely drain the verify queue
+        if device_sign:  # signature bytes live on device until fetched
+            sigs_parts = [np.asarray(s) for s in sigs_parts]
     t_end = time.perf_counter()
     msgs_t = np.concatenate(msgs_parts)
     sigs_t = np.concatenate(sigs_parts)

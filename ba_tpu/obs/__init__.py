@@ -1,0 +1,46 @@
+"""ba_tpu.obs — the unified observability layer.
+
+Three parts, layered bottom-up (docs/DESIGN.md §8):
+
+- **sink** (``ba_tpu.utils.metrics``): the versioned JSON-lines event
+  stream — one record per event, ``BA_TPU_METRICS=<path|->`` enables.
+- **registry** (``obs.registry``): typed counters / gauges /
+  log-bucketed histograms aggregating in memory; snapshots into the sink
+  as ``{"event": "metrics_snapshot", "v": 1, ...}`` and dumps
+  Prometheus-style text on demand (REPL ``stats``; ``bench.py --obs``).
+- **tracer** (``obs.trace``): thread-safe monotonic ring-buffer span
+  tracing with Chrome trace-event export (Perfetto /
+  ``chrome://tracing``), ``BA_TPU_TRACE`` enables.
+
+Everything here is HOST-side and jax-free: spans and emissions must
+never appear inside jitted or scanned bodies (``scripts/ci.sh`` lints
+``ba_tpu/core`` and ``ba_tpu/ops`` for exactly that), and with both env
+vars unset the layer writes no files and grows no buffers — the
+overhead-guard tests in tests/test_obs.py pin it.
+"""
+
+from ba_tpu.obs import instrument, registry, trace
+from ba_tpu.obs.instrument import (
+    compile_or_dispatch_span,
+    first_call,
+    reset_first_calls,
+    timed_span,
+)
+from ba_tpu.obs.registry import MetricsRegistry, default_registry
+from ba_tpu.obs.trace import Tracer, default_tracer, instant, span
+
+__all__ = [
+    "MetricsRegistry",
+    "Tracer",
+    "compile_or_dispatch_span",
+    "default_registry",
+    "default_tracer",
+    "first_call",
+    "instant",
+    "instrument",
+    "registry",
+    "reset_first_calls",
+    "span",
+    "timed_span",
+    "trace",
+]

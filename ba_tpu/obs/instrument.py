@@ -1,0 +1,129 @@
+"""Instrumentation glue: compile-vs-dispatch classification and shared
+metric names.
+
+jit hides compilation inside the first call of each (program, static
+args, shapes) combination — there is no portable "was that a cache hit?"
+callback, and through the persistent XLA cache
+(``utils/platform.enable_compilation_cache``, ``BA_TPU_COMPILE_CACHE``)
+a "compile" may be a disk read.  What IS observable, cheaply and
+everywhere, is *first-call timing*: the first dispatch of a given static
+key pays trace + compile (or cache load), every later one is a cached
+dispatch.  ``first_call(key)`` is that classifier — a process-wide seen
+set — and the callers (``parallel/pipeline.py``,
+``runtime/backends.py``) name the surrounding span ``compile`` or
+``dispatch`` accordingly and feed ``compile_time_s`` on the first hit.
+With the persistent cache enabled the ``compile`` spans shrink to cache
+loads, which is exactly the effect the cache A/B wants to see in the
+trace.
+
+Canonical metric names (so dashboards/tests never chase spellings):
+
+- ``compile_time_s``               histogram, first-call latencies
+- ``pipeline_dispatch_latency_s``  histogram, submit → retire per dispatch
+- ``pipeline_retire_lag_s``        histogram, time blocked in the retire fetch
+- ``pipeline_depth_occupancy``     histogram, in-flight dispatches (base=1)
+- ``pipeline_dispatches_total`` / ``pipeline_retires_total``  counters
+- ``round_wall_s``                 histogram, interactive round wall time
+- ``host_sign_s``                  histogram, host signing batches
+- ``elections_total`` / ``failover_kills_total``  counters
+- ``compile_cache_enabled``        gauge, 0/1
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+_seen: set = set()
+_seen_lock = threading.Lock()
+
+
+def first_call(key) -> bool:
+    """True exactly once per hashable ``key`` per process.
+
+    The compile-vs-cached-dispatch classifier: key on the static
+    arguments + input shapes that force a fresh jit specialization.
+    """
+    with _seen_lock:
+        if key in _seen:
+            return False
+        _seen.add(key)
+        return True
+
+
+def reset_first_calls() -> None:
+    """Forget all seen keys (tests that pin ``compile`` span emission)."""
+    with _seen_lock:
+        _seen.clear()
+
+
+class TimedBox:
+    """Yielded by ``timed_span``; ``elapsed_s`` is set when the span
+    closes, for callers that also need the scalar (JSONL records)."""
+
+    __slots__ = ("elapsed_s",)
+
+    def __init__(self):
+        self.elapsed_s = None
+
+
+@contextlib.contextmanager
+def timed_span(name: str, histogram=None, **attrs):
+    """One clock window feeding BOTH a span and a latency histogram.
+
+    ``histogram`` is a registry ``Histogram`` or a metric name resolved
+    on the default registry (None = span only).  The single spelling for
+    every span-plus-histogram site (host signing, interactive rounds,
+    pipeline retires), so the two windows can never drift apart.
+    """
+    from ba_tpu.obs import registry, trace
+
+    if isinstance(histogram, str):
+        histogram = registry.default_registry().histogram(histogram)
+    box = TimedBox()
+    t0 = time.perf_counter()
+    try:
+        with trace.default_tracer().span(name, **attrs):
+            yield box
+    finally:
+        box.elapsed_s = time.perf_counter() - t0
+        if histogram is not None:
+            histogram.record(box.elapsed_s)
+
+
+@contextlib.contextmanager
+def compile_or_dispatch_span(key, **attrs):
+    """Span a jitted call as ``compile`` (first call of ``key``) or
+    ``dispatch`` (cached), yielding the chosen phase name.
+
+    The single spelling of the classification for every instrumented jit
+    site (``parallel/pipeline.py``, ``runtime/backends.py``): first hits
+    additionally record their latency into the ``compile_time_s``
+    histogram.  The span measures host-side time only — for an async
+    dispatch that is trace + compile (or persistent-cache load) on the
+    first call and just the enqueue afterwards.
+    """
+    from ba_tpu.obs import registry, trace
+
+    phase = "compile" if first_call(key) else "dispatch"
+    t0 = time.perf_counter()
+    with trace.default_tracer().span(phase, **attrs):
+        yield phase
+    if phase == "compile":
+        registry.default_registry().histogram("compile_time_s").record(
+            time.perf_counter() - t0
+        )
+
+
+def report_compile_cache(path: str | None) -> None:
+    """Record the persistent-cache decision (called by
+    ``utils/platform.enable_compilation_cache``): gauge 0/1 plus an
+    instant trace marker carrying the directory when enabled."""
+    from ba_tpu.obs import registry, trace
+
+    registry.default_registry().gauge("compile_cache_enabled").set(
+        0 if path is None else 1
+    )
+    if path is not None:
+        trace.default_tracer().instant("compile_cache_enabled", dir=path)

@@ -1,0 +1,185 @@
+"""Span tracing: a thread-safe monotonic ring-buffer tracer with Chrome
+trace-event export.
+
+The host-side timeline counterpart of ``bench.py --profile`` (which traces
+*device* kernels via jax.profiler): this tracer records where the HOST
+spends its time — jit compile vs. cached dispatch, pipeline dispatch /
+retire / host_work phases, Ed25519 host signing, election and failover
+transitions, REPL command handling — as closed spans in a fixed-capacity
+ring buffer, exportable to Chrome trace-event JSON loadable in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``.
+
+Clocking: all span timestamps and durations come from
+``time.perf_counter_ns`` — monotonic, ns resolution, immune to wall-clock
+steps.  The epoch is arbitrary (process start), which is fine for a
+trace: viewers only care about relative placement.
+
+Enable with ``BA_TPU_TRACE``: unset/empty/``0`` disables (spans are a
+single attribute check + generator frame, and the buffer NEVER grows — the
+overhead-guard test pins that); ``1`` enables buffering; any other value
+is a path the default tracer exports to at process exit.  ``bench.py
+--obs DIR`` enables programmatically and exports to ``DIR/trace.json``.
+
+This module must stay importable without jax and must never touch device
+values: spans wrap HOST phases only (a span inside a jitted/scan body
+would time tracing, not execution — ``scripts/ci.sh`` lints for that).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+
+# One span record: (name, start perf_counter_ns, duration ns, thread id,
+# attrs dict | None).  Instant events use duration -1.
+_INSTANT = -1
+
+
+class Tracer:
+    """Fixed-capacity ring buffer of host spans.
+
+    ``capacity`` bounds memory (oldest spans drop first — a long campaign
+    keeps its most recent window, which is the window being diagnosed).
+    ``enabled=None`` derives from ``BA_TPU_TRACE``; a bool forces.
+    """
+
+    def __init__(self, capacity: int = 65536, enabled: bool | None = None):
+        if enabled is None:
+            env = os.environ.get("BA_TPU_TRACE", "")
+            enabled = bool(env) and env != "0"
+        self.enabled = enabled
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Context manager recording one closed span around its body.
+
+        Thread-safe: concurrent spans from the pipelined engine's
+        ``host_work`` lane interleave cleanly (each record carries its
+        thread id, so the Chrome export lays them out on separate rows).
+        """
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter_ns() - t0
+            with self._lock:
+                self._buf.append(
+                    (name, t0, dur, threading.get_ident(), attrs or None)
+                )
+
+    def instant(self, name: str, **attrs) -> None:
+        """A zero-duration marker (election flips, cache enablement...)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._buf.append(
+                (
+                    name,
+                    time.perf_counter_ns(),
+                    _INSTANT,
+                    threading.get_ident(),
+                    attrs or None,
+                )
+            )
+
+    # -- export --------------------------------------------------------------
+
+    def chrome_events(self) -> list[dict]:
+        """The buffer as Chrome trace-event dicts (``ph`` ``X``/``i``).
+
+        Timestamps are microseconds (the trace-event unit); complete
+        spans carry ``dur``; every event has ``pid``/``tid`` so Perfetto
+        groups rows by thread.
+        """
+        with self._lock:
+            records = list(self._buf)
+        events = []
+        for name, t0, dur, tid, attrs in records:
+            ev = {
+                "name": name,
+                "ts": t0 / 1e3,
+                "pid": self._pid,
+                "tid": tid,
+                "args": attrs or {},
+            }
+            if dur == _INSTANT:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            else:
+                ev["ph"] = "X"
+                ev["dur"] = dur / 1e3
+            events.append(ev)
+        return events
+
+    def export_chrome(self, path: str) -> str:
+        """Write the buffer as a Chrome trace-event JSON file at ``path``."""
+        doc = {
+            "traceEvents": self.chrome_events(),
+            "displayTimeUnit": "ms",
+        }
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        return path
+
+
+_default: Tracer | None = None
+
+
+def default_tracer() -> Tracer:
+    """Process-wide tracer configured from ``BA_TPU_TRACE`` (lazily).
+
+    When the env value is a path (not ``0``/``1``), an atexit hook
+    exports the Chrome trace there — the no-code-changes way to trace a
+    whole REPL session or sweep campaign.
+    """
+    global _default
+    if _default is None:
+        _default = Tracer()
+        env = os.environ.get("BA_TPU_TRACE", "")
+        if env not in ("", "0", "1"):
+            atexit.register(_export_at_exit, _default, env)
+    return _default
+
+
+def _export_at_exit(tracer: Tracer, path: str) -> None:
+    """Best-effort exit export: a bad BA_TPU_TRACE path must not end an
+    otherwise-clean session with a traceback."""
+    if not tracer.enabled:
+        return
+    try:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tracer.export_chrome(path)
+    except OSError as e:
+        import sys
+
+        print(f"ba_tpu.obs: trace export to {path!r} failed: {e}",
+              file=sys.stderr)
+
+
+def span(name: str, **attrs):
+    """Module-level ``span`` on the default tracer (the common spelling)."""
+    return default_tracer().span(name, **attrs)
+
+
+def instant(name: str, **attrs) -> None:
+    default_tracer().instant(name, **attrs)

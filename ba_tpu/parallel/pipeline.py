@@ -55,12 +55,14 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 import jax.random as jr
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ba_tpu import obs
 from ba_tpu.core.state import SimState
 from ba_tpu.parallel.multihost import put_global
 from ba_tpu.parallel.sweep import agreement_step
@@ -250,33 +252,72 @@ def pipeline_sweep(
     max_in_flight = 0
     retires_before_drain = 0
 
+    # Observability (ISSUE 2): spans + registry feed off the engine's
+    # existing dispatch/retire/host_work structure and add NO
+    # synchronization — only perf_counter reads (the no-blocking test
+    # runs with instrumentation enabled to pin that).  Spans no-op when
+    # the tracer is disabled; registry updates are in-memory scalar ops.
+    tracer = obs.default_tracer()
+    reg = obs.default_registry()
+    lat_h = reg.histogram("pipeline_dispatch_latency_s")
+    lag_h = reg.histogram("pipeline_retire_lag_s")
+    occ_h = reg.histogram("pipeline_depth_occupancy", base=1.0, n_buckets=16)
+    disp_c = reg.counter("pipeline_dispatches_total")
+    ret_c = reg.counter("pipeline_retires_total")
+
     def retire():
-        d, ys = inflight.popleft()
-        # The ONLY blocking operation in the engine: fetch dispatch d's
-        # outputs, which waits on a dispatch `depth` behind the queue head
-        # while later rounds keep the device busy.
-        retired.append(jax.device_get(ys))
+        # t_sub rides the in-flight tuple (perf_counter_ns at submit).
+        d, ys, t_sub = inflight.popleft()
+        with obs.timed_span("retire", lag_h, dispatch=d):
+            # The ONLY blocking operation in the engine: fetch dispatch
+            # d's outputs, which waits on a dispatch `depth` behind the
+            # queue head while later rounds keep the device busy.
+            retired.append(jax.device_get(ys))
+        lat_h.record((time.perf_counter_ns() - t_sub) / 1e9)
+        ret_c.inc()
         if on_event is not None:
             on_event("retire", d)
 
     for d, nr in enumerate(chunks):
-        out = pipeline_megastep(
-            state,
-            sched,
-            rounds=nr,
-            m=m,
-            max_liars=max_liars,
-            unroll=min(unroll, nr),
-            collect_decisions=collect_decisions,
+        # First dispatch of a fresh static specialization pays trace +
+        # compile (or a persistent-cache load) synchronously before the
+        # async dispatch; later ones are cached dispatches — the span is
+        # named accordingly (obs.compile_or_dispatch_span).
+        ckey = (
+            "pipeline_megastep",
+            state.faulty.shape,
+            nr,
+            m,
+            max_liars,
+            min(unroll, nr),
+            collect_decisions,
+            # Sharded inputs force a fresh specialization even at equal
+            # shapes/statics — key on it so the meshed first call still
+            # classifies as "compile".
+            mesh is not None,
         )
+        with obs.compile_or_dispatch_span(ckey, dispatch=d, rounds=nr):
+            out = pipeline_megastep(
+                state,
+                sched,
+                rounds=nr,
+                m=m,
+                max_liars=max_liars,
+                unroll=min(unroll, nr),
+                collect_decisions=collect_decisions,
+            )
+        t_sub = time.perf_counter_ns()
+        disp_c.inc()
         state, sched = out[0], out[1]
         ys = out[2:]
         if on_event is not None:
             on_event("dispatch", d)
-        inflight.append((d, ys))
+        inflight.append((d, ys, t_sub))
         max_in_flight = max(max_in_flight, len(inflight))
+        occ_h.record(len(inflight))
         if host_work is not None:
-            host_work(d)  # overlaps the rounds still executing on device
+            with tracer.span("host_work", dispatch=d):
+                host_work(d)  # overlaps the rounds still executing on device
         while len(inflight) > depth:
             retire()
             retires_before_drain += 1

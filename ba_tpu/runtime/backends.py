@@ -18,8 +18,10 @@ RPC call, ba.py:44-49, 268-273 — unseeded; we make it reproducible).
 
 from __future__ import annotations
 
+import itertools
 import random
 
+from ba_tpu import obs
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
 
 
@@ -75,6 +77,9 @@ class PyBackend:
         return majorities
 
 
+_INSTANCE_IDS = itertools.count()
+
+
 class JaxBackend:
     """The batched TPU core behind a B=1 interactive facade.
 
@@ -108,6 +113,10 @@ class JaxBackend:
         if signed and protocol != "sm":
             raise ValueError("signed=True requires protocol='sm'")
         self._jax = jax
+        # Monotonic instance tag for compile-vs-dispatch classification
+        # (id() could be recycled after GC and misclassify a fresh
+        # instance's first compile as a cached dispatch).
+        self._obs_instance = next(_INSTANCE_IDS)
         self.m = m
         self.protocol = protocol
         self.signed = signed
@@ -224,9 +233,33 @@ class JaxBackend:
         n = len(generals)
         state = self._make_state(generals, leader_idx, order_code)
         if self.signed:
-            maj = self._run_signed(state, seed)
+            # Not compile/dispatch-classified: the signed round
+            # synchronously host-signs and verifies between two device
+            # programs, so its wall time is NOT dispatch latency — the
+            # sign/verify internals carry their own host_sign /
+            # device_sign_dispatch spans (crypto/signed.py).
+            with obs.span("signed_round", n=n, m=self.m):
+                maj = self._run_signed(state, seed)
         else:
-            maj = self._fn()(jr.key(seed), state)
+            # First call at a fresh roster capacity pays trace + compile
+            # (or a persistent-cache load, BA_TPU_COMPILE_CACHE); later
+            # calls are cached dispatches — obs.compile_or_dispatch_span
+            # names the span and feeds first-call latency into
+            # compile_time_s.  The instance tag rides the key because
+            # the jit cache is per-instance (self._compiled): a second
+            # backend at equal statics re-pays the compile and must
+            # re-classify.
+            ckey = (
+                "jax_backend_step",
+                self._obs_instance,
+                self.protocol,
+                self.m,
+                self._capacity(n),
+            )
+            with obs.compile_or_dispatch_span(
+                ckey, n=n, protocol=self.protocol
+            ):
+                maj = self._fn()(jr.key(seed), state)
         # ONE host fetch for the whole row: int(v) per element costs a
         # ~50-100 ms tunnel round-trip per general (measured r3: the REPL
         # round dropped ~4x when this loop stopped fetching elementwise).

@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import time
 
+from ba_tpu import obs
 from ba_tpu.core.quorum import quorum_threshold_py
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED, COMMAND_NAMES, command_from_name
 from ba_tpu.utils import metrics
@@ -92,6 +93,12 @@ class Cluster:
         if g is None or not g.alive:
             return False
         g.alive = False
+        was_leader = gid == self.leader_id
+        # Failover transition marker: an instant span + counter, NOT a
+        # metrics.emit — the JSONL stream stays one-record-per-round so
+        # existing consumers' line counts hold.
+        obs.instant("failover_kill", gid=gid, was_leader=was_leader)
+        obs.default_registry().counter("failover_kills_total").inc()
         self.generals = [x for x in self.generals if x.alive]
         self.tick()
         return True
@@ -118,12 +125,17 @@ class Cluster:
         transition is a lookup.  Election is for life (ba.py:124-125): a
         living leader is never displaced.
         """
+        prev = self.leader_id
         alive = [g for g in self.generals if g.alive]
         if not alive:
             self.leader_id = None
-            return
-        if self.leader_id is None or self.find(self.leader_id) is None:
+        elif self.leader_id is None or self.find(self.leader_id) is None:
             self.leader_id = min(g.id for g in alive)
+        if self.leader_id != prev and self.leader_id is not None:
+            # Count ELECTIONS only: a cluster draining to leaderless is a
+            # transition but nobody was elected.
+            obs.instant("election", leader_id=self.leader_id, prev=prev)
+            obs.default_registry().counter("elections_total").inc()
 
     @property
     def leader(self):
@@ -153,11 +165,14 @@ class Cluster:
         leader_idx = next(
             i for i, g in enumerate(self.generals) if g.id == self.leader_id
         )
-        t0 = time.perf_counter()
-        majorities = self.backend.run_round(
-            self.generals, leader_idx, order_code, self._round_seed()
-        )
-        round_elapsed = time.perf_counter() - t0
+        with obs.timed_span(
+            "agreement_round", "round_wall_s",
+            round=self._round, n=len(self.generals),
+        ) as timed:
+            majorities = self.backend.run_round(
+                self.generals, leader_idx, order_code, self._round_seed()
+            )
+        round_elapsed = timed.elapsed_s
         round_idx = self._round
         self._round += 1
 
@@ -234,14 +249,17 @@ class Cluster:
                     }
                 )
 
-            pipelined = run_rounds(
-                self.generals,
-                leader_idx,
-                order_code,
-                self._round_seed(),
-                rounds,
-                host_work=host_work,
-            )
+            with obs.span(
+                "agreement_rounds", rounds=rounds, n=len(self.generals)
+            ):
+                pipelined = run_rounds(
+                    self.generals,
+                    leader_idx,
+                    order_code,
+                    self._round_seed(),
+                    rounds,
+                    host_work=host_work,
+                )
         if pipelined is None:
             res = None
             counts = {"attack": 0, "retreat": 0, "undefined": 0}

@@ -14,10 +14,16 @@ Command surface and exact output formats follow SURVEY.md section 3.1
 - ``List`` — ``P{id}, {True|False}`` primary flags (ba.py:439-445).
 - ``Exit`` — leave the loop (ba.py:373-374).
 
-Framework extension: ``run-rounds <cmd> <R>`` — R agreement rounds in one
-pipelined device run (the last round's block in ``actual-order`` format,
-plus a ``Rounds: ...`` decision tally).  No reference analogue; the six
-reference commands stay byte-identical.
+Framework extensions (no reference analogue; the six reference commands
+stay byte-identical):
+
+- ``run-rounds <cmd> <R>`` — R agreement rounds in one pipelined device
+  run (the last round's block in ``actual-order`` format, plus a
+  ``Rounds: ...`` decision tally).
+- ``stats`` — dump the observability registry (``ba_tpu.obs``) as
+  Prometheus-style text: round wall-time histogram, pipeline dispatch /
+  retire latencies and depth occupancy, election and failover counters.
+  Prints nothing before the first instrumented operation.
 
 Divergences (all guarded crashes in the reference, documented in SURVEY.md
 section 3.3): unknown ids and an empty cluster are ignored instead of
@@ -31,6 +37,7 @@ votes from the next ``actual-order`` on.
 
 from __future__ import annotations
 
+from ba_tpu import obs
 from ba_tpu.runtime.cluster import Cluster
 
 
@@ -60,6 +67,11 @@ def quorum_line(res) -> str:
 def handle_command(cluster: Cluster, line: str, out) -> bool:
     """Dispatch one REPL line.  Returns False when the loop should stop."""
     cmd = line.split(" ")
+    with obs.span("repl_command", command=cmd[0]):
+        return _dispatch(cluster, cmd, out)
+
+
+def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
     command = cmd[0]
 
     if command == "Exit":
@@ -139,6 +151,13 @@ def handle_command(cluster: Cluster, line: str, out) -> bool:
     elif command == "List":
         for g in cluster.generals:
             out(f"P{g.id}, {g.id == cluster.leader_id}")
+
+    elif command == "stats":
+        # Framework extension (additive, like run-rounds): the obs
+        # registry as Prometheus-style text exposition.  Empty registry
+        # prints nothing — the reference command surface is untouched.
+        for ln in obs.default_registry().prometheus_text().splitlines():
+            out(ln)
 
     return True
 

@@ -1,4 +1,5 @@
-"""Structured metrics: JSON-lines observability for agreement rounds.
+"""Structured metrics: versioned JSON-lines observability for agreement
+rounds — the bottom layer of ``ba_tpu.obs``.
 
 The reference's only observability is bare ``print()`` to stdout with
 exceptions swallowed (/root/reference/ba.py:255,389; SURVEY.md section 6
@@ -7,20 +8,43 @@ can emit one machine-readable JSON line — decision, vote counts, quorum
 threshold, fault count, wall time — without touching the REPL's
 byte-identical stdout contract (metrics go to a file or stderr).
 
+Schema contract: every record carries ``"v": 1`` (the JSONL schema
+version — consumers gate on it; ``scripts/ci.sh`` checks every emitted
+line parses and carries ``event`` + ``v``) and a ``ts`` wall-clock
+timestamp.  ``ts`` is for correlation across processes ONLY: durations
+are never derived from it — every ``*_s``/``*elapsed*`` field is
+measured with ``time.perf_counter`` (monotonic) at its call site, and
+span timing (``obs.trace``) uses ``perf_counter_ns``.
+
 Enable with ``BA_TPU_METRICS=<path>`` (append) or ``BA_TPU_METRICS=-``
-(stderr); disabled (zero overhead beyond one dict build) otherwise.
-Device-side sweeps keep their metrics as tensors (``failover_sweep`` /
-``sharded_sweep`` return per-round decision histograms); this sink is the
-host-side shell's counterpart.  ``bench.py --profile DIR`` adds the
-jax.profiler trace for kernel-level timing.
+(stderr); disabled (zero overhead beyond one dict build, zero file
+writes) otherwise.  The file handle opens lazily on first emit, is held
+for the sink's lifetime (the first cut reopened the file on EVERY
+record — an open/close syscall pair per line, which the pipelined
+engine's ``host_work`` lane paid per dispatch), flushes per line so
+tail-readers and crashes lose nothing, and closes atexit.  Emission is
+thread-safe: the pipelined driver's host lane and the main thread may
+interleave emits.
+
+Aggregation (counters/histograms) lives one layer up in
+``obs.registry``, which snapshots into this sink as
+``{"event": "metrics_snapshot", "v": 1, ...}`` records; device-side
+sweeps keep their metrics as tensors (``failover_sweep`` /
+``sharded_sweep`` return per-round decision histograms).  ``bench.py
+--profile DIR`` adds the jax.profiler device trace and ``--obs DIR`` the
+host span trace (``obs.trace``) for timeline-level timing.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import sys
+import threading
 import time
+
+SCHEMA_VERSION = 1
 
 
 class MetricsSink:
@@ -30,6 +54,9 @@ class MetricsSink:
         self.target = (
             target if target is not None else os.environ.get("BA_TPU_METRICS")
         )
+        self._fh = None
+        self._lock = threading.Lock()
+        self._atexit_registered = False
 
     @property
     def enabled(self) -> bool:
@@ -38,13 +65,75 @@ class MetricsSink:
     def emit(self, record: dict) -> None:
         if not self.target:
             return
+        record.setdefault("v", SCHEMA_VERSION)
         record.setdefault("ts", round(time.time(), 3))
         line = json.dumps(record)
-        if self.target == "-":
-            print(line, file=sys.stderr, flush=True)
-        else:
-            with open(self.target, "a") as fh:
-                fh.write(line + "\n")
+        # Telemetry must never kill the agreement path: ANY OSError —
+        # failed open, ENOSPC mid-write, EPIPE on a closed stderr —
+        # warns once, disables the sink, and lets the protocol continue.
+        # (The reference's sin was the inverse, swallowing everything
+        # silently, so the single warning is loud.)
+        with self._lock:
+            if not self.target:  # _disable() raced us; re-check held
+                return
+            if self._fh is None:
+                if self.target == "-":
+                    self._fh = sys.stderr  # borrowed: close() skips it
+                else:
+                    try:
+                        parent = os.path.dirname(self.target)
+                        if parent:
+                            os.makedirs(parent, exist_ok=True)
+                        self._fh = open(self.target, "a")
+                    except OSError as e:
+                        self._disable(e)
+                        return
+                    if not self._atexit_registered:
+                        atexit.register(self.close)
+                        self._atexit_registered = True
+            try:
+                # One write call per record (line + newline together):
+                # concurrent emitters must not interleave mid-line.
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except OSError as e:
+                self._disable(e)
+
+    def _owns_fh(self) -> bool:
+        return self._fh is not None and self.target != "-"
+
+    def _disable(self, err: OSError) -> None:
+        """Warn once and turn the sink off (called under ``_lock``)."""
+        owned = self._owns_fh()
+        target, self.target = self.target, None
+        if self._fh is not None:
+            if owned:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+            self._fh = None
+        try:
+            print(
+                f"ba_tpu.utils.metrics: sink {target!r} failed ({err}); "
+                f"metrics disabled",
+                file=sys.stderr,
+            )
+        except OSError:  # stderr itself is gone — nothing left to say
+            pass
+
+    def close(self) -> None:
+        """Close the held handle (idempotent; emit lazily reopens).
+
+        The ``-`` target's handle is BORROWED stderr — dropped from the
+        sink but never actually closed."""
+        with self._lock:
+            if self._owns_fh():
+                try:
+                    self._fh.close()
+                except OSError:  # pragma: no cover - target fs went away
+                    pass
+            self._fh = None
 
 
 _default: MetricsSink | None = None
@@ -55,6 +144,19 @@ def default_sink() -> MetricsSink:
     global _default
     if _default is None:
         _default = MetricsSink()
+    return _default
+
+
+def configure(target: str | None) -> MetricsSink:
+    """Point the process-wide sink at ``target`` (closing any old handle).
+
+    The programmatic counterpart of ``BA_TPU_METRICS`` — ``bench.py
+    --obs DIR`` routes the sink to ``DIR/metrics.jsonl`` with this.
+    """
+    global _default
+    if _default is not None:
+        _default.close()
+    _default = MetricsSink(target)
     return _default
 
 

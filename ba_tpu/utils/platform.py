@@ -55,8 +55,14 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
     programs the REPL re-pays most often).  Returns the cache dir in use,
     or None when disabled or unsupported by the installed jax.
     """
+    # Deferred import: obs is jax-free, but platform must stay importable
+    # before ba_tpu.utils finishes initializing (utils/__init__ imports
+    # this module first).
+    from ba_tpu.obs.instrument import report_compile_cache
+
     env = os.environ.get("BA_TPU_COMPILE_CACHE", "")
     if env == "0":
+        report_compile_cache(None)
         return None
     if env not in ("", "1"):
         path = env
@@ -70,6 +76,7 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
     except (AttributeError, OSError):
+        report_compile_cache(None)
         return None  # jax without the cache, or unwritable cache dir
     # Threshold knobs are best-effort AFTER the dir is live: a jax that has
     # the cache but not a threshold knob keeps its default gate (some small
@@ -83,6 +90,10 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
             jax.config.update(knob, val)
         except AttributeError:
             pass
+    # Observable cache state: gauge compile_cache_enabled + an instant
+    # trace marker, so first-call "compile" spans (obs.instrument) can be
+    # read as cache loads vs real compiles.
+    report_compile_cache(path)
     return path
 
 

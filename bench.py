@@ -1386,6 +1386,30 @@ def bench_verify_stages(jax, jnp, jr):
     return results
 
 
+_obs_finalized = False
+
+
+def _obs_finalize(obs_dir: str, platform: str) -> None:
+    """Flush the obs layer into DIR: one metrics_snapshot JSONL record
+    (depth occupancy, dispatch/retire latency and compile-time histogram
+    buckets, counters), the Chrome trace, and Prometheus text.
+
+    Idempotent: also registered atexit by --obs setup, so a crashed or
+    Ctrl-C'd run still gets its partial trace/snapshot — the run you
+    most want the artifacts for."""
+    global _obs_finalized
+    if _obs_finalized:
+        return
+    _obs_finalized = True
+    from ba_tpu import obs
+
+    reg = obs.default_registry()
+    reg.emit_snapshot(platform=platform)
+    obs.default_tracer().export_chrome(os.path.join(obs_dir, "trace.json"))
+    with open(os.path.join(obs_dir, "metrics.prom"), "w") as f:
+        f.write(reg.prometheus_text())
+
+
 CONFIGS = {
     # Latency-sensitive configs first: dispatch through the TPU tunnel gets
     # noticeably slower once the big Ed25519-verify programs have run
@@ -1409,6 +1433,16 @@ def main() -> None:
                              "local backends, e.g. BA_TPU_BENCH_PLATFORM=cpu "
                              "or directly-attached TPU; the shared TPU-tunnel "
                              "backend does not serve the profiler and hangs)")
+    parser.add_argument("--obs", metavar="DIR", default=None,
+                        help="write HOST observability artifacts to DIR "
+                             "(ba_tpu.obs): trace.json — Chrome trace-event "
+                             "spans (compile/dispatch/retire/host_work, "
+                             "Perfetto-loadable), metrics.jsonl — the JSONL "
+                             "sink incl. the final metrics_snapshot record, "
+                             "metrics.prom — Prometheus text exposition.  "
+                             "Orthogonal to --profile (device kernels) and "
+                             "safe on every backend; render with "
+                             "scripts/obs_report.py DIR")
     parser.add_argument("--configs", default=os.environ.get(
         "BA_TPU_BENCH_CONFIGS", ",".join(CONFIGS)),
         help="comma-separated subset of: " + ",".join(CONFIGS))
@@ -1423,6 +1457,32 @@ def main() -> None:
 
     if platform:
         jax.config.update("jax_platforms", platform)
+    if args.obs:
+        # Force-enable the host tracer + route the JSONL sink into the
+        # obs dir BEFORE any jit compiles, so first-call "compile" spans
+        # land in the trace; artifacts are finalized by _obs_finalize.
+        os.makedirs(args.obs, exist_ok=True)
+        from ba_tpu import obs as _obs
+        from ba_tpu.utils import metrics as _metrics
+
+        _obs.default_tracer().enabled = True
+        # Crash-safe artifacts: finalize at exit too (idempotent), so an
+        # OOM'd/interrupted campaign still leaves its trace behind.
+        import atexit
+
+        atexit.register(
+            _obs_finalize, args.obs, jax.devices()[0].platform
+        )
+        if os.environ.get("BA_TPU_METRICS"):
+            # --obs owns the artifact dir contract; say so rather than
+            # silently starving a user-configured sink of records.
+            print(
+                f"bench: --obs overrides BA_TPU_METRICS="
+                f"{os.environ['BA_TPU_METRICS']!r} for this run (JSONL -> "
+                f"{os.path.join(args.obs, 'metrics.jsonl')})",
+                file=sys.stderr,
+            )
+        _metrics.configure(os.path.join(args.obs, "metrics.jsonl"))
     # Persistent XLA cache: repeat bench invocations (bench_refresh.sh
     # attempts, A/B scripts) stop re-paying unchanged programs' compiles.
     # Compile time was never inside the timed loops, so cached-vs-fresh
@@ -1444,6 +1504,8 @@ def main() -> None:
             "fieldmul_peak": bench_fieldmul_peak(jax, jnp, jr),
             "stages": bench_verify_stages(jax, jnp, jr),
         }
+        if args.obs:
+            _obs_finalize(args.obs, jax.devices()[0].platform)
         print(json.dumps(line))
         return
 
@@ -1461,6 +1523,8 @@ def main() -> None:
         for name in names:
             print(f"bench: {name} ...", file=sys.stderr, flush=True)
             results[name] = CONFIGS[name](jax, jnp, jr)
+    if args.obs:
+        _obs_finalize(args.obs, jax.devices()[0].platform)
 
     primary_name = "om1_n4" if "om1_n4" in results else next(iter(results))
     primary = results[primary_name]

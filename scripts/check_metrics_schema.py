@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""CI check: every JSONL record the obs layer emits parses and carries
+``event`` + ``v`` (schema version).
+
+Exercises the real emitters end-to-end — interactive rounds through the
+sequential oracle backend (``agreement_round`` records), the pipelined
+fallback path (``agreement_rounds`` decision tallies ride the sequential
+records), and a registry ``metrics_snapshot`` — into a temp sink, then
+validates every line.  Run by ``scripts/ci.sh`` before the tier-1 suite;
+standalone: ``JAX_PLATFORMS=cpu python scripts/check_metrics_schema.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from ba_tpu import obs
+    from ba_tpu.runtime.backends import PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.utils import metrics
+
+    fd, path = tempfile.mkstemp(suffix=".jsonl")
+    os.close(fd)
+    try:
+        sink = metrics.configure(path)
+        cluster = Cluster(4, PyBackend(), seed=0)
+        cluster.set_faulty(2, True)
+        cluster.actual_order("attack")
+        cluster.actual_order_rounds("retreat", 2)  # sequential fallback
+        cluster.kill(1)  # election transition (registry counter, no emit)
+        cluster.actual_order("attack")
+        obs.default_registry().emit_snapshot(sink=sink, source="ci-check")
+        sink.close()
+
+        lines = [l for l in open(path).read().splitlines() if l.strip()]
+        if not lines:
+            print("schema check: no records emitted", file=sys.stderr)
+            return 1
+        bad = 0
+        events = set()
+
+        def _no_const(tok):  # strict JSON: Python json tolerates
+            raise ValueError(f"non-strict JSON constant {tok!r}")  # Infinity/NaN
+
+        for i, line in enumerate(lines):
+            try:
+                rec = json.loads(line, parse_constant=_no_const)
+            except ValueError as e:
+                print(f"schema check: line {i} unparseable: {e}", file=sys.stderr)
+                bad += 1
+                continue
+            if "event" not in rec or rec.get("v") != metrics.SCHEMA_VERSION:
+                print(
+                    f"schema check: line {i} missing event/v: {line[:120]}",
+                    file=sys.stderr,
+                )
+                bad += 1
+            events.add(rec.get("event"))
+        want = {"agreement_round", "metrics_snapshot"}
+        if not want <= events:
+            print(
+                f"schema check: expected events {want - events} missing "
+                f"(got {sorted(map(str, events))})",
+                file=sys.stderr,
+            )
+            bad += 1
+        if bad:
+            return 1
+        print(f"metrics JSONL schema OK ({len(lines)} records, v=1)")
+        return 0
+    finally:
+        os.unlink(path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Render a ba_tpu obs artifact pair into a human summary.
+
+Usage:
+    python scripts/obs_report.py DIR                 # bench.py --obs DIR
+    python scripts/obs_report.py --trace trace.json --metrics metrics.jsonl
+
+Reads the Chrome trace-event JSON written by ``obs.trace`` (span
+durations grouped by name) and/or the JSONL sink stream (event counts
+plus the last ``metrics_snapshot``'s counters, gauges, and histogram
+buckets) and prints aligned tables — the zero-dependency way to answer
+"where did the time go" without opening Perfetto.
+
+Stdlib only; never imports jax or ba_tpu (it must run anywhere the
+artifacts were copied to).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _fmt_s(seconds: float) -> str:
+    if seconds == float("inf"):
+        return "+Inf"
+    if seconds >= 1.0:
+        return f"{seconds:.3f} s"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:.2f} ms"
+    return f"{seconds * 1e6:.1f} us"
+
+
+def report_trace(path: str) -> None:
+    with open(path) as fh:
+        doc = json.load(fh)
+    events = doc if isinstance(doc, list) else doc.get("traceEvents", [])
+    spans: dict = {}
+    instants: dict = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            spans.setdefault(ev["name"], []).append(ev.get("dur", 0.0))
+        elif ev.get("ph") == "i":
+            instants[ev["name"]] = instants.get(ev["name"], 0) + 1
+    print(f"== spans ({path}) ==")
+    if not spans:
+        print("  (no spans recorded — was BA_TPU_TRACE/--obs enabled?)")
+    else:
+        header = f"  {'name':<24} {'count':>6} {'total':>12} {'mean':>12} {'max':>12}"
+        print(header)
+        by_total = sorted(
+            spans.items(), key=lambda kv: sum(kv[1]), reverse=True
+        )
+        for name, durs_us in by_total:
+            total = sum(durs_us) / 1e6  # trace-event ts/dur are microseconds
+            print(
+                f"  {name:<24} {len(durs_us):>6} {_fmt_s(total):>12} "
+                f"{_fmt_s(total / len(durs_us)):>12} "
+                f"{_fmt_s(max(durs_us) / 1e6):>12}"
+            )
+    if instants:
+        print("== markers ==")
+        for name, c in sorted(instants.items()):
+            print(f"  {name:<24} {c:>6}")
+
+
+def _hist_quantile(buckets: list, count: int, q: float) -> float | None:
+    """Approximate quantile: the upper edge of the bucket where the
+    cumulative count crosses q*count (None for an empty histogram).
+    The overflow edge is serialized as the string "+Inf"."""
+    if not count:
+        return None
+    need = q * count
+    cum = 0
+    for le, c in buckets:
+        cum += c
+        if cum >= need:
+            return float("inf") if le == "+Inf" else le
+    return None
+
+
+def report_metrics(path: str) -> None:
+    events: dict = {}
+    snapshot = None
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            events[rec.get("event", "?")] = events.get(rec.get("event", "?"), 0) + 1
+            if rec.get("event") == "metrics_snapshot":
+                snapshot = rec
+    print(f"== JSONL events ({path}) ==")
+    for name, c in sorted(events.items()):
+        print(f"  {name:<32} {c:>6}")
+    if snapshot is None:
+        print("  (no metrics_snapshot record)")
+        return
+    metrics = snapshot.get("metrics", {})
+    scalars = {
+        k: v for k, v in metrics.items() if v["type"] in ("counter", "gauge")
+    }
+    if scalars:
+        print("== counters / gauges ==")
+        for name, v in sorted(scalars.items()):
+            print(f"  {name:<32} {v['value']:>12}")
+    hists = {k: v for k, v in metrics.items() if v["type"] == "histogram"}
+    if hists:
+        print("== histograms ==")
+        print(
+            f"  {'name':<32} {'count':>6} {'mean':>12} {'p50<=':>12} "
+            f"{'p90<=':>12} {'max':>12}"
+        )
+        for name, h in sorted(hists.items()):
+            count = h["count"]
+            mean = h["sum"] / count if count else 0.0
+            p50 = _hist_quantile(h["buckets"], count, 0.5)
+            p90 = _hist_quantile(h["buckets"], count, 0.9)
+            time_like = name.endswith("_s")
+            fmt = _fmt_s if time_like else (lambda x: f"{x:g}")
+            print(
+                f"  {name:<32} {count:>6} "
+                f"{fmt(mean) if count else '-':>12} "
+                f"{fmt(p50) if p50 is not None else '-':>12} "
+                f"{fmt(p90) if p90 is not None else '-':>12} "
+                f"{fmt(h['max']) if h['max'] is not None else '-':>12}"
+            )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dir", nargs="?", help="bench.py --obs output directory")
+    ap.add_argument("--trace", help="Chrome trace-event JSON path")
+    ap.add_argument("--metrics", help="metrics JSONL path")
+    args = ap.parse_args()
+    trace, metrics = args.trace, args.metrics
+    if args.dir:
+        trace = trace or os.path.join(args.dir, "trace.json")
+        metrics = metrics or os.path.join(args.dir, "metrics.jsonl")
+    if not trace and not metrics:
+        ap.error("give DIR or --trace/--metrics")
+    found = False
+    for path, render in ((trace, report_trace), (metrics, report_metrics)):
+        if path and os.path.exists(path):
+            render(path)
+            found = True
+        elif path:
+            print(f"(missing: {path})", file=sys.stderr)
+    return 0 if found else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

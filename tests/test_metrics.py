@@ -55,3 +55,88 @@ def test_sink_env_configuration(tmp_path, monkeypatch):
     assert sink.enabled
     sink.emit({"event": "x"})
     assert json.loads(path.read_text())["event"] == "x"
+
+
+def test_every_record_carries_schema_version(tmp_path, monkeypatch):
+    # ISSUE 2 satellite: the sink stamps "v": 1 on every record (callers
+    # never spell it), alongside the wall-clock ts.  ts is correlation
+    # only — every duration field is measured with perf_counter at its
+    # call site, never derived from ts.
+    path = tmp_path / "v.jsonl"
+    _with_sink(monkeypatch, str(path))
+    cluster = Cluster(3, PyBackend(), seed=2)
+    cluster.actual_order("attack")
+    metrics.emit({"event": "custom"})
+    for line in path.read_text().splitlines():
+        rec = json.loads(line)
+        assert rec["v"] == metrics.SCHEMA_VERSION == 1
+        assert "event" in rec and "ts" in rec
+
+
+def test_sink_holds_one_handle(tmp_path, monkeypatch):
+    # ISSUE 2 satellite: the first cut reopened the target on EVERY
+    # emit; the sink now opens once (lazily), flushes per line, and
+    # closes idempotently.
+    path = tmp_path / "one.jsonl"
+    sink = metrics.MetricsSink(str(path))
+    opens = []
+    real_open = open
+
+    def counting_open(*a, **k):
+        opens.append(a[0])
+        return real_open(*a, **k)
+
+    monkeypatch.setattr("builtins.open", counting_open)
+    for i in range(5):
+        sink.emit({"event": "n", "i": i})
+    assert opens == [str(path)]  # one open across five emits
+    # Flushed per line: readable before close, no buffering loss.
+    assert len(path.read_text().splitlines()) == 5
+    sink.close()
+    sink.close()  # idempotent
+    # emit after close lazily reopens (atexit-then-straggler safety).
+    sink.emit({"event": "late"})
+    sink.close()
+    assert len(path.read_text().splitlines()) == 6
+
+
+def test_sink_creates_parent_dir_and_survives_bad_target(tmp_path, capsys):
+    # A sink path in a not-yet-existing directory is created lazily (the
+    # common BA_TPU_METRICS=artifacts/run1/m.jsonl case)...
+    path = tmp_path / "new" / "dir" / "m.jsonl"
+    sink = metrics.MetricsSink(str(path))
+    sink.emit({"event": "a"})
+    sink.close()
+    assert json.loads(path.read_text())["event"] == "a"
+    # ...and a genuinely unwritable target warns ONCE and disables the
+    # sink instead of crashing the agreement path (telemetry must never
+    # kill the protocol; the reference's sin was silent swallowing, so
+    # the warning is loud).
+    bad = metrics.MetricsSink(str(tmp_path / "m.jsonl" / "x.jsonl"))
+    (tmp_path / "m.jsonl").write_text("a file, not a dir")
+    bad.emit({"event": "b"})
+    assert not bad.enabled  # disabled after the failed open
+    bad.emit({"event": "c"})  # silent no-op now
+    err = capsys.readouterr().err
+    assert err.count("metrics disabled") == 1
+
+
+def test_sink_stderr_target(capsys):
+    sink = metrics.MetricsSink("-")
+    sink.emit({"event": "e"})
+    err = capsys.readouterr().err
+    rec = json.loads(err.strip())
+    assert rec["event"] == "e" and rec["v"] == 1
+
+
+def test_configure_replaces_default(tmp_path):
+    old = metrics._default
+    try:
+        sink = metrics.configure(str(tmp_path / "c.jsonl"))
+        assert metrics.default_sink() is sink
+        metrics.emit({"event": "via_default"})
+        sink.close()
+        rec = json.loads((tmp_path / "c.jsonl").read_text())
+        assert rec["event"] == "via_default"
+    finally:
+        metrics._default = old

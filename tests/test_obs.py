@@ -1,0 +1,380 @@
+"""Observability layer tests (ISSUE 2 tentpole, ba_tpu/obs/).
+
+Contracts pinned here:
+
+1. **Disabled = free**: with BA_TPU_METRICS/BA_TPU_TRACE unset, spans
+   record nothing (no buffer growth) and no file is ever written — the
+   overhead-guard the hot paths rely on.
+2. **Tracer**: spans/instants land in the ring buffer with monotonic
+   timestamps, the Chrome export validates against the trace-event
+   schema (``ph``, ``ts``, ``dur``, ``pid``, ``tid``), and the ring
+   capacity bounds memory.
+3. **Registry**: typed counters/gauges/log-bucketed histograms snapshot
+   to a versioned ``metrics_snapshot`` JSONL record and dump Prometheus
+   text with cumulative buckets.
+4. **Thread safety**: sink + tracer survive concurrent emission (the
+   pipelined driver's host_work lane vs. the main thread).
+5. **Pipeline wiring**: a pipeline_sweep run with instrumentation on
+   produces compile/dispatch/retire spans and occupancy/latency
+   histograms — and `bench.py --obs DIR` pins the end-to-end acceptance
+   artifact pair on the CPU backend.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ba_tpu import obs
+from ba_tpu.obs.registry import MetricsRegistry
+from ba_tpu.obs.trace import Tracer
+from ba_tpu.utils import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fresh(monkeypatch, *, trace_enabled):
+    """Swap in a fresh default tracer + registry (and return them)."""
+    tracer = Tracer(enabled=trace_enabled)
+    reg = MetricsRegistry()
+    monkeypatch.setattr(obs.trace, "_default", tracer)
+    monkeypatch.setattr(obs.registry, "_default", reg)
+    return tracer, reg
+
+
+# -- 1. disabled path ---------------------------------------------------------
+
+
+def test_disabled_tracer_records_nothing(monkeypatch):
+    monkeypatch.delenv("BA_TPU_TRACE", raising=False)
+    tracer = Tracer()
+    assert not tracer.enabled
+    with tracer.span("x", a=1):
+        pass
+    tracer.instant("y")
+    assert len(tracer) == 0
+
+
+def test_env_zero_disables_tracer(monkeypatch):
+    monkeypatch.setenv("BA_TPU_TRACE", "0")
+    assert not Tracer().enabled
+
+
+def test_disabled_obs_zero_writes_and_growth(monkeypatch, tmp_path):
+    # The overhead guard: a full pipelined run with every obs env var
+    # unset must write no files and grow no span buffer.
+    import jax.random as jr
+
+    from ba_tpu.parallel import make_sweep_state, pipeline_sweep
+
+    monkeypatch.delenv("BA_TPU_TRACE", raising=False)
+    monkeypatch.delenv("BA_TPU_METRICS", raising=False)
+    tracer, reg = _fresh(monkeypatch, trace_enabled=False)
+    monkeypatch.setattr(metrics, "_default", metrics.MetricsSink())
+    monkeypatch.chdir(tmp_path)
+    state = make_sweep_state(jr.key(41), 8, 8)
+    out = pipeline_sweep(jr.key(42), state, 4, depth=2, host_work=lambda d: None)
+    assert out["stats"]["dispatches"] == 4
+    assert len(tracer) == 0  # no span-buffer growth
+    assert not metrics.default_sink().enabled
+    assert list(tmp_path.iterdir()) == []  # zero file writes
+    # emit_snapshot with a disabled sink builds the dict but writes nothing.
+    rec = reg.emit_snapshot()
+    assert rec["event"] == "metrics_snapshot" and rec["v"] == 1
+    assert list(tmp_path.iterdir()) == []
+
+
+# -- 2. tracer ----------------------------------------------------------------
+
+
+def test_span_records_and_chrome_schema(tmp_path):
+    tracer = Tracer(enabled=True)
+    with tracer.span("outer", kind="test"):
+        with tracer.span("inner"):
+            pass
+    tracer.instant("marker", gid=3)
+    assert len(tracer) == 3
+    path = tmp_path / "trace.json"
+    tracer.export_chrome(str(path))
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    assert len(events) == 3
+    complete = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(complete) == 2 and len(instants) == 1
+    for ev in complete:
+        # Trace-event schema: name, ph, ts (us), dur (us), pid, tid.
+        assert isinstance(ev["ts"], float) and ev["ts"] > 0
+        assert isinstance(ev["dur"], float) and ev["dur"] >= 0
+        assert ev["pid"] == os.getpid()
+        assert isinstance(ev["tid"], int)
+        assert ev["name"] in ("outer", "inner")
+    # inner nests within outer on the monotonic timeline.
+    outer = next(e for e in complete if e["name"] == "outer")
+    inner = next(e for e in complete if e["name"] == "inner")
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"kind": "test"}
+    assert instants[0]["args"] == {"gid": 3}
+
+
+def test_ring_buffer_caps_memory():
+    tracer = Tracer(capacity=4, enabled=True)
+    for i in range(10):
+        with tracer.span("s", i=i):
+            pass
+    assert len(tracer) == 4
+    names = [e["args"]["i"] for e in tracer.chrome_events()]
+    assert names == [6, 7, 8, 9]  # oldest dropped first
+
+
+def test_span_survives_exceptions():
+    tracer = Tracer(enabled=True)
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("x")
+    assert len(tracer) == 1  # the span still closed and recorded
+
+
+# -- 3. registry --------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(4)
+    reg.gauge("g").set(2.5)
+    h = reg.histogram("lat_s")
+    for v in (1e-7, 3e-6, 3e-6, 0.5):
+        h.record(v)
+    snap = reg.snapshot()
+    assert snap["c"] == {"type": "counter", "value": 5}
+    assert snap["g"] == {"type": "gauge", "value": 2.5}
+    hs = snap["lat_s"]
+    assert hs["count"] == 4 and hs["min"] == 1e-7 and hs["max"] == 0.5
+    assert math.isclose(hs["sum"], 1e-7 + 6e-6 + 0.5)
+    assert sum(c for _, c in hs["buckets"]) == 4
+    # Log-bucket shape: every value is <= its bucket's upper edge and
+    # (except bucket 0) > the previous edge.
+    for le, _ in hs["buckets"]:
+        assert le > 0
+
+
+def test_histogram_bucket_edges_and_overflow():
+    reg = MetricsRegistry()
+    h = reg.histogram("occ", base=1.0, factor=2.0, n_buckets=3)
+    for v in (1, 2, 3, 100):  # edges: 1, 2, 4; 100 -> +Inf overflow
+        h.record(v)
+    snap = h.snapshot()
+    buckets = dict((le, c) for le, c in snap["buckets"])
+    assert buckets[1.0] == 1
+    assert buckets[2.0] == 1
+    assert buckets[4.0] == 1
+    # The overflow edge serializes as the STRING "+Inf" so the snapshot
+    # stays strict JSON (a float('inf') would dump as bare `Infinity`).
+    assert buckets["+Inf"] == 1
+    json.loads(json.dumps(snap, allow_nan=False))  # strict round-trip
+
+
+def test_registry_type_clash_raises():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+    with pytest.raises(TypeError):
+        reg.histogram("x")
+
+
+def test_prometheus_text_cumulative_buckets():
+    reg = MetricsRegistry()
+    reg.counter("events_total").inc(3)
+    h = reg.histogram("lat_s", base=1e-3, factor=2.0, n_buckets=4)
+    h.record(0.0005)
+    h.record(0.003)
+    text = reg.prometheus_text()
+    assert "# TYPE events_total counter\nevents_total 3" in text
+    assert "# TYPE lat_s histogram" in text
+    assert 'lat_s_bucket{le="0.001"} 1' in text
+    assert 'lat_s_bucket{le="0.004"} 2' in text
+    assert 'lat_s_bucket{le="+Inf"} 2' in text
+    assert "lat_s_count 2" in text
+
+
+def test_emit_snapshot_versioned_record(tmp_path):
+    sink = metrics.MetricsSink(str(tmp_path / "m.jsonl"))
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.emit_snapshot(sink=sink, platform="cpu")
+    sink.close()
+    rec = json.loads((tmp_path / "m.jsonl").read_text())
+    assert rec["event"] == "metrics_snapshot"
+    assert rec["v"] == 1 and rec["platform"] == "cpu"
+    assert rec["metrics"]["c"]["value"] == 1
+
+
+# -- 4. thread safety ---------------------------------------------------------
+
+
+def test_sink_and_tracer_thread_safety(tmp_path):
+    # The pipelined driver's host_work lane can emit/span concurrently
+    # with the main thread: every line must stay intact JSON and every
+    # span must be recorded.
+    sink = metrics.MetricsSink(str(tmp_path / "t.jsonl"))
+    tracer = Tracer(capacity=1 << 16, enabled=True)
+    threads, per = 8, 50
+
+    def work(t):
+        for i in range(per):
+            with tracer.span("w", t=t, i=i):
+                sink.emit({"event": "thread_test", "t": t, "i": i})
+
+    ts = [threading.Thread(target=work, args=(t,)) for t in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    sink.close()
+    lines = (tmp_path / "t.jsonl").read_text().splitlines()
+    assert len(lines) == threads * per
+    for line in lines:
+        rec = json.loads(line)  # interleaved writes would break parsing
+        assert rec["event"] == "thread_test" and rec["v"] == 1
+    assert len(tracer) == threads * per
+    tids = {e["tid"] for e in tracer.chrome_events()}
+    assert len(tids) == threads  # each thread's spans keep its identity
+
+
+# -- 5. pipeline + REPL + bench wiring ---------------------------------------
+
+
+def test_pipeline_emits_spans_and_histograms(monkeypatch):
+    import jax.random as jr
+
+    from ba_tpu.parallel import make_sweep_state, pipeline_sweep
+
+    tracer, reg = _fresh(monkeypatch, trace_enabled=True)
+    obs.reset_first_calls()  # force the first dispatch to classify as compile
+    state = make_sweep_state(jr.key(43), 12, 8)
+    out = pipeline_sweep(
+        jr.key(44), state, 6,
+        depth=2, rounds_per_dispatch=2, host_work=lambda d: None,
+    )
+    assert out["stats"]["dispatches"] == 3
+    names = [e["name"] for e in tracer.chrome_events()]
+    assert names.count("compile") == 1  # one fresh specialization
+    assert names.count("dispatch") == 2  # the cached re-dispatches
+    assert names.count("retire") == 3
+    assert names.count("host_work") == 3
+    snap = reg.snapshot()
+    assert snap["pipeline_dispatches_total"]["value"] == 3
+    assert snap["pipeline_retires_total"]["value"] == 3
+    assert snap["pipeline_dispatch_latency_s"]["count"] == 3
+    assert snap["pipeline_retire_lag_s"]["count"] == 3
+    assert snap["compile_time_s"]["count"] == 1
+    occ = snap["pipeline_depth_occupancy"]
+    assert occ["count"] == 3 and occ["max"] <= 3  # depth+1 momentary cap
+
+
+def test_repl_stats_command_additive(monkeypatch):
+    from ba_tpu.runtime.backends import PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    _fresh(monkeypatch, trace_enabled=False)
+    cluster = Cluster(3, PyBackend(), seed=5)
+    lines = []
+    assert handle_command(cluster, "actual-order attack", lines.append)
+    before = list(lines)
+    assert handle_command(cluster, "stats", lines.append)
+    stats_lines = lines[len(before):]
+    text = "\n".join(stats_lines)
+    assert "# TYPE round_wall_s histogram" in text
+    assert "round_wall_s_count 1" in text
+    assert "# TYPE elections_total counter" in text  # init elected G1
+    # Reference commands' output is untouched by the new command.
+    assert before[0].startswith("G1, primary")
+
+
+def test_cluster_election_failover_counters(monkeypatch):
+    from ba_tpu.runtime.backends import PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    tracer, reg = _fresh(monkeypatch, trace_enabled=True)
+    cluster = Cluster(4, PyBackend(), seed=9)
+    assert cluster.leader_id == 1
+    cluster.kill(1)  # leader dies -> failover + re-election
+    assert cluster.leader_id == 2
+    snap = reg.snapshot()
+    assert snap["elections_total"]["value"] == 2  # init + re-election
+    assert snap["failover_kills_total"]["value"] == 1
+    names = [e["name"] for e in tracer.chrome_events()]
+    assert "election" in names and "failover_kill" in names
+
+
+def test_bench_obs_acceptance_cpu(tmp_path):
+    """The ISSUE 2 acceptance pin: ``bench.py --obs DIR`` on the CPU
+    backend produces (a) a Chrome trace with compile/dispatch/retire
+    spans for a pipeline_sweep run and (b) a metrics_snapshot JSONL
+    record with depth-occupancy and dispatch-latency histogram buckets —
+    and scripts/obs_report.py renders the pair."""
+    obs_dir = tmp_path / "obs"
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "BA_TPU_BENCH_PLATFORM": "cpu",
+            "BA_TPU_COMPILE_CACHE": "0",
+            "BA_TPU_BENCH_PIPE_BATCH": "8",
+            "BA_TPU_BENCH_PIPE_CAP": "8",
+            "BA_TPU_BENCH_PIPE_ROUNDS": "8",
+            "BA_TPU_BENCH_PIPE_KPD": "2",
+            "BA_TPU_BENCH_PIPE_UNROLL": "1",
+            "BA_TPU_BENCH_DETAIL": str(tmp_path / "detail.json"),
+        }
+    )
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--obs", str(obs_dir),
+         "--configs", "pipeline_sweep"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+
+    # (a) the Chrome trace parses and carries the pipeline's span kinds.
+    doc = json.loads((obs_dir / "trace.json").read_text())
+    complete = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in complete}
+    assert {"compile", "dispatch", "retire"} <= names
+    for ev in complete:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(ev)
+
+    # (b) the JSONL stream: every record versioned, snapshot present
+    # with depth-occupancy + dispatch-latency buckets populated.
+    recs = [
+        json.loads(l)
+        for l in (obs_dir / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert recs and all(r["v"] == 1 and "event" in r for r in recs)
+    snaps = [r for r in recs if r["event"] == "metrics_snapshot"]
+    assert len(snaps) == 1
+    m = snaps[0]["metrics"]
+    assert m["pipeline_depth_occupancy"]["count"] > 0
+    assert m["pipeline_depth_occupancy"]["buckets"]
+    assert m["pipeline_dispatch_latency_s"]["count"] > 0
+    assert m["pipeline_dispatch_latency_s"]["buckets"]
+    assert m["compile_time_s"]["count"] > 0
+
+    # Prometheus text exposition rides along.
+    prom = (obs_dir / "metrics.prom").read_text()
+    assert "# TYPE pipeline_dispatch_latency_s histogram" in prom
+
+    # The report renderer digests the pair without ba_tpu on its path.
+    r = subprocess.run(
+        [sys.executable, "scripts/obs_report.py", str(obs_dir)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "dispatch" in r.stdout and "pipeline_dispatch_latency_s" in r.stdout

@@ -154,6 +154,46 @@ def test_depth_k_inflight_no_intermediate_blocking(monkeypatch):
     assert out["stats"]["retires_before_drain"] == R - depth
 
 
+def test_depth_k_no_blocking_with_instrumentation_enabled(monkeypatch):
+    # ISSUE 2 acceptance: the observability layer's only added work is
+    # clock reads + in-memory appends — with tracing AND the registry
+    # live, the engine still never calls block_until_ready and the
+    # dispatch/retire schedule is unchanged (depth dispatches genuinely
+    # in flight before the first retire fetch).
+    from ba_tpu import obs
+    from ba_tpu.obs.registry import MetricsRegistry
+    from ba_tpu.obs.trace import Tracer
+
+    monkeypatch.setattr(obs.trace, "_default", Tracer(enabled=True))
+    monkeypatch.setattr(obs.registry, "_default", MetricsRegistry())
+
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    B, cap, R, depth = 8, 8, 7, 3
+    state = make_sweep_state(jr.key(55), B, cap)
+    events = []
+    out = pipeline_sweep(
+        jr.key(56), state, R,
+        depth=depth, rounds_per_dispatch=1,
+        on_event=lambda kind, i: events.append((kind, i)),
+    )
+    assert [i for kind, i in events if kind == "dispatch"] == list(range(R))
+    assert [i for kind, i in events if kind == "retire"] == list(range(R))
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [("dispatch", i) for i in range(depth + 1)]
+    assert out["stats"]["max_in_flight"] == depth + 1
+    # And the instrumentation actually observed the run.
+    tracer = obs.default_tracer()
+    names = [e["name"] for e in tracer.chrome_events()]
+    assert names.count("retire") == R
+    assert names.count("compile") + names.count("dispatch") == R
+    snap = obs.default_registry().snapshot()
+    assert snap["pipeline_dispatch_latency_s"]["count"] == R
+    assert snap["pipeline_depth_occupancy"]["count"] == R
+
+
 def test_pipeline_host_work_overlaps_dispatches():
     # host_work runs once per dispatch, after it is queued and before the
     # engine may block on a retire — the metrics-emission overlap hook.
