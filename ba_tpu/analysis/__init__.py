@@ -1,0 +1,63 @@
+"""ba-lint — the AST-based JAX-safety analyzer for this repository.
+
+PRs 1-2 established hard contracts that keep the OM(1)/quorum sweep
+engine fast and correct: no host sync inside the parallel round loops,
+keys derived ON DEVICE from the ``KeySchedule`` counter, donated
+``(state, schedule)`` carries never reused after dispatch, and a
+host-only observability layer (nothing from ``ba_tpu.obs`` inside the
+jitted ``core``/``ops`` trees).  Until this package those contracts were
+enforced by text greps in ``scripts/ci.sh`` — blind to import aliases
+(``import numpy as jnp_like`` sails through a ``\\bnp\\.`` grep), unable
+to tell ``jnp.asarray`` (device-side) from a locally renamed ``numpy``,
+and structurally incapable of expressing the donation or RNG-reuse
+rules.  ba-lint turns each invariant into a machine-checked semantic
+property over real ``ast`` trees and the real import graph.
+
+Zero dependencies beyond the standard library: running the analyzer
+never imports jax (or ba_tpu's runtime modules — ``ba_tpu/__init__.py``
+is import-free by design, and tests pin that ``jax`` stays out of
+``sys.modules``), so it runs on any host in well under the CI budget.
+
+Usage::
+
+    python -m ba_tpu.analysis ba_tpu/ examples/ bench.py
+    python -m ba_tpu.analysis --format json --rules BA101,BA301 path/
+
+Rules (docs/DESIGN.md §9 has the full table and rationale):
+
+====== ========================= =========================================
+code   name                      invariant
+====== ========================= =========================================
+BA101  host-sync-in-hot-path     no ``block_until_ready`` / host-numpy
+                                 conversions / ``.item()``/``.tolist()``
+                                 / ``float()``/``int()`` coercions of
+                                 device values in the parallel round-loop
+                                 modules
+BA102  host-key-split-in-pipeline no ``jax.random.split`` (and no
+                                 ``fold_in`` inside host loops) in
+                                 ``parallel/pipeline.py`` — keys come
+                                 from the on-device ``KeySchedule``
+BA201  use-after-donate          an argument donated to a jitted call is
+                                 never read again before rebinding
+BA202  rng-key-reuse             the same key name is never consumed by
+                                 two sampling calls before rebinding
+                                 (deriving does not decorrelate the
+                                 original key)
+BA301  obs-purity                nothing under ``ba_tpu.core`` or
+                                 ``ba_tpu.ops`` reaches ``ba_tpu.obs`` or
+                                 calls ``metrics.emit`` (direct-import
+                                 closure, alias-resolved)
+BA401  dead-import               unused imports (warning severity;
+                                 ``__all__`` re-exports honored)
+====== ========================= =========================================
+
+Suppressions: append ``# ba-lint: disable=BA101`` (comma-separated
+codes, or ``all``) to the flagged line, or put
+``# ba-lint: disable-file=BAxxx`` on its own line to silence a code for
+the whole file.  Suppressed findings are counted but never fail the run.
+"""
+
+from ba_tpu.analysis.base import Finding, Rule, all_rules
+from ba_tpu.analysis.driver import main, run_paths
+
+__all__ = ["Finding", "Rule", "all_rules", "main", "run_paths"]

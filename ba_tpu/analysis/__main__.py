@@ -1,0 +1,8 @@
+"""``python -m ba_tpu.analysis`` — the ba-lint entry point."""
+
+import sys
+
+from ba_tpu.analysis.driver import main
+
+if __name__ == "__main__":
+    sys.exit(main())

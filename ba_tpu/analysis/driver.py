@@ -1,0 +1,207 @@
+"""ba-lint driver: file discovery, the two-phase run, output, exit code.
+
+``python -m ba_tpu.analysis [paths] [--format human|json] [--rules ...]``
+
+Phase one parses every ``.py`` under the given paths into
+:class:`~ba_tpu.analysis.project.ModuleInfo`; phase two builds the
+:class:`~ba_tpu.analysis.project.Project` (import graph + donation
+registry) and runs every selected rule over every module.  Findings are
+filtered through the per-file suppression index, sorted by location,
+and rendered human-readable or as one JSON object (schema below, which
+``scripts/ci.sh`` validates the way it validates the metrics JSONL).
+
+Exit code: 1 if any unsuppressed ERROR-severity finding (including
+syntax errors, reported as ``BA900``), else 0.  Warnings print and
+count but never fail the run.
+
+JSON schema (version 1)::
+
+    {"version": 1, "tool": "ba-lint", "files_scanned": N,
+     "rules": ["BA101", ...],
+     "findings":   [{"code", "severity", "path", "line", "col",
+                     "message"}, ...],
+     "suppressed": [...same shape...],
+     "counts": {"error": E, "warning": W, "suppressed": S},
+     "exit": 0 | 1}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from ba_tpu.analysis.base import ERROR, Finding, all_rules
+from ba_tpu.analysis.project import ModuleInfo, Project
+
+JSON_SCHEMA_VERSION = 1
+PARSE_ERROR_CODE = "BA900"
+
+_SKIP_DIRS = {"__pycache__", ".git"}
+
+
+def discover(paths) -> list:
+    """``(abs_path, display_path)`` for every ``.py`` under ``paths``."""
+    out = []
+    seen = set()
+
+    def add(p: str) -> None:
+        ap = os.path.abspath(p)
+        if ap in seen:
+            return
+        seen.add(ap)
+        rel = os.path.relpath(ap)
+        out.append((ap, rel if not rel.startswith("..") else ap))
+
+    for path in paths:
+        if os.path.isfile(path):
+            add(path)
+            continue
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no such file or directory: {path}")
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d
+                for d in dirs
+                if d not in _SKIP_DIRS and not d.startswith(".")
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    add(os.path.join(root, f))
+    return sorted(out, key=lambda t: t[1])
+
+
+def run_paths(paths, rule_codes=None):
+    """Analyze ``paths``; returns ``(findings, suppressed, files_scanned)``.
+
+    ``findings``/``suppressed`` are location-sorted :class:`Finding`
+    lists; ``rule_codes`` (e.g. ``{"BA101"}``) restricts the rule set.
+    """
+    rules = [
+        r
+        for r in all_rules()
+        if rule_codes is None or r.code in rule_codes
+    ]
+    modules = []
+    findings = []
+    for ap, disp in discover(paths):
+        with open(ap, encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            modules.append(ModuleInfo.parse(ap, disp, source))
+        except SyntaxError as exc:
+            findings.append(
+                Finding(
+                    code=PARSE_ERROR_CODE,
+                    severity=ERROR,
+                    path=disp,
+                    line=exc.lineno or 1,
+                    col=(exc.offset or 1) - 1,
+                    message=f"syntax error: {exc.msg}",
+                )
+            )
+    project = Project(modules)
+    for mod in modules:
+        for rule in rules:
+            findings.extend(rule.check_module(mod, project))
+
+    by_path = {m.display_path: m for m in modules}
+    active, suppressed = [], []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and mod.suppressions.is_suppressed(
+            f.code, f.line
+        ):
+            suppressed.append(f)
+        else:
+            active.append(f)
+    key = lambda f: (f.path, f.line, f.col, f.code)  # noqa: E731
+    return sorted(active, key=key), sorted(suppressed, key=key), len(
+        modules
+    )
+
+
+def _to_json(active, suppressed, files, rules) -> dict:
+    errors = sum(1 for f in active if f.severity == ERROR)
+    warnings = len(active) - errors
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "ba-lint",
+        "files_scanned": files,
+        "rules": [r.code for r in rules],
+        "findings": [f.to_json() for f in active],
+        "suppressed": [f.to_json() for f in suppressed],
+        "counts": {
+            "error": errors,
+            "warning": warnings,
+            "suppressed": len(suppressed),
+        },
+        "exit": 1 if errors else 0,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ba_tpu.analysis",
+        description=(
+            "ba-lint: AST-based JAX-safety analyzer (host-sync, "
+            "donation, key-linearity, obs-purity; zero deps, never "
+            "imports jax)"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["."],
+        help="files or directories to analyze (default: .)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("human", "json"),
+        default="human",
+        help="output format (json: one schema-versioned object)",
+    )
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule table and exit",
+    )
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.code}  {r.severity:7s}  {r.name}")
+        return 0
+    selected = None
+    if args.rules:
+        selected = {c.strip().upper() for c in args.rules.split(",")}
+        known = {r.code for r in rules}
+        bad = selected - known
+        if bad:
+            parser.error(
+                f"unknown rule code(s): {', '.join(sorted(bad))} "
+                f"(known: {', '.join(sorted(known))})"
+            )
+    try:
+        active, suppressed, files = run_paths(args.paths, selected)
+    except FileNotFoundError as exc:
+        parser.error(str(exc))
+
+    run_rules = [r for r in rules if selected is None or r.code in selected]
+    if args.format == "json":
+        print(json.dumps(_to_json(active, suppressed, files, run_rules)))
+    else:
+        for f in active:
+            print(f.render())
+        errors = sum(1 for f in active if f.severity == ERROR)
+        warnings = len(active) - errors
+        print(
+            f"ba-lint: {errors} error(s), {warnings} warning(s)"
+            f" ({len(suppressed)} suppressed) across {files} file(s)"
+        )
+    return 1 if any(f.severity == ERROR for f in active) else 0

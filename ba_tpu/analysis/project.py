@@ -1,0 +1,271 @@
+"""Whole-run model: parsed modules, the import graph, donation registry.
+
+The analyzer is two-phase.  Phase one parses every file into a
+:class:`ModuleInfo` (tree, alias map, suppression index, import
+records).  Phase two builds the cross-file facts single rules cannot
+see from one tree:
+
+- the **direct-import graph** over the analyzed set, with
+  longest-prefix resolution of ``from X import y`` targets (module or
+  symbol — both land on the defining module), powering BA301's
+  transitive reachability;
+- the **donation registry**: every function the analyzed set jits with
+  ``donate_argnums``/``donate_argnames`` (the
+  ``@functools.partial(jax.jit, donate_argnums=...)`` decorator idiom
+  and the ``g = jax.jit(f, donate_argnums=...)`` rebinding idiom),
+  keyed by qualified name so BA201 checks call sites in *other*
+  modules through their import aliases.
+
+"Direct-import" is a deliberate semantic: the graph follows modules the
+code NAMES (what it could call into), not Python's package-``__init__``
+load side effects — ``from ba_tpu.parallel.mesh import shard_map``
+executes ``ba_tpu/parallel/__init__.py`` at runtime, but gives the
+importer no handle on ``ba_tpu.parallel.pipeline``.  The obs-purity
+contract is about code reachability, and this is also what keeps the
+rule's verdict stable when ``__init__`` re-export lists churn.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from ba_tpu.analysis.resolver import (
+    ImportMap,
+    iter_import_aliases,
+    module_name,
+)
+from ba_tpu.analysis.suppress import SuppressionIndex
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str
+    display_path: str
+    modname: str
+    source: str
+    tree: ast.Module
+    imports: ImportMap
+    suppressions: SuppressionIndex
+    # (ast node, raw dotted target) per imported alias — the node is the
+    # finding anchor for import-graph rules.
+    import_records: list
+
+    @classmethod
+    def parse(cls, path: str, display_path: str, source: str) -> "ModuleInfo":
+        tree = ast.parse(source, filename=display_path)
+        modname = module_name(path)
+        is_package = path.endswith("__init__.py")
+        records = [
+            (node, edge)
+            for node, _local, _binding, edge in iter_import_aliases(
+                tree, modname, is_package
+            )
+        ]
+        return cls(
+            path=path,
+            display_path=display_path,
+            modname=modname,
+            source=source,
+            tree=tree,
+            imports=ImportMap(tree, modname, is_package),
+            suppressions=SuppressionIndex(source),
+            import_records=records,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationSpec:
+    """Donated positions (and param names, for kwarg call sites) of one
+    jitted callable."""
+
+    positions: frozenset
+    param_names: tuple = ()
+
+    def donated_params(self) -> set:
+        named = {
+            self.param_names[i]
+            for i in self.positions
+            if i < len(self.param_names)
+        }
+        return named
+
+
+def _const_positions(node: ast.AST) -> frozenset | None:
+    """``donate_argnums=`` value -> positions, if statically constant."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return frozenset([node.value])
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, int)
+            ):
+                return None
+            vals.append(elt.value)
+        return frozenset(vals)
+    return None
+
+
+def _const_names(node: ast.AST) -> list | None:
+    """``donate_argnames=`` value -> names, if statically constant."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                return None
+            vals.append(elt.value)
+        return vals
+    return None
+
+
+def _jit_donation(call: ast.Call, imports: ImportMap, params: list):
+    """Donated positions from one ``jax.jit(...)``/``partial(jax.jit,
+    ...)`` call, or ``None`` when it donates nothing."""
+    fn = imports.resolve(call.func)
+    inner_args = call.args
+    if fn in ("functools.partial", "partial"):
+        if not call.args:
+            return None
+        if imports.resolve(call.args[0]) not in ("jax.jit", "jax.pjit"):
+            return None
+        inner_args = call.args[1:]
+    elif fn not in ("jax.jit", "jax.pjit"):
+        return None
+    del inner_args  # positional args carry no donation info
+    positions: set = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            got = _const_positions(kw.value)
+            if got:
+                positions |= got
+        elif kw.arg == "donate_argnames":
+            names = _const_names(kw.value)
+            if names:
+                positions |= {
+                    i for i, p in enumerate(params) if p in names
+                }
+    return frozenset(positions) if positions else None
+
+
+def _param_names(fn: ast.AST) -> list:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+class Project:
+    """Everything rules may ask about the analyzed set as a whole."""
+
+    def __init__(self, modules: list):
+        self.modules = {m.modname: m for m in modules}
+        self.donating: dict = {}
+        self._reach_memo: dict = {}
+        for m in modules:
+            self._collect_donations(m)
+
+    # -- donation registry ------------------------------------------------
+
+    def _collect_donations(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = _param_names(node)
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    pos = _jit_donation(dec, mod.imports, params)
+                    if pos:
+                        self.donating[f"{mod.modname}.{node.name}"] = (
+                            DonationSpec(pos, tuple(params))
+                        )
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                pos = _jit_donation(node.value, mod.imports, [])
+                if pos:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.donating[f"{mod.modname}.{tgt.id}"] = (
+                                DonationSpec(pos)
+                            )
+
+    def donation_for(self, mod: ModuleInfo, func: ast.AST, extra=None):
+        """The :class:`DonationSpec` a call's func resolves to, if any.
+
+        Resolution order: local name defined in this module, then the
+        alias-resolved qualified name (cross-module call sites), then the
+        rule-supplied ``extra`` table (convention-donating wrappers).
+        """
+        candidates = []
+        if isinstance(func, ast.Name):
+            candidates.append(f"{mod.modname}.{func.id}")
+        dotted = mod.imports.resolve(func)
+        if dotted:
+            candidates.append(dotted)
+        for cand in candidates:
+            spec = self.donating.get(cand)
+            if spec is None and extra:
+                spec = extra.get(cand)
+            if spec is not None:
+                return spec
+        return None
+
+    # -- import graph -----------------------------------------------------
+
+    def resolve_target_module(self, target: str) -> str | None:
+        """Longest analyzed-module prefix of a raw import target."""
+        parts = target.split(".")
+        for k in range(len(parts), 0, -1):
+            cand = ".".join(parts[:k])
+            if cand in self.modules:
+                return cand
+        return None
+
+    def reaches(
+        self, modname: str, prefix: str, through=None, memo=None
+    ) -> bool:
+        """True when ``modname``'s direct-import closure names a module
+        under ``prefix`` (e.g. ``ba_tpu.obs``).
+
+        ``through`` optionally filters which analyzed modules the BFS
+        may traverse INTO (BA301 passes its jitted-tree predicate so
+        host-layer modules act as boundaries); the start module is
+        always examined.  Callers supplying ``through`` must supply
+        their own ``memo`` dict — the default memo is only valid for
+        the unfiltered closure.
+
+        Iterative BFS over the analyzed set (import cycles are just
+        revisits against ``seen`` — a recursive memo would cache wrong
+        negatives inside a cycle).
+        """
+        if memo is None:
+            if through is not None:
+                raise ValueError("custom `through` needs its own memo")
+            memo = self._reach_memo
+        key = (modname, prefix)
+        if key in memo:
+            return memo[key]
+        seen = {modname}
+        frontier = [modname]
+        hit = False
+        while frontier and not hit:
+            mod = self.modules.get(frontier.pop())
+            if mod is None:
+                continue
+            for _, target in mod.import_records:
+                if target == prefix or target.startswith(prefix + "."):
+                    hit = True
+                    break
+                nxt = self.resolve_target_module(target)
+                if (
+                    nxt
+                    and nxt not in seen
+                    and (through is None or through(nxt))
+                ):
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        memo[key] = hit
+        return hit

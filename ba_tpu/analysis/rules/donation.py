@@ -1,0 +1,123 @@
+"""BA201 use-after-donate.
+
+The engine's donation contract (``parallel/pipeline.py``): buffers
+passed to a ``donate_argnums`` dispatch are CONSUMED — XLA aliases the
+output onto them and jax deletes the handle, so a later read raises (at
+best) or silently reads an aliased buffer on backends that defer the
+error.  The rule proves at the call site what the runtime only catches
+when the path executes: after a statement that passes local name ``x``
+at a donated position, any read of ``x`` before a rebinding is a
+finding.
+
+Donating callables come from the project-wide registry
+(``@functools.partial(jax.jit, donate_argnums=...)`` decorators and
+``g = jax.jit(f, donate_argnums=...)`` rebindings, resolved through
+import aliases so cross-module call sites are checked), plus the
+CONVENTION table below for wrappers whose jit lives inside but whose
+documented contract donates an argument.
+
+Analysis is the shared must-flow walk (``analysis/flow.py``):
+evaluation-ordered events, intersection joins at branches (a donate on
+one path never poisons the other), and double-walked loop bodies so a
+donate at the bottom of a loop body catches the read at the top of the
+next iteration.  ``fresh_copy(x)`` BEFORE the donating call is the
+sanctioned survival idiom and naturally clean here — only reads AFTER
+the donate flag.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ba_tpu.analysis.base import Rule, register
+from ba_tpu.analysis.flow import (
+    FlowHandler,
+    FlowState,
+    function_scopes,
+    walk_body,
+)
+from ba_tpu.analysis.project import DonationSpec
+
+# Wrappers that donate by documented contract rather than a visible
+# donate_argnums: pipeline_sweep consumes its `state` (arg 1) — the
+# first megastep inside it donates it — while `key` survives (the
+# schedule copies the key data; make_key_schedule's contract).
+KNOWN_DONATING = {
+    "ba_tpu.parallel.pipeline.pipeline_sweep": DonationSpec(
+        frozenset([1]), ("key", "state")
+    ),
+}
+
+
+class _PoisonState(FlowState):
+    def __init__(self, poisoned=None):
+        # name -> (callee display, donate line)
+        self.poisoned = dict(poisoned or {})
+
+    def copy(self):
+        return _PoisonState(self.poisoned)
+
+    def merge(self, others):
+        if not others:
+            self.poisoned = {}
+            return
+        keep = {}
+        for name, info in others[0].poisoned.items():
+            if all(name in o.poisoned for o in others):
+                keep[name] = self.poisoned.get(name, info)
+        self.poisoned = keep
+
+
+class _Handler(FlowHandler):
+    def __init__(self, rule, mod, project):
+        self.rule = rule
+        self.mod = mod
+        self.project = project
+        self.findings = {}
+
+    def on_load(self, node, state):
+        info = state.poisoned.get(node.id)
+        if info is None:
+            return
+        callee, line = info
+        key = (node.lineno, node.col_offset)
+        if key not in self.findings:
+            self.findings[key] = self.rule.finding(
+                self.mod,
+                node,
+                f"'{node.id}' read after being donated to {callee} "
+                f"(line {line}) — donated buffers are deleted by XLA; "
+                "thread the returned value, or fresh_copy() before the "
+                "dispatch",
+            )
+
+    def on_store(self, name, state):
+        state.poisoned.pop(name, None)
+
+    def on_call(self, call, state):
+        spec = self.project.donation_for(
+            self.mod, call.func, KNOWN_DONATING
+        )
+        if spec is None:
+            return
+        callee = ast.unparse(call.func)
+        for i in spec.positions:
+            if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                state.poisoned[call.args[i].id] = (callee, call.lineno)
+        named = spec.donated_params()
+        for kw in call.keywords:
+            if kw.arg in named and isinstance(kw.value, ast.Name):
+                state.poisoned[kw.value.id] = (callee, call.lineno)
+
+
+@register
+class UseAfterDonate(Rule):
+    code = "BA201"
+    name = "use-after-donate"
+    severity = "error"
+
+    def check_module(self, mod, project):
+        handler = _Handler(self, mod, project)
+        for _scope, body in function_scopes(mod.tree):
+            walk_body(body, handler, _PoisonState())
+        yield from handler.findings.values()

@@ -1,0 +1,169 @@
+"""BA101 host-sync-in-hot-path and BA102 host-key-split-in-pipeline.
+
+The pipelined sweep engine's entire win (BENCH_pipeline_r6.json: 2.72x
+over the blocking driver) is that the host NEVER synchronizes inside
+the round loop — the only blocking operation is the depth-delayed
+retire, and keys derive on device from the ``KeySchedule`` counter.
+These two rules are the semantic versions of the PR 1 text greps in
+``scripts/ci.sh`` (see the mapping comment there):
+
+- **BA101** bans host-sync idioms in the round-loop modules:
+  ``block_until_ready`` anywhere under ``ba_tpu.parallel``; host-numpy
+  conversions (``np.asarray``/``np.array``, ALIAS-RESOLVED — ``import
+  numpy as jnp_like`` is still numpy, ``jnp.asarray`` is still
+  device-side), ``.item()``/``.tolist()`` drains, and
+  ``float()``/``int()`` coercions of jax-derived values, each scoped to
+  the two round-loop modules (``pipeline``/``sweep`` — ``mesh``/
+  ``multihost`` build host-side topology and are the package's
+  sanctioned numpy users).
+- **BA102** keeps the host out of PRNG derivation in ``pipeline.py``:
+  any ``jax.random.split`` (the round keys come from the on-device
+  schedule; a split reappearing means the host is back in the per-round
+  loop), and ``jax.random.fold_in`` inside a host ``for``/``while``
+  body (the sanctioned ``fold_in`` lives in ``round_keys``, trace-time
+  under jit, outside any host loop).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ba_tpu.analysis.base import Rule, register
+
+HOT_TREE = "ba_tpu.parallel."
+# The round-loop modules: the only two whose steady-state statements run
+# once per round / per dispatch.
+HOT_CONVERSION_MODULES = {
+    "ba_tpu.parallel.pipeline",
+    "ba_tpu.parallel.sweep",
+}
+PIPELINE_MODULE = "ba_tpu.parallel.pipeline"
+
+_NP_CONVERSIONS = {
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+}
+_DRAIN_METHODS = {"item", "tolist"}
+
+
+def _loop_node_ids(tree: ast.AST) -> set:
+    """ids of every node lexically inside a host ``for``/``while`` body."""
+    inside: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            for sub in node.body + node.orelse:
+                for inner in ast.walk(sub):
+                    inside.add(id(inner))
+    return inside
+
+
+@register
+class HostSyncInHotPath(Rule):
+    code = "BA101"
+    name = "host-sync-in-hot-path"
+    severity = "error"
+
+    def check_module(self, mod, project):
+        if not mod.modname.startswith(HOT_TREE):
+            return
+        seen: set = set()
+
+        def hit(node, msg):
+            key = (node.lineno, node.col_offset)
+            if key not in seen:
+                seen.add(key)
+                yield self.finding(mod, node, msg)
+
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "block_until_ready"
+            ):
+                yield from hit(
+                    node,
+                    "block_until_ready in a parallel round-loop module: "
+                    "any host sync serializes host and device — the "
+                    "engine's only sync is the depth-delayed retire",
+                )
+        for node, dotted in mod.imports.resolved_refs(mod.tree):
+            if dotted == "jax.block_until_ready":
+                yield from hit(
+                    node,
+                    "block_until_ready in a parallel round-loop module: "
+                    "any host sync serializes host and device — the "
+                    "engine's only sync is the depth-delayed retire",
+                )
+
+        if mod.modname not in HOT_CONVERSION_MODULES:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.imports.resolve(node.func)
+            if dotted in _NP_CONVERSIONS:
+                yield from hit(
+                    node,
+                    f"host numpy conversion ({dotted}) on the round path "
+                    "drains the dispatch queue through the host "
+                    "(device-side jnp is fine; multihost.put_global is "
+                    "the sanctioned np user)",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _DRAIN_METHODS
+            ):
+                yield from hit(
+                    node,
+                    f".{node.func.attr}() in a round-loop module forces a "
+                    "device->host transfer per call",
+                )
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int")
+                and node.func.id not in mod.imports.bindings
+                and any(
+                    d == "jax" or d.startswith(("jax.", "jax.numpy"))
+                    for a in node.args
+                    for _, d in mod.imports.resolved_refs(a)
+                )
+            ):
+                yield from hit(
+                    node,
+                    f"{node.func.id}() of a jax value in a round-loop "
+                    "module blocks on the device result",
+                )
+
+
+@register
+class HostKeySplitInPipeline(Rule):
+    code = "BA102"
+    name = "host-key-split-in-pipeline"
+    severity = "error"
+
+    def check_module(self, mod, project):
+        if mod.modname != PIPELINE_MODULE:
+            return
+        in_loop = _loop_node_ids(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.imports.resolve(node.func)
+            if dotted == "jax.random.split":
+                yield self.finding(
+                    mod,
+                    node,
+                    "host key split in pipeline.py — round keys derive ON "
+                    "DEVICE from the KeySchedule counter "
+                    "(fold_in(fold_in(base, r), i) inside the compiled "
+                    "megastep); a host split puts the host back in the "
+                    "per-round loop",
+                )
+            elif dotted == "jax.random.fold_in" and id(node) in in_loop:
+                yield self.finding(
+                    mod,
+                    node,
+                    "host-loop fold_in in pipeline.py — per-round key "
+                    "derivation belongs on device (round_keys, under "
+                    "jit), not in the host dispatch loop",
+                )

@@ -1,0 +1,108 @@
+"""BA301 obs-purity: the jitted trees never touch the obs layer.
+
+The observability layer (PR 2) is HOST-only by contract: a span or
+``metrics.emit`` inside a jitted/scanned body would time tracing
+instead of execution, or force a host-callback sync in the middle of
+the round loop.  The jitted math lives in ``ba_tpu.core`` and
+``ba_tpu.ops``; instrumentation belongs in ``runtime/``, the
+``parallel/`` loop drivers, crypto host paths, and ``bench.py``.
+
+Unlike the grep it replaces, this rule works on the real import graph,
+alias-resolved:
+
+- a ``core``/``ops`` module importing ``ba_tpu.obs`` under ANY spelling
+  (``from ba_tpu import obs as o``, ``from ba_tpu.obs.trace import
+  span``...) is flagged at the import;
+- so is importing another ``core``/``ops`` module whose own
+  closure reaches obs — the finding lands on the edge that lets the
+  contamination in, with the path named;
+- any alias-resolved attribute reference to ``ba_tpu.obs...`` or
+  ``.emit`` on a name bound to ``ba_tpu.utils.metrics`` is flagged at
+  the reference.
+
+The closure deliberately follows edges only THROUGH other jitted-tree
+modules: importing a host-layer module (``crypto``/``utils``/
+``parallel`` helpers, which legitimately instrument their own host
+paths — e.g. ``crypto/sha512`` -> ``utils/platform`` ->
+``obs.instrument``) is not an obs reference from the jitted tree, and
+treating it as one would indict every kernel that consults
+``use_pallas`` at trace time.
+"""
+
+from __future__ import annotations
+
+from ba_tpu.analysis.base import Rule, register
+
+SCOPES = ("ba_tpu.core", "ba_tpu.ops")
+OBS = "ba_tpu.obs"
+SINK = "ba_tpu.utils.metrics"
+
+
+def _in_scope(modname: str) -> bool:
+    return any(
+        modname == s or modname.startswith(s + ".") for s in SCOPES
+    )
+
+
+def _is_obs(target: str) -> bool:
+    return target == OBS or target.startswith(OBS + ".")
+
+
+@register
+class ObsPurity(Rule):
+    code = "BA301"
+    name = "obs-purity"
+    severity = "error"
+
+    def check_module(self, mod, project):
+        if not _in_scope(mod.modname):
+            return
+        # Memoized per Project (rule instances are registry singletons
+        # shared across runs; a cross-run memo would go stale).
+        memo = project.__dict__.setdefault("_ba301_memo", {})
+        seen_lines: set = set()
+
+        def once(node, message):
+            if node.lineno not in seen_lines:
+                seen_lines.add(node.lineno)
+                yield self.finding(mod, node, message)
+
+        for node, target in mod.import_records:
+            if _is_obs(target):
+                yield from once(
+                    node,
+                    f"jitted-tree module imports {OBS} — observability "
+                    "is host-only (a span or emit inside a jitted body "
+                    "times tracing, not execution); instrument the "
+                    "caller in runtime/ or parallel/ instead",
+                )
+                continue
+            nxt = project.resolve_target_module(target)
+            if (
+                nxt
+                and nxt != mod.modname
+                and _in_scope(nxt)
+                and project.reaches(nxt, OBS, through=_in_scope, memo=memo)
+            ):
+                yield from once(
+                    node,
+                    f"jitted-tree module imports '{target}', whose "
+                    f"jitted-tree import closure reaches {OBS} — "
+                    "observability is host-only",
+                )
+        for node, dotted in mod.imports.resolved_refs(mod.tree):
+            if _is_obs(dotted):
+                yield from once(
+                    node,
+                    f"reference to {dotted} inside a jitted-tree module "
+                    "— observability is host-only",
+                )
+            elif dotted.startswith(SINK + ".") and dotted.endswith(
+                ".emit"
+            ):
+                yield from once(
+                    node,
+                    "metrics sink emit inside a jitted-tree module — "
+                    "the JSONL sink is host-only; emit from the loop "
+                    "driver",
+                )

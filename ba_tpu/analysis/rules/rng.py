@@ -1,0 +1,134 @@
+"""BA202 rng-key-reuse.
+
+The silent-correctness bug class the byzantine fault-injection path is
+most exposed to: pass the same PRNG key to two sampling calls and the
+"random" traitor coins repeat — no crash, no warning, just correlated
+faults that quietly break the independence assumptions behind every
+histogram test in the suite.  (The engine's whole key discipline —
+``fold_in(fold_in(base, round), instance)`` — exists to make reuse
+structurally impossible on the hot path; this rule covers everywhere
+else.)
+
+Semantics, per function scope over the shared must-flow walk:
+
+- A **sampling** call (``jax.random.normal/bernoulli/randint/...``,
+  alias-resolved) with a bare-name key argument CONSUMES that name.
+- A second sampling call consuming the same name before it is REBOUND
+  is a finding.  Deriving from the key in between
+  (``k2 = jr.fold_in(key, 1)``) does NOT clear the mark: keys are
+  immutable, so the original name still repeats its stream — only
+  rebinding (``key, sub = jr.split(key)``, the canonical idiom)
+  decorrelates it.
+- Branch joins are intersections (consumed on one path only does not
+  poison the other); loop bodies are double-walked, so a
+  loop-invariant key consumed every iteration is caught
+  (``for i in r: jr.normal(key)`` draws the same numbers each pass).
+
+Only bare ``Name`` keys are tracked: ``jr.normal(jr.fold_in(key, i))``
+derives inline and is clean by construction.  Deliberate reuse (A/B
+benchmarks replaying identical randomness across two implementations)
+is exactly what the line suppression is for::
+
+    out_b = engine_b(jr.uniform(key, ...))  # ba-lint: disable=BA202
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ba_tpu.analysis.base import Rule, register
+from ba_tpu.analysis.flow import (
+    FlowHandler,
+    FlowState,
+    function_scopes,
+    walk_body,
+)
+
+SAMPLING = {
+    "ball", "bernoulli", "beta", "binomial", "bits", "categorical",
+    "cauchy", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+    "exponential", "f", "gamma", "generalized_normal", "geometric",
+    "gumbel", "laplace", "loggamma", "logistic", "lognormal", "maxwell",
+    "multinomial", "multivariate_normal", "normal", "orthogonal",
+    "pareto", "permutation", "poisson", "rademacher", "randint",
+    "rayleigh", "t", "triangular", "truncated_normal", "uniform", "wald",
+    "weibull_min",
+}
+
+
+def _key_arg(call: ast.Call):
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return None
+
+
+class _ConsumedState(FlowState):
+    def __init__(self, consumed=None):
+        # name -> (sampling fn, line of first consumption)
+        self.consumed = dict(consumed or {})
+
+    def copy(self):
+        return _ConsumedState(self.consumed)
+
+    def merge(self, others):
+        if not others:
+            self.consumed = {}
+            return
+        keep = {}
+        for name, info in others[0].consumed.items():
+            if all(name in o.consumed for o in others):
+                keep[name] = self.consumed.get(name, info)
+        self.consumed = keep
+
+
+class _Handler(FlowHandler):
+    def __init__(self, rule, mod):
+        self.rule = rule
+        self.mod = mod
+        self.findings = {}
+
+    def on_store(self, name, state):
+        state.consumed.pop(name, None)
+
+    def on_call(self, call, state):
+        dotted = self.mod.imports.resolve(call.func)
+        if not dotted or not dotted.startswith("jax.random."):
+            return
+        fn = dotted.rsplit(".", 1)[1]
+        key = _key_arg(call)
+        if fn not in SAMPLING or not isinstance(key, ast.Name):
+            # Deriving calls (split/fold_in/clone) deliberately do NOT
+            # clear the mark: the immutable original key would still
+            # repeat its stream.  Only on_store (rebinding) clears.
+            return
+        prior = state.consumed.get(key.id)
+        if prior is not None:
+            prev_fn, prev_line = prior
+            loc = (call.lineno, call.col_offset)
+            if loc not in self.findings:
+                self.findings[loc] = self.rule.finding(
+                    self.mod,
+                    call,
+                    f"key '{key.id}' already consumed by "
+                    f"jax.random.{prev_fn} (line {prev_line}) — reuse "
+                    "draws identical randomness; split/fold_in first "
+                    "(or rebind the name)",
+                )
+        else:
+            state.consumed[key.id] = (fn, call.lineno)
+
+
+@register
+class RngKeyReuse(Rule):
+    code = "BA202"
+    name = "rng-key-reuse"
+    severity = "error"
+
+    def check_module(self, mod, project):
+        handler = _Handler(self, mod)
+        for _scope, body in function_scopes(mod.tree):
+            walk_body(body, handler, _ConsumedState())
+        yield from handler.findings.values()

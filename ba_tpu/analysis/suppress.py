@@ -1,0 +1,71 @@
+"""Inline suppression comments: ``# ba-lint: disable=BAxxx``.
+
+Two forms, both parsed from REAL comment tokens (``tokenize``, not a
+raw-line regex — a docstring that merely *documents* the syntax, like
+this one, must never register as a live directive):
+
+- line-scoped — appended to the flagged line::
+
+      out = np.asarray(x)  # ba-lint: disable=BA101
+
+  Multiple codes comma-separate (``disable=BA101,BA202``); ``all``
+  silences every rule on the line.
+- file-scoped — a comment anywhere in the file on its own line
+  (conventionally in the header)::
+
+      # ba-lint: disable-file=BA401
+
+Suppressed findings still count in the JSON summary (``suppressed``
+bucket) so a tree accumulating waivers is visible, but they never fail
+the run.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+
+_LINE_RE = re.compile(r"#\s*ba-lint:\s*disable=([A-Za-z0-9,\s]+)")
+_FILE_RE = re.compile(r"#\s*ba-lint:\s*disable-file=([A-Za-z0-9,\s]+)")
+
+
+def _codes(group: str) -> set[str]:
+    return {c.strip().upper() for c in group.split(",") if c.strip()}
+
+
+class SuppressionIndex:
+    """Per-file map of suppressed codes by line, plus file-wide codes."""
+
+    def __init__(self, source: str):
+        self.by_line: dict[int, set[str]] = {}
+        self.file_wide: set[str] = set()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            comments = [
+                (tok.start, tok.string, tok.line)
+                for tok in tokens
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # Unparseable files surface as BA900 findings; suppression
+            # directives in them are moot.
+            return
+        for (lineno, col), text, line in comments:
+            m = _FILE_RE.search(text)
+            if m:
+                # Own-line comments only: a TRAILING disable-file would
+                # silently waive a whole file where the author plainly
+                # meant one line — ignore it rather than over-apply it.
+                if line[:col].strip() == "":
+                    self.file_wide |= _codes(m.group(1))
+                continue
+            m = _LINE_RE.search(text)
+            if m:
+                self.by_line[lineno] = _codes(m.group(1))
+
+    def is_suppressed(self, code: str, line: int) -> bool:
+        for active in (self.file_wide, self.by_line.get(line, ())):
+            if code in active or "ALL" in active:
+                return True
+        return False

@@ -40,7 +40,7 @@ from ba_tpu.core.om import round1_broadcast
 from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.quorum import majority_counts, quorum_decision, strict_majority
 from ba_tpu.core.state import SimState
-from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT
+from ba_tpu.core.types import ATTACK, RETREAT
 
 
 def _coin(key: jax.Array, shape) -> jnp.ndarray:

@@ -26,7 +26,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from ba_tpu.crypto.scalar import _C16, _DELTA, _L32
 from ba_tpu.ops.ladder import (

@@ -30,7 +30,7 @@ from ba_tpu.core.eig import _in_path_mask
 from ba_tpu.core.quorum import quorum_decision, strict_majority
 from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.state import SimState
-from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
 from ba_tpu.parallel.mesh import cached_jit, shard_map
 from ba_tpu.parallel.multihost import put_global, round1_jit
 

@@ -116,8 +116,9 @@ def round_keys(sched: KeySchedule, batch: int) -> jax.Array:
     counter, then one vmapped ``fold_in`` over the instance index — the
     device-side replacement for the blocking driver's host-side per-round
     key split.  Same threefry derivation strength, and the instance-index
-    fold keeps this module free of the banned host-split idiom the
-    hot-path lint (scripts/ci.sh) greps for.
+    fold keeps this module free of the banned host-split idiom ba-lint's
+    BA102 rule (ba_tpu/analysis, run by scripts/ci.sh) checks for — this
+    ``fold_in`` is sanctioned because it sits outside any host loop.
     """
     base = jr.wrap_key_data(sched.key_data)
     kr = jr.fold_in(base, sched.counter)

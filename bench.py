@@ -1094,8 +1094,6 @@ def make_fieldmul_probe(jax, jnp, jr):
     rng = np.random.default_rng(11)
 
     if use_pallas():
-        import functools
-
         from jax.experimental import pallas as pl
         from ba_tpu.ops.ladder import plane_spec, plane_out_shape, TILE
         from ba_tpu.ops.planes import p_mul

@@ -1,20 +1,31 @@
-"""10k-instance fault-sweep campaign with checkpointing.
+"""Checkpointed fault-sweep campaign with per-checkpoint metrics.
 
-The batched equivalent of running the reference's REPL thousands of times
-with different ``g-state``/``g-kill`` configurations (ba.py:401-437): one
-device program agrees 10,240 independent clusters with random sizes and
-traitor sets, reports the decision histogram, and checkpoints the final
-state (something the reference cannot do at all — its state dies with the
-process).
+The batched equivalent of running the reference's REPL thousands of
+times with different ``g-state``/``g-kill`` configurations
+(ba.py:401-437): each checkpoint agrees ``SWEEP_BATCH`` independent
+clusters with random sizes and traitor sets under a fresh fold of the
+campaign key, reports the decision histogram, snapshots the campaign's
+metrics into the obs registry (ROADMAP: mid-campaign dashboards for
+free), and checkpoints the final state — something the reference cannot
+do at all, since its state dies with the process.
+
+Observability wiring (PR 2's registry, PR 3's ROADMAP item): counters
+for instances/decisions, a log-bucketed histogram of per-checkpoint
+wall time, and one versioned ``{"event": "metrics_snapshot", "v": 1}``
+record per checkpoint.  Point ``BA_TPU_METRICS`` at a path (or ``-``
+for stderr) to capture the JSONL stream; unset, the snapshots are
+returned in-memory only and the example stays file-silent.
 
 Runs anywhere: real TPU if available, else an 8-device virtual CPU mesh.
 
-    python examples/sweep_campaign.py
+    SWEEP_CHECKPOINTS=3 BA_TPU_METRICS=/tmp/campaign.jsonl \\
+        python examples/sweep_campaign.py
 """
 
 import os
 import pathlib
 import sys
+import time
 
 import numpy as np
 
@@ -27,23 +38,68 @@ def main() -> None:
     select_example_platform(8)
     import jax.random as jr
 
+    from ba_tpu.obs import default_registry
     from ba_tpu.parallel import make_mesh, make_sweep_state, sharded_sweep
     from ba_tpu.utils.snapshot import save_sim_state
 
     batch = int(os.environ.get("SWEEP_BATCH", 10_240))
     cap = int(os.environ.get("SWEEP_CAP", 64))
-    state = make_sweep_state(jr.key(0), batch, cap)
+    checkpoints = int(os.environ.get("SWEEP_CHECKPOINTS", 3))
+    ckpt_path = os.environ.get("SWEEP_CKPT", "/tmp/sweep_campaign.npz")
+
+    reg = default_registry()
+    ck_c = reg.counter("sweep_campaign_checkpoints_total")
+    inst_c = reg.counter("sweep_campaign_instances_total")
+    wall_h = reg.histogram("sweep_campaign_checkpoint_s")
+    decision_c = {
+        name: reg.counter(f"sweep_campaign_{name}_total")
+        for name in ("retreat", "attack", "undefined")
+    }
+
     mesh = make_mesh()
-    out = sharded_sweep(mesh, jr.key(1), state, m=2)
-    hist = np.asarray(out["histogram"])
+    campaign_key = jr.key(1)
+    total = np.zeros(3, dtype=np.int64)
     names = ["retreat", "attack", "undefined"]
-    print(f"{batch} clusters (n <= {cap}, OM(2)):")
-    for name, count in zip(names, hist):
-        print(f"  {name:10s} {int(count):6d}")
-    assert hist.sum() == batch
-    path = os.environ.get("SWEEP_CKPT", "/tmp/sweep_campaign.npz")
-    save_sim_state(path, state, decisions=np.asarray(out["decision"]))
-    print(f"checkpoint -> {path}")
+    print(
+        f"campaign: {checkpoints} checkpoint(s) x {batch} clusters "
+        f"(n <= {cap}, OM(2))"
+    )
+    for ck in range(checkpoints):
+        t0 = time.perf_counter()
+        state = make_sweep_state(jr.fold_in(jr.key(0), ck), batch, cap)
+        out = sharded_sweep(
+            mesh, jr.fold_in(campaign_key, ck), state, m=2
+        )
+        hist = np.asarray(out["histogram"])
+        assert hist.sum() == batch
+        total += hist
+        wall_h.record(time.perf_counter() - t0)
+        ck_c.inc()
+        inst_c.inc(batch)
+        for name, count in zip(names, hist):
+            decision_c[name].inc(int(count))
+        save_sim_state(
+            ckpt_path, state, decisions=np.asarray(out["decision"])
+        )
+        # One versioned metrics_snapshot per checkpoint: the JSONL sink
+        # (BA_TPU_METRICS) gets a {"event": "metrics_snapshot", "v": 1}
+        # record a dashboard can tail mid-campaign.
+        record = reg.emit_snapshot(checkpoint=ck, batch=batch)
+        counts = " ".join(
+            f"{name}={int(count)}" for name, count in zip(names, hist)
+        )
+        print(
+            f"  checkpoint {ck}: {counts} "
+            f"(snapshot: {len(record['metrics'])} metrics)"
+        )
+    print(f"{checkpoints * batch} clusters total:")
+    for name, count in zip(names, total):
+        print(f"  {name:10s} {int(count):7d}")
+    assert total.sum() == checkpoints * batch
+    sink_target = os.environ.get("BA_TPU_METRICS")
+    where = sink_target or "in-memory only (set BA_TPU_METRICS to capture)"
+    print(f"checkpoint -> {ckpt_path}")
+    print(f"metrics_snapshot x{checkpoints} -> {where}")
 
 
 if __name__ == "__main__":

@@ -1,72 +1,133 @@
 #!/usr/bin/env bash
-# CI entry: hot-path lint + the tier-1 suite (ROADMAP.md, verbatim).
+# CI entry: ba-lint static analysis + the tier-1 suite (ROADMAP.md,
+# verbatim).
 #
-# The lint guards the pipelined sweep engine's contract (ISSUE 1): the
-# round-loop modules under ba_tpu/parallel/ must never re-grow
+# ISSUE 3 replaced the PR 1/2 text greps with `python -m
+# ba_tpu.analysis` (ba-lint): a zero-dependency pure-ast analyzer that
+# resolves import aliases (an `import numpy as jnp_like` no longer
+# sails through), computes the real import graph, and expresses the
+# donation and RNG-linearity contracts greps structurally cannot.
+# Rule <-> old-grep mapping:
 #
-#   - block_until_ready      — on the tunnel backend it acks the dispatch
-#                              without awaiting execution (README
-#                              methodology note), and in a round loop ANY
-#                              host sync serializes host and device; the
-#                              engine's only sync is the depth-delayed
-#                              device_get retire;
-#   - host np. conversions   — np.asarray/np.array on device values drain
-#                              the queue through the host (multihost.py's
-#                              documented put_global ingestion is the one
-#                              sanctioned np user in the package);
-#   - host per-round key splits in pipeline.py — keys are derived ON
-#                              DEVICE from the folded counter
-#                              (KeySchedule); a jr.split reappearing
-#                              there means the host is back in the
-#                              per-round loop.
+#   BA101 host-sync-in-hot-path      <- grep block 1: block_until_ready
+#                                       in ba_tpu/parallel/ + host
+#                                       np.asarray/np.array in
+#                                       pipeline.py/sweep.py (now also
+#                                       .item()/.tolist()/float()/int()
+#                                       drains, alias-resolved)
+#   BA102 host-key-split-in-pipeline <- grep block 2: jr.split /
+#                                       random.split in pipeline.py
+#                                       (now alias-resolved, plus
+#                                       fold_in inside host loops)
+#   BA301 obs-purity                 <- grep block 3: metrics.emit /
+#                                       ba_tpu.obs / obs.span in
+#                                       ba_tpu/core|ops (now the
+#                                       transitive direct-import
+#                                       closure, alias-resolved)
+#   BA201 use-after-donate           <- new: no grep could express it
+#   BA202 rng-key-reuse              <- new: no grep could express it
+#   BA401 dead-import                <- new, warning-level ratchet
 #
-# Greps are over source text (comments included) by design: cheap, zero
-# deps, and the banned idioms have no legitimate spelling in these files.
+# ba-lint never imports jax, so this stage costs seconds and runs on
+# any host.  Findings output is a schema-versioned JSON object,
+# validated below exactly like the metrics JSONL records are.
 
 set -u
 cd "$(dirname "$0")/.."
 
-fail=0
+echo "== ba-lint static analysis: ba_tpu/ examples/ bench.py =="
+balint_json=$(mktemp)
+trap 'rm -rf "$balint_json" "${mutdir:-}"' EXIT
+python -m ba_tpu.analysis ba_tpu/ examples/ bench.py --format json \
+    > "$balint_json"
+balint_rc=$?
+# Schema check (mirrors scripts/check_metrics_schema.py's contract for
+# the metrics JSONL: every consumer-facing record parses and carries
+# its schema version) + legacy stderr messaging per rule family.
+python - "$balint_json" "$balint_rc" <<'EOF'
+import json, sys
 
-echo "== hot-path lint: ba_tpu/parallel =="
-if grep -rn "block_until_ready" ba_tpu/parallel/ --include='*.py'; then
-    echo "LINT FAIL: block_until_ready inside ba_tpu/parallel/" >&2
-    fail=1
-fi
-# \b keeps jnp.asarray (device-side) out of the match; scope is the
-# round-loop modules (mesh/multihost build host-side topology and are
-# the package's sanctioned numpy users).
-if grep -rn "\bnp\.asarray(\|\bnp\.array(\|\bnumpy\.asarray(" \
-        ba_tpu/parallel/pipeline.py ba_tpu/parallel/sweep.py; then
-    echo "LINT FAIL: host numpy conversion in a parallel round-loop module" >&2
-    fail=1
-fi
-if grep -n "jr\.split\|random\.split" ba_tpu/parallel/pipeline.py; then
-    echo "LINT FAIL: host key split in pipeline.py (keys must derive" \
-         "on device from the KeySchedule counter)" >&2
-    fail=1
-fi
-if [ "$fail" -ne 0 ]; then
-    echo "hot-path lint failed" >&2
+path, rc = sys.argv[1], int(sys.argv[2])
+with open(path) as fh:
+    doc = json.load(fh)
+for field in ("version", "tool", "files_scanned", "rules", "findings",
+              "suppressed", "counts", "exit"):
+    assert field in doc, f"ba-lint JSON missing {field!r}"
+assert doc["version"] == 1, f"unexpected ba-lint schema v{doc['version']}"
+assert doc["tool"] == "ba-lint"
+for f in doc["findings"] + doc["suppressed"]:
+    for field in ("code", "severity", "path", "line", "col", "message"):
+        assert field in f, f"finding missing {field!r}: {f}"
+assert doc["exit"] == rc, (
+    f"ba-lint exit {rc} disagrees with its own JSON ({doc['exit']})"
+)
+
+for f in doc["findings"]:
+    print(f"{f['path']}:{f['line']}:{f['col']}: {f['code']} "
+          f"[{f['severity']}] {f['message']}")
+codes = {f["code"] for f in doc["findings"] if f["severity"] == "error"}
+# Identical stderr messaging to the grep blocks this stage replaced.
+if codes & {"BA101"}:
+    print("LINT FAIL: host sync inside a parallel round-loop module",
+          file=sys.stderr)
+if codes & {"BA102"}:
+    print("LINT FAIL: host key split in pipeline.py (keys must derive",
+          "on device from the KeySchedule counter)", file=sys.stderr)
+if codes & {"BA201", "BA202"}:
+    print("LINT FAIL: donation/RNG-linearity contract violation",
+          file=sys.stderr)
+if codes & {"BA301"}:
+    print("LINT FAIL: host-only instrumentation referenced inside a",
+          "jitted module tree (ba_tpu/core or ba_tpu/ops)",
+          file=sys.stderr)
+if doc["counts"]["warning"]:
+    # BA401 (dead-import) stays warning-level: visible, never fatal.
+    print(f"ba-lint: {doc['counts']['warning']} warning(s) — see above",
+          file=sys.stderr)
+sys.exit(1 if codes else 0)
+EOF
+schema_rc=$?
+if [ "$balint_rc" -ne 0 ] || [ "$schema_rc" -ne 0 ]; then
+    echo "ba-lint failed" >&2
     exit 1
 fi
-echo "hot-path lint OK"
+echo "ba-lint OK"
 
-echo "== obs host-only lint: ba_tpu/core ba_tpu/ops =="
-# The observability layer (ISSUE 2) is HOST-only by contract: a span or
-# metrics.emit inside a jitted/scan body would time tracing instead of
-# execution (or force a host callback sync).  The jitted math lives in
-# ba_tpu/core and ba_tpu/ops, so — mirroring the hot-path lint above —
-# those trees must never reference the sink or the tracer; wiring
-# belongs in runtime/, parallel/ loop drivers, crypto host paths, and
-# bench.py.
-if grep -rn "metrics\.emit\|ba_tpu\.obs\|ba_tpu import obs\|obs\.span" \
-        ba_tpu/core/ ba_tpu/ops/ --include='*.py'; then
-    echo "LINT FAIL: host-only instrumentation referenced inside a" \
-         "jitted module tree (ba_tpu/core or ba_tpu/ops)" >&2
-    exit 1
-fi
-echo "obs host-only lint OK"
+echo "== ba-lint mutation check =="
+# Guard against the analyzer rotting into a silent no-op: seed one
+# banned idiom per core rule into a tempdir copy of the tree and assert
+# ba-lint goes red with the right code.  Each mutation uses an import
+# alias a grep could not have followed.
+mutdir=$(mktemp -d)
+mutate_and_expect() {
+    # $1 = rule code, $2 = target file (relative), $3 = seeded code.
+    # The copy keeps its `ba_tpu` name: rules scope on the dotted
+    # module name derived from __init__.py ancestry, so the tempdir
+    # copy scopes identically to the real tree.
+    rm -rf "$mutdir/ba_tpu"
+    cp -r ba_tpu "$mutdir/ba_tpu"
+    rm -rf "$mutdir/ba_tpu/analysis"   # lint the product tree, not the linter
+    printf '\n%s\n' "$3" >> "$mutdir/ba_tpu/$2"
+    if python -m ba_tpu.analysis "$mutdir/ba_tpu" --format json \
+            > "$mutdir/out.json"; then
+        echo "MUTATION CHECK FAIL: seeded $1 violation not fatal" >&2
+        return 1
+    fi
+    if ! grep -q "\"code\": \"$1\"" "$mutdir/out.json"; then
+        echo "MUTATION CHECK FAIL: $1 missing from findings JSON" >&2
+        return 1
+    fi
+    echo "mutation check OK: seeded $1 goes red"
+}
+mutate_and_expect BA101 parallel/pipeline.py \
+    'def _mut101(x):
+    return x.block_until_ready()' || exit 1
+mutate_and_expect BA102 parallel/pipeline.py \
+    'import jax.random as _mut_jr
+def _mut102(key):
+    return _mut_jr.split(key)' || exit 1
+mutate_and_expect BA301 core/om.py \
+    'from ba_tpu import obs as _mut_obs' || exit 1
 
 echo "== metrics JSONL schema check =="
 # Every record the layer emits must parse and carry event + v (schema

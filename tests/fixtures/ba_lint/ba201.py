@@ -1,0 +1,90 @@
+"""BA201 use-after-donate fixture (never imported; parsed by ba-lint)."""
+
+import functools
+
+import jax
+
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("n",))
+def megastep(state, sched, *, n=1):
+    return state + 1, sched + n
+
+
+@functools.partial(jax.jit, donate_argnames=("buf",))
+def named_donate(x, buf):
+    return x + buf
+
+
+def _plain(state):
+    return state * 2
+
+
+consuming = jax.jit(_plain, donate_argnums=(0,))
+
+
+def positive_read_after_donate(state, sched):
+    out = megastep(state, sched)
+    return state.sum()  # expect: BA201
+
+
+def positive_second_arg(state, sched):
+    out = megastep(state, sched)
+    hist = jnp.sum(sched)  # expect: BA201
+    return out, hist
+
+
+def positive_assigned_jit(state):
+    out = consuming(state)
+    return out + state  # expect: BA201
+
+
+def positive_kwarg_by_name(x, buf):
+    y = named_donate(x, buf=buf)
+    return y, buf  # expect: BA201
+
+
+def positive_loop_carried(state, sched):
+    outs = []
+    for _ in range(4):
+        out = megastep(state, sched)  # expect: BA201
+        outs.append(out)
+        # `state` is donated above and never rebound: the second
+        # iteration's call reads a deleted buffer.
+    return outs
+
+
+def negative_rethread(state, sched):
+    state, sched = megastep(state, sched)
+    return state.sum() + sched.sum()
+
+
+def negative_copy_before(state, sched):
+    keep = jax.tree.map(lambda x: x.copy(), state)
+    state, sched = megastep(state, sched)
+    return keep, state, sched
+
+
+def negative_branch_isolated(state, sched, flag):
+    if flag:
+        state, sched = megastep(state, sched)
+    return state.sum()  # donate happened only on the taken branch
+
+
+def negative_boolop_short_circuit(state, sched, flag):
+    # `and` may never evaluate its right side: the conditional donate
+    # must not poison the fall-through read, same as an `if` branch.
+    _ = flag and megastep(state, sched)
+    return state.sum()
+
+
+def negative_read_before(state, sched):
+    shape = state.shape
+    out = megastep(state, sched)
+    return shape, out
+
+
+def suppressed_deliberate(state, sched):
+    out = megastep(state, sched)
+    return state.is_deleted(), out  # ba-lint: disable=BA201

@@ -1,0 +1,96 @@
+"""BA202 rng-key-reuse fixture (never imported; parsed by ba-lint)."""
+
+import jax.random as jr
+import jax.random as aliased_random
+from jax.random import normal as nrm
+
+
+def positive_plain_reuse(key):
+    a = jr.normal(key, (2,))
+    b = jr.uniform(key, (2,))  # expect: BA202
+    return a + b
+
+
+def positive_through_aliases(key):
+    a = aliased_random.bernoulli(key, 0.5, (4,))
+    b = nrm(key, (4,))  # expect: BA202
+    return a, b
+
+
+def positive_loop_invariant(key):
+    acc = 0.0
+    for _ in range(8):
+        acc += jr.normal(key, ())  # expect: BA202
+    return acc
+
+
+def positive_after_derive_then_double(key):
+    k = jr.fold_in(key, 7)
+    a = jr.randint(k, (3,), 0, 10)
+    b = jr.permutation(k, 16)  # expect: BA202
+    return a, b
+
+
+def positive_derive_does_not_decorrelate(key):
+    # Keys are immutable: splitting `key` does not change what
+    # jr.normal(key) returns, so the second sampling still repeats the
+    # first — deriving must NOT clear the consumed mark.
+    a = jr.normal(key, (4,))
+    k1, k2 = jr.split(key)
+    b = jr.normal(key, (4,))  # expect: BA202
+    return a, b, k1, k2
+
+
+def negative_split_between(key):
+    a = jr.normal(key, (2,))
+    k1, k2 = jr.split(key)
+    b = jr.uniform(k1, (2,))
+    c = jr.uniform(k2, (2,))
+    return a, b, c
+
+
+def negative_fold_in_between(key):
+    a = jr.normal(key, (2,))
+    k2 = jr.fold_in(key, 1)
+    b = jr.uniform(k2, (2,))
+    return a, b
+
+
+def negative_inline_derivation(key):
+    a = jr.normal(jr.fold_in(key, 0), (2,))
+    b = jr.normal(jr.fold_in(key, 1), (2,))
+    return a, b
+
+
+def negative_rebound(key):
+    a = jr.normal(key, (2,))
+    key = jr.fold_in(key, 1)
+    b = jr.normal(key, (2,))
+    return a, b
+
+
+def negative_branches(key, flag):
+    if flag:
+        a = jr.normal(key, (2,))
+    else:
+        a = jr.uniform(key, (2,))
+    return a
+
+
+def negative_loop_derives(key):
+    acc = 0.0
+    for i in range(8):
+        acc += jr.normal(jr.fold_in(key, i), ())
+    return acc
+
+
+def negative_lambda_is_opaque(key):
+    fns = [lambda k=key: jr.normal(k, ())]
+    a = jr.normal(key, (2,))
+    return fns, a
+
+
+def suppressed_ab_replay(key):
+    a = jr.normal(key, (4,))
+    b = jr.normal(key, (4,))  # ba-lint: disable=BA202
+    return a, b

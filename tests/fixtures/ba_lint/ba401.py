@@ -1,0 +1,23 @@
+"""BA401 dead-import fixture (never imported; parsed by ba-lint)."""
+
+from __future__ import annotations
+
+import json
+import os as operating_system  # expect: BA401
+from datetime import datetime  # expect: BA401
+from functools import wraps  # expect: BA401
+
+import collections
+import collections.abc as cabc  # expect: BA401
+
+from json import JSONDecodeError as ReExported
+from json import dumps as _
+
+__all__ = ["ReExported", "used_everywhere"]
+
+
+def used_everywhere(blob):
+    # `json` used via attribute chain (base name counts); `collections`
+    # via a nested attribute.
+    payload = json.loads(blob)
+    return collections.OrderedDict(sorted(payload.items()))

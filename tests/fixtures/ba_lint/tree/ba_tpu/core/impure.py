@@ -1,0 +1,12 @@
+"""BA301 fixture: direct obs reference from a jitted-tree module."""
+
+from ba_tpu import obs as quietly_renamed  # expect: BA301 BA401
+from ba_tpu.obs.trace import span as sp  # expect: BA301 BA401
+from ba_tpu.utils import metrics as m
+
+from ba_tpu.core.pure import quorum_threshold
+
+
+def positive_emit_through_alias(decision):
+    m.emit({"event": "round", "decision": decision})  # expect: BA301
+    return quorum_threshold(decision)

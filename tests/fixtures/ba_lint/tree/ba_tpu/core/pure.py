@@ -1,0 +1,9 @@
+"""BA301 fixture: a clean jitted-tree module (all negatives)."""
+
+import jax.numpy as jnp
+
+from ba_tpu.utils.helpers import clamp
+
+
+def quorum_threshold(n):
+    return clamp(jnp.asarray(n) // 3 + 1)

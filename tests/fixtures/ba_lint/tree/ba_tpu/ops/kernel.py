@@ -1,0 +1,14 @@
+"""BA301 fixture: transitive contamination through the jitted tree.
+
+This module never names obs — but it imports ``ba_tpu.core.impure``,
+a jitted-tree module whose closure reaches ``ba_tpu.obs``.  The grep
+this rule replaced could not see this at all.
+"""
+
+from ba_tpu.core.impure import positive_emit_through_alias  # expect: BA301
+
+from ba_tpu.core.pure import quorum_threshold
+
+
+def body(x):
+    return positive_emit_through_alias(x) + quorum_threshold(x)

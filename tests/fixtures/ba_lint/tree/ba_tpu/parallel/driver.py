@@ -1,0 +1,32 @@
+"""Cross-module BA201 + parallel-wide BA101 fixture (never run).
+
+``step`` donates in ``pipeline.py``; the call sites here prove the
+donation registry resolves through import aliases across modules, and
+that the ``pipeline_sweep`` CONVENTION entry (donates ``state``, arg 1)
+applies to importers by qualified name.  ``block_until_ready`` is
+banned across ALL of ``ba_tpu.parallel``, not just the two
+conversion-scoped modules.
+"""
+
+from ba_tpu.parallel.pipeline import pipeline_sweep, step as megastep
+
+
+def positive_cross_module_donate(state, keys):
+    out = megastep(state, keys)
+    return state  # expect: BA201
+
+
+def positive_convention_donate(key, state):
+    out = pipeline_sweep(key, state, 64)
+    hist = out["histograms"]
+    return hist, state.shape  # expect: BA201
+
+
+def positive_sync_outside_conversion_scope(x):
+    return x.block_until_ready()  # expect: BA101
+
+
+def negative_key_survives(key, state):
+    state2 = pipeline_sweep(key, state, 64)["final_state"]
+    probe = pipeline_sweep(key, state2, 1)
+    return probe

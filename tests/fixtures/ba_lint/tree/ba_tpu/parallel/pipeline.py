@@ -1,0 +1,55 @@
+"""BA101/BA102 fixture: scoped as ba_tpu.parallel.pipeline (never run).
+
+The alias tricks here are the whole point: the old greps matched
+``\\bnp\\.asarray`` and ``jr\\.split`` as TEXT, so ``import numpy as
+jnp_like`` slipped through and ``import jax.numpy as np`` false-
+positived.  ba-lint resolves both.
+"""
+
+import os
+
+import functools
+
+import jax
+import jax.numpy as np
+import jax.random as jr
+import numpy as jnp_like
+from jax.random import split as sp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, keys):
+    return state
+
+
+def positive_host_sync(x, state):
+    jax.block_until_ready(x)  # expect: BA101
+    y = x.block_until_ready()  # expect: BA101
+    h = jnp_like.asarray(x)  # expect: BA101
+    v = x.item()  # expect: BA101
+    t = x.tolist()  # expect: BA101
+    n = int(np.sum(x))  # expect: BA101
+    return y, h, v, t, n
+
+
+def positive_host_keys(key, xs):
+    k1, k2 = jr.split(key)  # expect: BA102
+    k3 = sp(k2, 3)  # expect: BA102
+    out = []
+    for i, x in enumerate(xs):
+        out.append(jr.fold_in(key, i))  # expect: BA102
+    return k1, k3, out
+
+
+def negative_device_side(x, key, sched_counter):
+    # jax.numpy is device-side whatever it is locally named; fold_in
+    # OUTSIDE a host loop is the sanctioned round_keys-style derivation.
+    a = np.asarray(x)
+    b = np.array([1, 2, 3])
+    kr = jr.fold_in(key, sched_counter)
+    n = int(os.environ.get("DEPTH", 2))
+    return a, b, kr, n
+
+
+def suppressed_sanctioned_drain(x):
+    return x.item()  # ba-lint: disable=BA101

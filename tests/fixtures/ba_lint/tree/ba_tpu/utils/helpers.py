@@ -1,0 +1,15 @@
+"""BA301 fixture: a HOST-layer module may reach obs (boundary case).
+
+``core.pure`` imports this module; this module references obs.  That
+must NOT contaminate ``core.pure`` — the closure follows edges only
+through jitted-tree (core/ops) modules, because host-layer utilities
+legitimately instrument their own host paths (the real
+``utils/platform.py`` -> ``obs.instrument`` chain).
+"""
+
+from ba_tpu.obs import default_registry
+
+
+def clamp(x):
+    default_registry().counter("clamp_calls_total").inc()
+    return x

@@ -76,7 +76,6 @@ def worker_results(tmp_path_factory):
 
 
 def test_two_process_mesh_matches_single_process(worker_results, eight_devices):
-    import jax
     import jax.random as jr
     from jax.sharding import PartitionSpec as P
 
