@@ -40,14 +40,26 @@ PARSE_ERROR_CODE = "BA900"
 _SKIP_DIRS = {"__pycache__", ".git"}
 
 
-def discover(paths) -> list:
-    """``(abs_path, display_path)`` for every ``.py`` under ``paths``."""
+def discover(paths, exclude=()) -> list:
+    """``(abs_path, display_path)`` for every ``.py`` under ``paths``.
+
+    ``exclude`` entries are file-or-directory path prefixes (resolved
+    absolute, so ``tests/fixtures/ba_lint`` works from the repo root):
+    anything at or under one is skipped — the CI spelling for "lint
+    ``tests/`` but not the deliberately-violating lint fixtures".
+    """
     out = []
     seen = set()
+    excluded = tuple(os.path.abspath(e) for e in exclude)
+
+    def is_excluded(ap: str) -> bool:
+        return any(
+            ap == e or ap.startswith(e + os.sep) for e in excluded
+        )
 
     def add(p: str) -> None:
         ap = os.path.abspath(p)
-        if ap in seen:
+        if ap in seen or is_excluded(ap):
             return
         seen.add(ap)
         rel = os.path.relpath(ap)
@@ -63,7 +75,9 @@ def discover(paths) -> list:
             dirs[:] = sorted(
                 d
                 for d in dirs
-                if d not in _SKIP_DIRS and not d.startswith(".")
+                if d not in _SKIP_DIRS
+                and not d.startswith(".")
+                and not is_excluded(os.path.abspath(os.path.join(root, d)))
             )
             for f in sorted(files):
                 if f.endswith(".py"):
@@ -71,11 +85,13 @@ def discover(paths) -> list:
     return sorted(out, key=lambda t: t[1])
 
 
-def run_paths(paths, rule_codes=None):
+def run_paths(paths, rule_codes=None, exclude=()):
     """Analyze ``paths``; returns ``(findings, suppressed, files_scanned)``.
 
     ``findings``/``suppressed`` are location-sorted :class:`Finding`
-    lists; ``rule_codes`` (e.g. ``{"BA101"}``) restricts the rule set.
+    lists; ``rule_codes`` (e.g. ``{"BA101"}``) restricts the rule set;
+    ``exclude`` prunes path prefixes from discovery (see
+    :func:`discover`).
     """
     rules = [
         r
@@ -84,7 +100,7 @@ def run_paths(paths, rule_codes=None):
     ]
     modules = []
     findings = []
-    for ap, disp in discover(paths):
+    for ap, disp in discover(paths, exclude):
         with open(ap, encoding="utf-8") as fh:
             source = fh.read()
         try:
@@ -166,6 +182,15 @@ def main(argv=None) -> int:
         help="comma-separated rule codes to run (default: all)",
     )
     parser.add_argument(
+        "--exclude",
+        action="append",
+        default=[],
+        metavar="PATH",
+        help="path prefix to skip (repeatable) — e.g. "
+             "--exclude tests/fixtures/ba_lint keeps the deliberately-"
+             "violating fixtures out of a tests/ lint run",
+    )
+    parser.add_argument(
         "--list-rules",
         action="store_true",
         help="print the rule table and exit",
@@ -188,7 +213,9 @@ def main(argv=None) -> int:
                 f"(known: {', '.join(sorted(known))})"
             )
     try:
-        active, suppressed, files = run_paths(args.paths, selected)
+        active, suppressed, files = run_paths(
+            args.paths, selected, exclude=args.exclude
+        )
     except FileNotFoundError as exc:
         parser.error(str(exc))
 

@@ -11,16 +11,24 @@ Three parts, layered bottom-up (docs/DESIGN.md §8):
 - **tracer** (``obs.trace``): thread-safe monotonic ring-buffer span
   tracing with Chrome trace-event export (Perfetto /
   ``chrome://tracing``), ``BA_TPU_TRACE`` enables.
+- **device tier** (``obs.xla``, docs/DESIGN.md §8): XLA artifact
+  introspection (``compiled_artifact`` records with flops / bytes /
+  donation-alias evidence, ``BA_TPU_HLO`` dumps), the recompile
+  explainer (``obs.instrument.classify_compile`` → ``recompile``
+  records), and the ``jax.profiler`` capture hook (``BA_TPU_XPROF``).
 
-Everything here is HOST-side and jax-free: spans and emissions must
-never appear inside jitted or scanned bodies (``scripts/ci.sh`` lints
-``ba_tpu/core`` and ``ba_tpu/ops`` for exactly that), and with both env
-vars unset the layer writes no files and grows no buffers — the
-overhead-guard tests in tests/test_obs.py pin it.
+Everything MODULE-LEVEL here is HOST-side and jax-free (``obs.xla``
+imports jax only inside its opt-in functions): spans and emissions must
+never appear inside jitted or scanned bodies (ba-lint BA301 checks the
+``ba_tpu/core``/``ba_tpu/ops`` closure for exactly that), and with the
+``BA_TPU_*`` env vars unset the layer writes no files, grows no
+buffers, and triggers no extra compiles — the overhead-guard tests in
+tests/test_obs.py and tests/test_obs_xla.py pin it.
 """
 
-from ba_tpu.obs import instrument, registry, trace
+from ba_tpu.obs import instrument, registry, trace, xla
 from ba_tpu.obs.instrument import (
+    classify_compile,
     compile_or_dispatch_span,
     first_call,
     reset_first_calls,
@@ -32,6 +40,7 @@ from ba_tpu.obs.trace import Tracer, default_tracer, instant, span
 __all__ = [
     "MetricsRegistry",
     "Tracer",
+    "classify_compile",
     "compile_or_dispatch_span",
     "default_registry",
     "default_tracer",
@@ -43,4 +52,5 @@ __all__ = [
     "span",
     "timed_span",
     "trace",
+    "xla",
 ]

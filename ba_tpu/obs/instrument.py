@@ -26,7 +26,23 @@ Canonical metric names (so dashboards/tests never chase spellings):
 - ``round_wall_s``                 histogram, interactive round wall time
 - ``host_sign_s``                  histogram, host signing batches
 - ``elections_total`` / ``failover_kills_total``  counters
+- ``recompiles_total``             counter, explained re-specializations
 - ``compile_cache_enabled``        gauge, 0/1
+- ``xla_introspect_s``             histogram, AOT artifact-harvest cost
+- ``xla_<fn>_flops`` / ``_bytes_accessed`` / ``_temp_bytes`` /
+  ``_alias_bytes``                 gauges, per-program cost/memory
+  (``obs/xla.py`` artifact introspection)
+
+The **recompile explainer** (ISSUE 4) extends ``first_call``: callers
+that pass a NAMED ``axes`` signature (shapes/dtypes/capacity/depth/
+static args as a dict) get more than a compile/dispatch phase — when a
+function that already compiled once compiles AGAIN, the explainer diffs
+the new signature against the previous one and emits a ``recompile``
+instant plus a versioned ``{"event": "recompile", "v": 1, "fn": ...,
+"changed": {axis: [old, new]}}`` JSONL record naming exactly the axis
+that forced the re-specialization.  ``runtime/backends.py``'s
+per-capacity re-specialization becomes attributable ("capacity: 4 ->
+8") instead of a mysterious second ``compile`` span.
 """
 
 from __future__ import annotations
@@ -37,6 +53,7 @@ import time
 
 _seen: set = set()
 _seen_lock = threading.Lock()
+_last_axes: dict = {}  # fn name -> axes dict of its most recent compile
 
 
 def first_call(key) -> bool:
@@ -52,10 +69,49 @@ def first_call(key) -> bool:
         return True
 
 
+def _freeze(value):
+    """A hashable, order-stable form of an axes value (dicts/lists from
+    callers become tuples; everything else is already hashable)."""
+    if isinstance(value, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v) for v in value)
+    return value
+
+
+def classify_compile(fn: str, axes: dict):
+    """``(first_call, changed)`` for one named compile signature.
+
+    ``first_call`` is True exactly once per (fn, axes) — the same
+    classification :func:`first_call` gives, keyed on the caller's named
+    signature instead of an opaque tuple.  ``changed`` is non-None only
+    on a RE-compile (fn seen before under a different signature): a
+    ``{axis: [previous, new]}`` diff against the function's most recent
+    compile, the explainer's payload.
+    """
+    key = (fn, _freeze(axes))
+    with _seen_lock:
+        if key in _seen:
+            return False, None
+        _seen.add(key)
+        prev = _last_axes.get(fn)
+        _last_axes[fn] = dict(axes)
+    if prev is None:
+        return True, None
+    changed = {
+        k: [prev.get(k), axes[k]]
+        for k in axes
+        if prev.get(k) != axes.get(k)
+    }
+    return True, changed or None
+
+
 def reset_first_calls() -> None:
-    """Forget all seen keys (tests that pin ``compile`` span emission)."""
+    """Forget all seen keys and signatures (tests that pin ``compile``
+    span / ``recompile`` record emission)."""
     with _seen_lock:
         _seen.clear()
+        _last_axes.clear()
 
 
 class TimedBox:
@@ -93,7 +149,7 @@ def timed_span(name: str, histogram=None, **attrs):
 
 
 @contextlib.contextmanager
-def compile_or_dispatch_span(key, **attrs):
+def compile_or_dispatch_span(key, axes=None, **attrs):
     """Span a jitted call as ``compile`` (first call of ``key``) or
     ``dispatch`` (cached), yielding the chosen phase name.
 
@@ -103,10 +159,23 @@ def compile_or_dispatch_span(key, **attrs):
     histogram.  The span measures host-side time only — for an async
     dispatch that is trace + compile (or persistent-cache load) on the
     first call and just the enqueue afterwards.
+
+    ``axes`` opts into the recompile explainer: a dict naming the static
+    signature (shapes, capacity, depth, flags...).  Classification then
+    keys on ``(key's function name, axes)`` and a re-specialization of a
+    previously-compiled function emits the ``recompile`` instant +
+    JSONL record with the per-axis diff (module docstring).
     """
     from ba_tpu.obs import registry, trace
 
-    phase = "compile" if first_call(key) else "dispatch"
+    if axes is None:
+        phase = "compile" if first_call(key) else "dispatch"
+        changed = None
+        fn = None
+    else:
+        fn = key[0] if isinstance(key, tuple) and key else str(key)
+        first, changed = classify_compile(fn, axes)
+        phase = "compile" if first else "dispatch"
     t0 = time.perf_counter()
     with trace.default_tracer().span(phase, **attrs):
         yield phase
@@ -114,6 +183,29 @@ def compile_or_dispatch_span(key, **attrs):
         registry.default_registry().histogram("compile_time_s").record(
             time.perf_counter() - t0
         )
+        if changed:
+            _emit_recompile(fn, axes, changed)
+
+
+def _emit_recompile(fn: str, axes: dict, changed: dict) -> None:
+    """One ``recompile`` instant + versioned JSONL record naming the
+    axis/axes whose change forced the re-specialization."""
+    from ba_tpu.obs import registry, trace
+    from ba_tpu.utils import metrics
+
+    registry.default_registry().counter("recompiles_total").inc()
+    trace.default_tracer().instant(
+        "recompile", fn=fn, changed=",".join(sorted(changed))
+    )
+    metrics.emit(
+        {
+            "event": "recompile",
+            "v": metrics.SCHEMA_VERSION,
+            "fn": fn,
+            "changed": changed,
+            "axes": dict(axes),
+        }
+    )
 
 
 def report_compile_cache(path: str | None) -> None:

@@ -1,0 +1,266 @@
+"""Device-tier observability: XLA artifact introspection and profiler
+capture — what the compiled program actually costs, below the dispatch
+boundary the host spans (``obs/trace.py``) cannot see.
+
+Three capabilities, all opt-in and zero-cost when disabled:
+
+- **Artifact introspection** (:func:`introspect`): AOT lower + compile a
+  jitted callable against the abstract shapes of a real call, harvest
+  XLA's ``cost_analysis()`` (flops, bytes accessed) and
+  ``memory_analysis()`` (argument / output / temp / **alias** bytes)
+  into registry gauges plus ONE versioned
+  ``{"event": "compiled_artifact", "v": 1, ...}`` JSONL record per
+  compile key.  ``alias_bytes`` is the load-bearing number: it is how
+  many input bytes XLA aliased onto outputs, i.e. direct evidence that
+  the ``donate_argnums`` contract (``parallel/pipeline.py``) actually
+  held — a donation regression shows up as ``alias_bytes: 0`` in the
+  artifact, not as a silent 2x allocation rate.  Callers gate on
+  :func:`enabled` (the JSONL sink is live, or ``BA_TPU_HLO`` is set) so
+  the disabled path never imports jax from here, never compiles, and
+  never emits.
+- **HLO dumps** (``BA_TPU_HLO=dir``): alongside each artifact record,
+  write the lowered StableHLO and the backend-optimized HLO text of the
+  compiled executable into ``dir`` — the raw material for "what did XLA
+  do to my megastep" questions the numbers alone can't answer.
+- **Profiler capture hook** (``BA_TPU_XPROF=dir`` / ``bench.py
+  --xprof``): a :func:`xprof_session` context manager around
+  ``jax.profiler.start_trace``/``stop_trace`` plus :func:`annotate` —
+  ``jax.profiler.TraceAnnotation`` markers the engine places on megastep
+  dispatch and retire so the device timeline (TensorBoard / xprof)
+  aligns with the host span trace's phases.
+
+Caveats, stated so nobody re-learns them: an AOT ``.compile()`` does NOT
+share jit's executable cache, so introspection pays one extra compile
+per specialization (a persistent-cache load when
+``BA_TPU_COMPILE_CACHE`` is on; seconds on CPU, potentially a minute
+through the TPU tunnel — which is why it only runs when the sink or an
+HLO dir asks for it).  Meshed calls are introspected at their UNSHARDED
+global shapes (the sharded executable may differ in layout; flops and
+alias accounting are shape-level properties and carry over).
+
+This module must stay importable without jax (``ba_tpu.obs`` pulls it in
+unconditionally): every jax import lives inside a function body.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import sys
+
+_HLO_ENV = "BA_TPU_HLO"
+_XPROF_ENV = "BA_TPU_XPROF"
+
+# Record fields harvested from CompiledMemoryStats, in record order.
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+_warned_fns: set = set()
+
+
+def hlo_dir() -> str | None:
+    """The HLO dump directory (``BA_TPU_HLO``), or None."""
+    return os.environ.get(_HLO_ENV) or None
+
+
+def enabled() -> bool:
+    """Should :func:`introspect` run at all?
+
+    True when the JSONL sink is live (``BA_TPU_METRICS`` / ``bench.py
+    --obs``) or an HLO dump directory is configured — the two consumers
+    of the artifact.  Everything else (no ``BA_TPU_*`` set) stays on the
+    zero-records, zero-extra-compiles path.
+    """
+    if hlo_dir() is not None:
+        return True
+    from ba_tpu.utils import metrics
+
+    return metrics.default_sink().enabled
+
+
+def abstractify(tree):
+    """Concrete arrays -> ShapeDtypeStructs (lowering never touches or
+    consumes buffers this way).  Callers that introspect AFTER a
+    donating dispatch capture the abstract signature with this BEFORE
+    the buffers are consumed; idempotent on already-abstract values."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if hasattr(x, "shape") and hasattr(x, "dtype")
+        else x,
+        tree,
+    )
+
+
+def _compile_uncached(lowered):
+    """AOT-compile with the persistent XLA cache bypassed.
+
+    A persistent-cache HIT deserializes the executable with EMPTY memory
+    stats — ``memory_analysis()`` then reports ``alias_bytes: 0`` and
+    the donation evidence silently degrades to "donation broken" on any
+    warm process (measured on jax 0.4.37 / CPU: first compile 1024
+    alias bytes, cache-hit recompile 0).  Introspection wants the
+    analysis, not the compile-time saving, so it pays the real compile.
+
+    Flipping ``jax_enable_compilation_cache`` alone is NOT enough:
+    ``compilation_cache.is_cache_used`` memoizes its decision on first
+    use, so a warm process ignores the flag.  ``reset_cache()`` clears
+    that memo (both directions — the second call below re-arms the
+    restored setting for the rest of the process).
+    """
+    import jax
+    from jax.experimental.compilation_cache import compilation_cache as cc
+
+    enabled = jax.config.jax_enable_compilation_cache
+    try:
+        jax.config.update("jax_enable_compilation_cache", False)
+        cc.reset_cache()
+        return lowered.compile()
+    finally:
+        jax.config.update("jax_enable_compilation_cache", enabled)
+        cc.reset_cache()
+
+
+def _scalar(analysis, field):
+    """One named scalar out of a cost_analysis dict (or list of them)."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    try:
+        return float(analysis.get(field, 0.0))
+    except (AttributeError, TypeError, ValueError):
+        return 0.0
+
+
+def introspect(jitted, fn: str, args=(), kwargs=None, axes=None):
+    """AOT-compile ``jitted`` at the abstract signature of ``args`` /
+    ``kwargs`` and emit one ``compiled_artifact`` record.
+
+    Returns the record dict, or None when disabled or when the backend
+    refuses the analysis (one warning per ``fn``, never an exception —
+    introspection must not take the agreement path down with it).
+    ``axes`` is the caller's named static signature (the same dict the
+    recompile explainer sees); it rides the record so artifacts are
+    joinable against ``recompile`` records and host spans.
+    """
+    if not enabled():
+        return None
+    from ba_tpu import obs
+    from ba_tpu.utils import metrics
+
+    try:
+        with obs.timed_span("xla_introspect", "xla_introspect_s", fn=fn):
+            abs_args = abstractify(tuple(args))
+            abs_kwargs = abstractify(dict(kwargs or {}))
+            lowered = jitted.lower(*abs_args, **abs_kwargs)
+            compiled = _compile_uncached(lowered)
+            try:
+                cost = compiled.cost_analysis()
+            except Exception:  # some backends only analyze pre-compile
+                cost = lowered.cost_analysis()
+            mem = compiled.memory_analysis()
+        record = {
+            "event": "compiled_artifact",
+            "v": metrics.SCHEMA_VERSION,
+            "fn": fn,
+            "axes": dict(axes or {}),
+            "flops": _scalar(cost, "flops"),
+            "bytes_accessed": _scalar(cost, "bytes accessed"),
+        }
+        for attr, field in _MEMORY_FIELDS:
+            record[field] = int(getattr(mem, attr, 0)) if mem is not None else 0
+        record["donation_aliased"] = record["alias_bytes"] > 0
+        record["hlo_dump"] = _dump_hlo(fn, record["axes"], lowered, compiled)
+    except Exception as exc:  # best-effort: warn once per fn, move on
+        if fn not in _warned_fns:
+            _warned_fns.add(fn)
+            print(
+                f"ba_tpu.obs.xla: introspection of {fn!r} failed ({exc!r}); "
+                f"skipping",
+                file=sys.stderr,
+            )
+        return None
+    metrics.emit(record)
+    reg = obs.default_registry()
+    for field in ("flops", "bytes_accessed", "temp_bytes", "alias_bytes"):
+        reg.gauge(f"xla_{fn}_{field}").set(record[field])
+    obs.instant(
+        "compiled_artifact",
+        fn=fn,
+        flops=record["flops"],
+        alias_bytes=record["alias_bytes"],
+    )
+    return record
+
+
+def _dump_hlo(fn: str, axes: dict, lowered, compiled) -> str | None:
+    """Write StableHLO + optimized-HLO text under ``BA_TPU_HLO`` (one
+    stable name per (fn, axes) so re-runs overwrite, not accumulate).
+    Returns the common path stem, or None when dumping is off."""
+    directory = hlo_dir()
+    if directory is None:
+        return None
+    tag = hashlib.sha256(
+        json.dumps(axes, sort_keys=True, default=str).encode()
+    ).hexdigest()[:10]
+    os.makedirs(directory, exist_ok=True)
+    stem = os.path.join(directory, f"{fn}-{tag}")
+    with open(stem + ".stablehlo.txt", "w") as fh:
+        fh.write(lowered.as_text())
+    try:
+        optimized = compiled.as_text()
+    except Exception:  # pragma: no cover - backend without HLO text
+        optimized = ""
+    if optimized:
+        with open(stem + ".optimized.txt", "w") as fh:
+            fh.write(optimized)
+    return stem
+
+
+# -- jax.profiler capture hook ------------------------------------------------
+
+_xprof_active = False
+
+
+def xprof_active() -> bool:
+    """A capture session is running, or ``BA_TPU_XPROF`` asks for
+    annotations (TraceMe markers are cheap and harmless un-captured)."""
+    return _xprof_active or bool(os.environ.get(_XPROF_ENV))
+
+
+def annotate(name: str, **attrs):
+    """A ``jax.profiler.TraceAnnotation`` when capture is active, else a
+    free nullcontext — the engine wraps megastep dispatch/retire in this
+    so the device timeline carries the same phase names as the host
+    trace."""
+    if not xprof_active():
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name, **attrs)
+
+
+@contextlib.contextmanager
+def xprof_session(directory: str):
+    """Capture a ``jax.profiler`` trace of the enclosed block into
+    ``directory`` (view with TensorBoard/xprof).  ``bench.py --xprof
+    DIR`` wraps its config loop in this; ``BA_TPU_XPROF=dir`` is the
+    env spelling bench honors as the flag's default."""
+    global _xprof_active
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    jax.profiler.start_trace(directory)
+    _xprof_active = True
+    try:
+        yield directory
+    finally:
+        _xprof_active = False
+        jax.profiler.stop_trace()

@@ -25,7 +25,9 @@ a slice (its all_gathers want ICI bandwidth).
 from ba_tpu.parallel.mesh import make_mesh
 from ba_tpu.parallel.multihost import init_distributed, make_global_mesh, put_global
 from ba_tpu.parallel.pipeline import (
+    COUNTER_NAMES,
     KeySchedule,
+    agreement_counters_init,
     fresh_copy,
     make_key_schedule,
     pipeline_megastep,
@@ -47,7 +49,9 @@ __all__ = [
     "init_distributed",
     "make_global_mesh",
     "put_global",
+    "COUNTER_NAMES",
     "KeySchedule",
+    "agreement_counters_init",
     "fresh_copy",
     "make_key_schedule",
     "pipeline_megastep",

@@ -64,8 +64,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ba_tpu import obs
 from ba_tpu.core.state import SimState
+from ba_tpu.core.types import UNDEFINED
 from ba_tpu.parallel.multihost import put_global
 from ba_tpu.parallel.sweep import agreement_step
+
+# On-device agreement counters (ISSUE 4): one int32 per name, riding the
+# donated scan carry as pure data — folded in-scan, drained only at the
+# engine's existing depth-delayed retire fetch (counter rows piggyback
+# the histogram block), so BA101 and the no-blocking test stay clean.
+COUNTER_NAMES = ("quorum_failures", "unanimous_rounds", "equivocation_observed")
 
 
 @jax.tree_util.register_dataclass
@@ -127,6 +134,44 @@ def round_keys(sched: KeySchedule, batch: int) -> jax.Array:
     )
 
 
+def agreement_counters_init() -> jax.Array:
+    """A zeroed on-device counter block (one int32 per COUNTER_NAMES)."""
+    return jnp.zeros((len(COUNTER_NAMES),), jnp.int32)
+
+
+def agreement_counter_delta(out: dict, state: SimState) -> jax.Array:
+    """One round's counter increments, derived ON DEVICE (trace-time,
+    called inside the compiled scan body) from ``agreement_step``'s
+    outputs — the paper's agreement semantics as values, not emissions:
+
+    - ``quorum_failures``: instances whose quorum decision this round is
+      UNDEFINED (no side reached the majority-of-majorities threshold);
+    - ``unanimous_rounds``: 1 when every instance in the batch decided
+      alike (the histogram concentrates in one bin);
+    - ``equivocation_observed``: instances containing at least one live
+      traitor whose alive lieutenants' majorities DISAGREE — the visible
+      footprint of per-recipient equivocation (a faulty responder
+      answering different queriers differently; honest-only instances
+      always tally unanimously under an honest leader).
+
+    Every count is host-reproducible from the decisions/majorities
+    streams (tests/test_pipeline.py pins the bit-match).
+    """
+    decision = out["decision"]
+    maj = out["majorities"]
+    quorum_failures = jnp.sum(decision == UNDEFINED, dtype=jnp.int32)
+    unanimous = (out["histogram"].max() == decision.shape[0]).astype(jnp.int32)
+    idx = jnp.arange(state.faulty.shape[1])[None, :]
+    lieutenants = state.alive & (idx != state.leader[:, None])
+    big = jnp.asarray(127, maj.dtype)
+    mmax = jnp.max(jnp.where(lieutenants, maj, -big), axis=1)
+    mmin = jnp.min(jnp.where(lieutenants, maj, big), axis=1)
+    disagree = (mmax != mmin) & lieutenants.any(axis=1)
+    traitor_present = (state.faulty & state.alive).any(axis=1)
+    equivocation = jnp.sum(disagree & traitor_present, dtype=jnp.int32)
+    return jnp.stack([quorum_failures, unanimous, equivocation])
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("rounds", "m", "max_liars", "unroll", "collect_decisions"),
@@ -141,39 +186,55 @@ def pipeline_megastep(
     max_liars: int | None = None,
     unroll: int = 1,
     collect_decisions: bool = False,
+    counters: jax.Array | None = None,
 ):
     """``rounds`` agreement rounds in one donated ``lax.scan`` dispatch.
 
-    Returns ``(state, sched, histograms[, decisions])``: the state rides
-    through unchanged and the schedule advances by ``rounds``, both
-    aliased onto the donated inputs so steady-state dispatches allocate
-    nothing but the outputs (``histograms`` [rounds, 3] int32 and, when
-    ``collect_decisions``, ``decisions`` [rounds, B] int8).
+    Returns ``(state, sched, histograms[, decisions][, counter_rounds])``:
+    the state rides through unchanged and the schedule advances by
+    ``rounds``, both aliased onto the donated inputs so steady-state
+    dispatches allocate nothing but the outputs (``histograms``
+    [rounds, 3] int32 and, when ``collect_decisions``, ``decisions``
+    [rounds, B] int8).
+
+    ``counters`` (a block from :func:`agreement_counters_init`, or the
+    previous dispatch's last ``counter_rounds`` row) enables the
+    on-device agreement counters: the block rides the scan carry,
+    :func:`agreement_counter_delta` folds each round's increments in,
+    and ``counter_rounds`` [rounds, len(COUNTER_NAMES)] holds the
+    CUMULATIVE block after every round — its last row both continues the
+    counter thread into the next dispatch and reaches the host for free
+    inside the existing retire fetch.  Counters are pure data in the
+    compiled program: no host emission, no added synchronization.
 
     Bit-compat contract: round ``sched.counter + r`` computes exactly
     ``agreement_step(round_keys(<schedule at counter + r>, B), state)`` —
     the round-by-round blocking driver under the same key schedule
-    produces identical decisions and histograms (tests/test_pipeline.py).
+    produces identical decisions and histograms (tests/test_pipeline.py),
+    with or without the counter block (counters read the step's outputs,
+    never its RNG).
     """
+    with_counters = counters is not None
 
     def body(carry, _):
-        st, sc = carry
+        if with_counters:
+            st, sc, ctr = carry
+        else:
+            st, sc = carry
         keys = round_keys(sc, st.batch)
         out = agreement_step(keys, st, m=m, max_liars=max_liars)
         nxt = KeySchedule(sc.key_data, sc.counter + 1)
-        ys = (
-            (out["histogram"], out["decision"])
-            if collect_decisions
-            else out["histogram"]
-        )
+        ys = (out["histogram"],)
+        if collect_decisions:
+            ys += (out["decision"],)
+        if with_counters:
+            ctr = ctr + agreement_counter_delta(out, st)
+            return (st, nxt, ctr), ys + (ctr,)
         return (st, nxt), ys
 
-    (state, sched), ys = jax.lax.scan(
-        body, (state, sched), None, length=rounds, unroll=unroll
-    )
-    if collect_decisions:
-        return state, sched, ys[0], ys[1]
-    return state, sched, ys
+    init = (state, sched, counters) if with_counters else (state, sched)
+    carry, ys = jax.lax.scan(body, init, None, length=rounds, unroll=unroll)
+    return (carry[0], carry[1], *ys)
 
 
 def pipeline_sweep(
@@ -187,6 +248,7 @@ def pipeline_sweep(
     rounds_per_dispatch: int = 1,
     unroll: int = 1,
     collect_decisions: bool = False,
+    with_counters: bool = False,
     host_work=None,
     mesh: Mesh | None = None,
     on_event=None,
@@ -214,6 +276,14 @@ def pipeline_sweep(
     - ``histograms`` [rounds, 3] host int32 — per-round [retreat, attack,
       undefined] decision counts (fetched at retire time, never earlier);
     - ``decisions`` [rounds, B] host int8 when ``collect_decisions``;
+    - with ``with_counters``: ``counters`` — a ``{name: int}`` dict of
+      the final on-device agreement counter block (COUNTER_NAMES),
+      ``counters_per_round`` [rounds, len(COUNTER_NAMES)] host int32
+      cumulative rows, and ``final_counters`` — the live device block
+      continuing the counter thread.  Counter rows piggyback the
+      existing retire fetch (they ride ``ys``), so enabling them adds
+      ZERO host synchronization; the final values also land in registry
+      gauges ``agreement_<name>``;
     - ``final_state`` / ``final_schedule`` — the live (un-donated) pair,
       ready to continue the sweep;
     - ``stats`` — dispatch bookkeeping: ``dispatches``, ``depth``,
@@ -233,6 +303,7 @@ def pipeline_sweep(
         raise ValueError(f"unroll={unroll} must be >= 1")
 
     sched = make_key_schedule(key)
+    counters = agreement_counters_init() if with_counters else None
     if mesh is not None:
         state = jax.tree.map(
             lambda x: put_global(
@@ -243,6 +314,11 @@ def pipeline_sweep(
         sched = jax.tree.map(
             lambda x: put_global(mesh, x, P(*([None] * x.ndim))), sched
         )
+        if counters is not None:
+            # Replicated like the schedule: every shard folds the same
+            # global deltas (agreement_counter_delta reduces over the
+            # full batch, which XLA turns into the histogram's psum).
+            counters = put_global(mesh, counters, P(None))
 
     chunks = [rounds_per_dispatch] * (rounds // rounds_per_dispatch)
     if rounds % rounds_per_dispatch:
@@ -272,8 +348,11 @@ def pipeline_sweep(
         with obs.timed_span("retire", lag_h, dispatch=d):
             # The ONLY blocking operation in the engine: fetch dispatch
             # d's outputs, which waits on a dispatch `depth` behind the
-            # queue head while later rounds keep the device busy.
-            retired.append(jax.device_get(ys))
+            # queue head while later rounds keep the device busy.  (The
+            # xla.annotate marker aligns this host phase with the device
+            # timeline when a BA_TPU_XPROF capture is running.)
+            with obs.xla.annotate("megastep_retire", dispatch=d):
+                retired.append(jax.device_get(ys))
         lat_h.record((time.perf_counter_ns() - t_sub) / 1e9)
         ret_c.inc()
         if on_event is not None:
@@ -283,34 +362,63 @@ def pipeline_sweep(
         # First dispatch of a fresh static specialization pays trace +
         # compile (or a persistent-cache load) synchronously before the
         # async dispatch; later ones are cached dispatches — the span is
-        # named accordingly (obs.compile_or_dispatch_span).
-        ckey = (
-            "pipeline_megastep",
-            state.faulty.shape,
-            nr,
-            m,
-            max_liars,
-            min(unroll, nr),
-            collect_decisions,
-            # Sharded inputs force a fresh specialization even at equal
-            # shapes/statics — key on it so the meshed first call still
-            # classifies as "compile".
-            mesh is not None,
+        # named accordingly, and the NAMED axes signature feeds the
+        # recompile explainer (a later re-specialization emits a
+        # `recompile` record diffing exactly these axes).  "meshed"
+        # rides the axes because sharded inputs force a fresh
+        # specialization even at equal shapes/statics.
+        kwargs = dict(
+            rounds=nr,
+            m=m,
+            max_liars=max_liars,
+            unroll=min(unroll, nr),
+            collect_decisions=collect_decisions,
+            counters=counters,
         )
-        with obs.compile_or_dispatch_span(ckey, dispatch=d, rounds=nr):
-            out = pipeline_megastep(
-                state,
-                sched,
-                rounds=nr,
-                m=m,
-                max_liars=max_liars,
-                unroll=min(unroll, nr),
-                collect_decisions=collect_decisions,
+        axes = {
+            "batch": state.faulty.shape[0],
+            "capacity": state.faulty.shape[1],
+            "rounds": nr,
+            "m": m,
+            "max_liars": max_liars,
+            "unroll": min(unroll, nr),
+            "collect_decisions": collect_decisions,
+            "counters": with_counters,
+            "meshed": mesh is not None,
+        }
+        with obs.compile_or_dispatch_span(
+            "pipeline_megastep", axes=axes, dispatch=d, rounds=nr
+        ) as phase:
+            with obs.xla.annotate("megastep_dispatch", dispatch=d):
+                out = pipeline_megastep(state, sched, **kwargs)
+        if phase == "compile" and obs.xla.enabled():
+            # Device-tier artifact: AOT-harvest this specialization's
+            # cost/memory analysis (flops, bytes, donation-alias
+            # evidence).  The abstract signature is read off the
+            # RETURNED carry — the megastep threads state/sched through
+            # at unchanged shapes/dtypes, so the outputs' signature
+            # equals the consumed (donated) inputs' — and is built only
+            # on the one-or-two compile dispatches per sweep, keeping
+            # the steady-state loop free of tree walks.  After the span
+            # and before t_sub, so the extra AOT compile inflates
+            # neither compile_time_s nor dispatch latency (it has its
+            # own xla_introspect_s histogram).
+            obs.xla.introspect(
+                pipeline_megastep,
+                "pipeline_megastep",
+                obs.xla.abstractify((out[0], out[1])),
+                obs.xla.abstractify(kwargs),
+                axes=axes,
             )
         t_sub = time.perf_counter_ns()
         disp_c.inc()
         state, sched = out[0], out[1]
         ys = out[2:]
+        if with_counters:
+            # The stacked cumulative rows' last row continues the
+            # counter thread into the next dispatch — a lazy device
+            # slice, not a fetch.
+            counters = ys[-1][-1]
         if on_event is not None:
             on_event("dispatch", d)
         inflight.append((d, ys, t_sub))
@@ -346,4 +454,17 @@ def pipeline_sweep(
     }
     if collect_decisions:
         result["decisions"] = _host_np.concatenate([ys[1] for ys in retired])
+    if with_counters:
+        # Counter rows were already fetched inside the retire fetches
+        # (they ride ys), so everything below is host arithmetic — the
+        # "drain" adds no synchronization.
+        counter_rows = _host_np.concatenate([ys[-1] for ys in retired])
+        final = {
+            name: int(v) for name, v in zip(COUNTER_NAMES, counter_rows[-1])
+        }
+        result["counters"] = final
+        result["counters_per_round"] = counter_rows
+        result["final_counters"] = counters
+        for name, value in final.items():
+            reg.gauge(f"agreement_{name}").set(value)
     return result

@@ -245,21 +245,35 @@ class JaxBackend:
             # (or a persistent-cache load, BA_TPU_COMPILE_CACHE); later
             # calls are cached dispatches — obs.compile_or_dispatch_span
             # names the span and feeds first-call latency into
-            # compile_time_s.  The instance tag rides the key because
-            # the jit cache is per-instance (self._compiled): a second
-            # backend at equal statics re-pays the compile and must
-            # re-classify.
-            ckey = (
-                "jax_backend_step",
-                self._obs_instance,
-                self.protocol,
-                self.m,
-                self._capacity(n),
-            )
+            # compile_time_s.  The NAMED axes feed the recompile
+            # explainer: when an elastic g-add crosses a power-of-two
+            # boundary and this step re-specializes, the emitted
+            # `recompile` record names "capacity" (e.g. 4 -> 8) instead
+            # of leaving a mysterious second compile span.  The instance
+            # tag rides the axes because the jit cache is per-instance
+            # (self._compiled): a second backend at equal statics
+            # re-pays the compile and must re-classify.
+            axes = {
+                "instance": self._obs_instance,
+                "protocol": self.protocol,
+                "m": self.m,
+                "capacity": self._capacity(n),
+            }
+            k = jr.key(seed)
             with obs.compile_or_dispatch_span(
-                ckey, n=n, protocol=self.protocol
-            ):
-                maj = self._fn()(jr.key(seed), state)
+                "jax_backend_step", axes=axes, n=n, protocol=self.protocol
+            ) as phase:
+                maj = self._fn()(k, state)
+            if phase == "compile" and obs.xla.enabled():
+                # Device-tier artifact for the interactive step:
+                # cost/memory analysis of this capacity's program
+                # (obs/xla.py; abstract shapes only — nothing here is
+                # donated, so the concrete args are still live).  After
+                # the span so the extra AOT compile never inflates the
+                # canonical compile_time_s.
+                obs.xla.introspect(
+                    self._fn(), "jax_backend_step", (k, state), axes=axes
+                )
         # ONE host fetch for the whole row: int(v) per element costs a
         # ~50-100 ms tunnel round-trip per general (measured r3: the REPL
         # round dropped ~4x when this loop stopped fetching elementwise).
@@ -317,6 +331,7 @@ class JaxBackend:
             depth=depth,
             rounds_per_dispatch=per_dispatch,
             collect_decisions=True,
+            with_counters=True,
             host_work=host_work,
         )
         # Per-general block for the LAST round: recompute it from the same
@@ -339,4 +354,7 @@ class JaxBackend:
         maj = self._majorities_fn(keys_last, state_copy)
         majorities = [int(v) for v in np.asarray(maj[0, :n])]
         decisions = [int(v) for v in out["decisions"][:, 0]]
-        return majorities, decisions, out["stats"]
+        # The on-device agreement counters ride the stats block (they
+        # were drained inside the engine's existing retire fetches).
+        stats = dict(out["stats"], counters=out["counters"])
+        return majorities, decisions, stats

@@ -286,6 +286,10 @@ class Cluster:
                 "decision_counts": counts,
                 "dispatches": stats["dispatches"],
                 "depth": stats["depth"],
+                # On-device agreement counters (quorum failures,
+                # unanimous rounds, equivocation observed), drained at
+                # the engine's retire points — pure data, no extra sync.
+                "counters": stats.get("counters"),
                 "elapsed_s": round(elapsed, 6),
             }
         )

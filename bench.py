@@ -911,7 +911,21 @@ def bench_pipeline_sweep(jax, jnp, jr):
     stats = out["stats"]
     rps_pipe = batch * rounds / t_pipe
     rps_block = batch * rounds / t_block
+    # Device-tier cost/memory (ISSUE 4): under --obs the engine's first
+    # compile AOT-harvested the megastep's XLA analysis into gauges
+    # (obs/xla.py) — surface them in the config artifact so the
+    # flops/bytes/donation-alias evidence rides next to the wall-clock
+    # numbers it explains.  Empty (and omitted) when --obs is off.
+    from ba_tpu import obs
+
+    xla_cost = {
+        name[len("xla_pipeline_megastep_"):]: snap["value"]
+        for name, snap in obs.default_registry().snapshot().items()
+        if name.startswith("xla_pipeline_megastep_")
+    }
+    result_extra = {"xla_cost": xla_cost} if xla_cost else {}
     return {
+        **result_extra,
         "rounds_per_sec": round(rps_pipe, 1),
         "blocking_rounds_per_sec": round(rps_block, 1),
         "pipeline_speedup_vs_blocking": round(t_block / t_pipe, 2),
@@ -1431,6 +1445,16 @@ def main() -> None:
                              "local backends, e.g. BA_TPU_BENCH_PLATFORM=cpu "
                              "or directly-attached TPU; the shared TPU-tunnel "
                              "backend does not serve the profiler and hangs)")
+    parser.add_argument("--xprof", metavar="DIR",
+                        default=os.environ.get("BA_TPU_XPROF") or None,
+                        help="capture a jax.profiler device trace of the "
+                             "run into DIR (view with TensorBoard/xprof); "
+                             "megastep dispatch/retire carry "
+                             "TraceAnnotation markers aligning the device "
+                             "timeline with the host spans (--obs).  "
+                             "BA_TPU_XPROF=DIR is the env spelling.  Same "
+                             "caveat as --profile: the shared TPU-tunnel "
+                             "backend does not serve the profiler")
     parser.add_argument("--obs", metavar="DIR", default=None,
                         help="write HOST observability artifacts to DIR "
                              "(ba_tpu.obs): trace.json — Chrome trace-event "
@@ -1507,7 +1531,16 @@ def main() -> None:
         print(json.dumps(line))
         return
 
+    if args.profile and args.xprof:
+        # jax.profiler allows ONE active session: the second start_trace
+        # would raise mid-run with the first trace already open.
+        parser.error("--profile and --xprof cannot be combined "
+                     "(one jax.profiler session at a time)")
     trace = (jax.profiler.trace(args.profile) if args.profile
+             else contextlib.nullcontext())
+    from ba_tpu import obs as _obs_xprof
+
+    xprof = (_obs_xprof.xla.xprof_session(args.xprof) if args.xprof
              else contextlib.nullcontext())
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
     unknown = [n for n in names if n not in CONFIGS]
@@ -1517,7 +1550,7 @@ def main() -> None:
             f"valid: {', '.join(CONFIGS)}"
         )
     results = {}
-    with trace:
+    with trace, xprof:
         for name in names:
             print(f"bench: {name} ...", file=sys.stderr, flush=True)
             results[name] = CONFIGS[name](jax, jnp, jr)

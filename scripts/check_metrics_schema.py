@@ -5,9 +5,14 @@
 Exercises the real emitters end-to-end — interactive rounds through the
 sequential oracle backend (``agreement_round`` records), the pipelined
 fallback path (``agreement_rounds`` decision tallies ride the sequential
-records), and a registry ``metrics_snapshot`` — into a temp sink, then
-validates every line.  Run by ``scripts/ci.sh`` before the tier-1 suite;
-standalone: ``JAX_PLATFORMS=cpu python scripts/check_metrics_schema.py``.
+records), a registry ``metrics_snapshot``, and (ISSUE 4) the DEVICE
+tier: two tiny ``pipeline_sweep`` runs on the CPU backend at different
+capacities drive the real ``compiled_artifact`` (obs/xla.py AOT
+introspection) and ``recompile`` (obs/instrument.py explainer) emitters
+— into a temp sink, then validates every line, including the typed
+shape of the two device-tier records.  Run by ``scripts/ci.sh`` before
+the tier-1 suite; standalone: ``JAX_PLATFORMS=cpu python
+scripts/check_metrics_schema.py``.
 """
 
 from __future__ import annotations
@@ -36,6 +41,22 @@ def main() -> int:
         cluster.actual_order_rounds("retreat", 2)  # sequential fallback
         cluster.kill(1)  # election transition (registry counter, no emit)
         cluster.actual_order("attack")
+        # Device tier: a live sink makes obs.xla.enabled() true, so two
+        # tiny pipelined runs at DIFFERENT capacities exercise the real
+        # compiled_artifact emitter and force one explained recompile.
+        import jax.random as jr
+
+        from ba_tpu.parallel import make_sweep_state, pipeline_sweep
+
+        obs.reset_first_calls()
+        pipeline_sweep(
+            jr.key(0), make_sweep_state(jr.key(1), 4, 4), 2,
+            with_counters=True,
+        )
+        pipeline_sweep(
+            jr.key(2), make_sweep_state(jr.key(3), 4, 8), 2,
+            with_counters=True,
+        )
         obs.default_registry().emit_snapshot(sink=sink, source="ci-check")
         sink.close()
 
@@ -63,7 +84,49 @@ def main() -> int:
                 )
                 bad += 1
             events.add(rec.get("event"))
-        want = {"agreement_round", "metrics_snapshot"}
+            # Device-tier records carry a typed shape beyond event/v.
+            if rec.get("event") == "compiled_artifact":
+                numeric = (
+                    "flops", "bytes_accessed", "argument_bytes",
+                    "output_bytes", "temp_bytes", "alias_bytes",
+                )
+                if not (
+                    isinstance(rec.get("fn"), str)
+                    and isinstance(rec.get("axes"), dict)
+                    and all(
+                        isinstance(rec.get(f), (int, float)) for f in numeric
+                    )
+                    and isinstance(rec.get("donation_aliased"), bool)
+                ):
+                    print(
+                        f"schema check: line {i} malformed "
+                        f"compiled_artifact: {line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "recompile":
+                changed = rec.get("changed")
+                if not (
+                    isinstance(rec.get("fn"), str)
+                    and isinstance(changed, dict)
+                    and changed
+                    and all(
+                        isinstance(v, list) and len(v) == 2
+                        for v in changed.values()
+                    )
+                ):
+                    print(
+                        f"schema check: line {i} malformed recompile: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+        want = {
+            "agreement_round",
+            "metrics_snapshot",
+            "compiled_artifact",
+            "recompile",
+        }
         if not want <= events:
             print(
                 f"schema check: expected events {want - events} missing "

@@ -35,10 +35,15 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== ba-lint static analysis: ba_tpu/ examples/ bench.py =="
+echo "== ba-lint static analysis: ba_tpu/ examples/ bench.py tests/ scripts/ =="
+# ISSUE 4 satellite (ROADMAP open item from PR 3): the lint set now
+# covers tests/ and scripts/ at error level too; the deliberately-
+# violating lint fixtures are pruned via --exclude (both already ran
+# clean — tests/test_analysis.py pins it — CI now gates on them).
 balint_json=$(mktemp)
 trap 'rm -rf "$balint_json" "${mutdir:-}"' EXIT
-python -m ba_tpu.analysis ba_tpu/ examples/ bench.py --format json \
+python -m ba_tpu.analysis ba_tpu/ examples/ bench.py tests/ scripts/ \
+    --exclude tests/fixtures/ba_lint --format json \
     > "$balint_json"
 balint_rc=$?
 # Schema check (mirrors scripts/check_metrics_schema.py's contract for

@@ -81,9 +81,59 @@ def _hist_quantile(buckets: list, count: int, q: float) -> float | None:
     return None
 
 
+def _fmt_count(n: float) -> str:
+    """Engineering-notation counts (flops/bytes): 1.23e9 -> '1.23 G'."""
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f} {suffix}"
+    return f"{n:g}"
+
+
+def report_device(artifacts: list, recompiles: list) -> None:
+    """The device tier: compiled-artifact cost table, donation-alias
+    verification, and the recompile ledger (obs/xla.py +
+    obs/instrument.py's recompile explainer)."""
+    if artifacts:
+        print("== compiled artifacts (device tier) ==")
+        print(
+            f"  {'fn':<24} {'flops':>10} {'bytes_acc':>10} {'arg':>10} "
+            f"{'out':>10} {'temp':>10} {'alias':>10}"
+        )
+        for a in artifacts:
+            print(
+                f"  {a.get('fn', '?'):<24} "
+                f"{_fmt_count(a.get('flops', 0)):>10} "
+                f"{_fmt_count(a.get('bytes_accessed', 0)):>10} "
+                f"{_fmt_count(a.get('argument_bytes', 0)):>10} "
+                f"{_fmt_count(a.get('output_bytes', 0)):>10} "
+                f"{_fmt_count(a.get('temp_bytes', 0)):>10} "
+                f"{_fmt_count(a.get('alias_bytes', 0)):>10}"
+            )
+        print("== donation-alias verification ==")
+        for a in artifacts:
+            alias = a.get("alias_bytes", 0)
+            verdict = (
+                f"aliased {_fmt_count(alias)}B of inputs onto outputs "
+                "(donation held)"
+                if alias
+                else "NO aliasing — donate_argnums had no effect"
+            )
+            print(f"  {a.get('fn', '?'):<24} {verdict}")
+    if recompiles:
+        print("== recompile ledger ==")
+        for r in recompiles:
+            changes = ", ".join(
+                f"{axis}: {old!r} -> {new!r}"
+                for axis, (old, new) in sorted(r.get("changed", {}).items())
+            )
+            print(f"  {r.get('fn', '?'):<24} {changes}")
+
+
 def report_metrics(path: str) -> None:
     events: dict = {}
     snapshot = None
+    artifacts: list = []
+    recompiles: list = []
     with open(path) as fh:
         for line in fh:
             line = line.strip()
@@ -93,9 +143,14 @@ def report_metrics(path: str) -> None:
             events[rec.get("event", "?")] = events.get(rec.get("event", "?"), 0) + 1
             if rec.get("event") == "metrics_snapshot":
                 snapshot = rec
+            elif rec.get("event") == "compiled_artifact":
+                artifacts.append(rec)
+            elif rec.get("event") == "recompile":
+                recompiles.append(rec)
     print(f"== JSONL events ({path}) ==")
     for name, c in sorted(events.items()):
         print(f"  {name:<32} {c:>6}")
+    report_device(artifacts, recompiles)
     if snapshot is None:
         print("  (no metrics_snapshot record)")
         return

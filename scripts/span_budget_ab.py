@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Span-budget audit: tracer-overhead A/B on the `run-rounds` path.
+
+PR 2's open ROADMAP item: a span costs two ``perf_counter_ns`` reads
+plus a deque append — confirm the trace-enabled ``run-rounds`` path
+shows no measurable regression and record the number in the BENCH
+series.  This harness runs the REAL path (``Cluster`` →
+``JaxBackend.run_rounds`` → the pipelined sweep engine, spans on every
+dispatch/retire/host_work plus the per-dispatch ``pipeline_dispatch``
+sink records) with the tracer ENABLED vs DISABLED, reps interleaved so
+both sides share one service window, and prints one JSON line:
+
+    JAX_PLATFORMS=cpu python scripts/span_budget_ab.py > BENCH_span_budget_rN.json
+
+Knobs: ``BA_TPU_SPAN_AB_ROUNDS`` (default 64 rounds per rep),
+``BA_TPU_SPAN_AB_REPS`` (default 5, min-of-reps per side),
+``BA_TPU_SPAN_AB_PLATFORM`` (default cpu; set tpu on the tunnel for the
+dispatch-scale number the ROADMAP asks about).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from ba_tpu import obs
+    from ba_tpu.obs.registry import MetricsRegistry
+    from ba_tpu.obs.trace import Tracer
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    platform = os.environ.get("BA_TPU_SPAN_AB_PLATFORM", "cpu")
+    rounds = int(os.environ.get("BA_TPU_SPAN_AB_ROUNDS", 64))
+    reps = int(os.environ.get("BA_TPU_SPAN_AB_REPS", 5))
+
+    cluster = Cluster(4, JaxBackend(platform=platform), seed=0)
+    cluster.set_faulty(3, True)
+    # Warm: compile the megastep + the last-round majority recompute off
+    # the clock (both sides reuse the same jit cache afterwards).
+    cluster.actual_order_rounds("attack", rounds)
+
+    def run_side(enabled: bool) -> tuple[float, int]:
+        # A fresh tracer/registry per timed run: the enabled side pays
+        # the REAL record/append cost, the disabled side the enabled
+        # check only — exactly the production toggle (BA_TPU_TRACE).
+        # Returns (elapsed seconds, spans recorded).
+        obs.trace._default = Tracer(enabled=enabled)
+        obs.registry._default = MetricsRegistry()
+        t0 = time.perf_counter()
+        cluster.actual_order_rounds("attack", rounds)
+        elapsed = time.perf_counter() - t0
+        spans = len(obs.default_tracer())
+        return elapsed, spans
+
+    t_on = t_off = float("inf")
+    spans_per_run = 0
+    for _ in range(reps):  # interleaved: window drift cancels
+        e_on, spans_per_run = run_side(True)
+        t_on = min(t_on, e_on)
+        e_off, _ = run_side(False)
+        t_off = min(t_off, e_off)
+
+    overhead_s = t_on - t_off
+    line = {
+        "metric": "span-budget",
+        "platform": platform,
+        "path": "Cluster.actual_order_rounds (pipelined run-rounds)",
+        "rounds_per_run": rounds,
+        "reps": reps,
+        "span_on_s": round(t_on, 6),
+        "span_off_s": round(t_off, 6),
+        "overhead_s": round(overhead_s, 6),
+        "overhead_pct": round(100 * overhead_s / t_off, 2),
+        "spans_per_run": spans_per_run,
+        "est_ns_per_span": (
+            round(overhead_s / spans_per_run * 1e9, 1)
+            if spans_per_run and overhead_s > 0
+            else None
+        ),
+        "note": "min-of-reps, sides interleaved in one window; "
+                "negative overhead = below measurement noise",
+    }
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,6 +150,51 @@ def test_file_wide_suppression(tmp_path):
     assert [s.code for s in suppressed] == ["BA202"]
 
 
+def test_exclude_prunes_paths(tmp_path):
+    # --exclude (ISSUE 4 satellite): a path prefix keeps its subtree out
+    # of discovery — the CI spelling for linting tests/ without the
+    # deliberately-violating tests/fixtures/ba_lint/ fixtures.
+    pkg = tmp_path / "ba_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ba_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "pipeline.py").write_text(
+        "def f(x):\n    return x.block_until_ready()\n"
+    )
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    active, _, files = run_paths([str(tmp_path)])
+    assert [f.code for f in active] == ["BA101"] and files == 4
+    active, _, files = run_paths(
+        [str(tmp_path)], exclude=[str(tmp_path / "ba_tpu")]
+    )
+    assert active == [] and files == 1  # only clean.py survives
+    # The CLI spelling agrees (and the excluded tree never parses).
+    proc = _run_cli(
+        [str(tmp_path), "--format", "json",
+         "--exclude", str(tmp_path / "ba_tpu")]
+    )
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == [] and doc["files_scanned"] == 1
+
+
+def test_ci_lint_set_with_exclude_is_error_free():
+    # The exact invocation scripts/ci.sh gates on: the full repo lint
+    # set with the fixtures excluded exits 0.
+    proc = _run_cli(
+        ["ba_tpu/", "examples/", "bench.py", "tests/", "scripts/",
+         "--exclude", "tests/fixtures/ba_lint", "--format", "json"]
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["counts"]["error"] == 0
+    assert not any(
+        "fixtures/ba_lint" in f["path"]
+        for f in doc["findings"] + doc["suppressed"]
+    )
+
+
 def test_syntax_error_is_fatal_finding(tmp_path):
     (tmp_path / "broken.py").write_text("def f(:\n")
     active, _, _ = run_paths([str(tmp_path)])

@@ -367,6 +367,19 @@ def test_bench_obs_acceptance_cpu(tmp_path):
     assert m["pipeline_dispatch_latency_s"]["buckets"]
     assert m["compile_time_s"]["count"] > 0
 
+    # (b2) ISSUE 4 acceptance: the device tier rode along — at least
+    # one compiled_artifact record for the megastep with nonzero
+    # flops/bytes and alias bytes proving the donate_argnums contract.
+    arts = [r for r in recs if r["event"] == "compiled_artifact"]
+    mega = [a for a in arts if a["fn"] == "pipeline_megastep"]
+    assert mega, arts
+    assert all(a["flops"] > 0 and a["bytes_accessed"] > 0 for a in mega)
+    assert all(a["alias_bytes"] > 0 and a["donation_aliased"] for a in mega)
+    # ... and the config artifact surfaces the same numbers.
+    detail = json.loads((tmp_path / "detail.json").read_text())
+    xla_cost = detail["configs"]["pipeline_sweep"]["xla_cost"]
+    assert xla_cost["flops"] > 0 and xla_cost["alias_bytes"] > 0
+
     # Prometheus text exposition rides along.
     prom = (obs_dir / "metrics.prom").read_text()
     assert "# TYPE pipeline_dispatch_latency_s histogram" in prom
@@ -378,3 +391,6 @@ def test_bench_obs_acceptance_cpu(tmp_path):
     )
     assert r.returncode == 0, r.stderr
     assert "dispatch" in r.stdout and "pipeline_dispatch_latency_s" in r.stdout
+    # The device section renders the artifact + donation verification.
+    assert "compiled artifacts (device tier)" in r.stdout
+    assert "donation held" in r.stdout

@@ -1,0 +1,234 @@
+"""Device-tier observability tests (ISSUE 4 tentpole, ba_tpu/obs/xla.py
++ the recompile explainer in obs/instrument.py).
+
+Contracts pinned here:
+
+1. **Artifact introspection on CPU**: `obs.xla.introspect` AOT-compiles
+   a jitted callable and emits one versioned ``compiled_artifact``
+   record with nonzero flops/bytes and — for a donating program —
+   nonzero ``alias_bytes`` (the donate_argnums contract made visible),
+   plus registry gauges and the HLO dump when ``BA_TPU_HLO`` is set.
+2. **Pipeline wiring**: a ``pipeline_sweep`` run with the sink live
+   emits exactly one artifact per specialization, whose alias bytes
+   cover the donated state+schedule bytes.
+3. **Recompile explainer**: a seen function compiling again emits
+   exactly ONE ``recompile`` record naming exactly the changed axis —
+   through the raw classifier, and end-to-end through ``JaxBackend``'s
+   capacity re-specialization.
+4. **Disabled = free**: with no ``BA_TPU_*`` set the introspector never
+   runs (no records, no extra compiles) and ``annotate`` degrades to a
+   nullcontext without importing the profiler.
+"""
+
+import contextlib
+import json
+
+import pytest
+
+from ba_tpu import obs
+from ba_tpu.obs.registry import MetricsRegistry
+from ba_tpu.obs.trace import Tracer
+from ba_tpu.utils import metrics
+
+
+@pytest.fixture
+def fresh_obs(monkeypatch, tmp_path):
+    """Fresh tracer/registry/instrument state + a live sink in tmp_path;
+    yields the sink path."""
+    monkeypatch.delenv("BA_TPU_HLO", raising=False)
+    monkeypatch.delenv("BA_TPU_XPROF", raising=False)
+    monkeypatch.setattr(obs.trace, "_default", Tracer(enabled=True))
+    monkeypatch.setattr(obs.registry, "_default", MetricsRegistry())
+    path = tmp_path / "metrics.jsonl"
+    monkeypatch.setattr(metrics, "_default", metrics.MetricsSink(str(path)))
+    obs.reset_first_calls()
+    yield path
+    metrics.default_sink().close()
+    obs.reset_first_calls()
+
+
+def _records(path, event=None):
+    if not path.exists():  # lazily-opened sink that never emitted
+        return []
+    recs = [
+        json.loads(line)
+        for line in path.read_text().splitlines()
+        if line.strip()
+    ]
+    if event is not None:
+        recs = [r for r in recs if r["event"] == event]
+    return recs
+
+
+# -- 1. introspection ---------------------------------------------------------
+
+
+def test_introspect_emits_versioned_artifact_with_alias(fresh_obs):
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x, y: (x @ y, x + 1), donate_argnums=(0,))
+    x = jnp.ones((16, 16))
+    y = jnp.ones((16, 16))
+    rec = obs.xla.introspect(f, "toy_matmul", (x, y), axes={"n": 16})
+    assert rec is not None
+    metrics.default_sink().close()
+    (on_disk,) = _records(fresh_obs, "compiled_artifact")
+    assert on_disk["v"] == 1 and on_disk["fn"] == "toy_matmul"
+    assert on_disk["axes"] == {"n": 16}
+    assert on_disk["flops"] > 0
+    assert on_disk["bytes_accessed"] > 0
+    # x (16*16 f32) is donated and comes back as an output: XLA aliases
+    # exactly its bytes.  This is the donation-evidence contract.
+    assert on_disk["alias_bytes"] == 16 * 16 * 4
+    assert on_disk["donation_aliased"] is True
+    # Gauges mirror the record for scrape-style consumers.
+    snap = obs.default_registry().snapshot()
+    assert snap["xla_toy_matmul_flops"]["value"] == on_disk["flops"]
+    assert snap["xla_toy_matmul_alias_bytes"]["value"] == 16 * 16 * 4
+    # The harvest cost is itself observable.
+    assert snap["xla_introspect_s"]["count"] == 1
+
+
+def test_introspect_hlo_dump(fresh_obs, monkeypatch, tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    hlo = tmp_path / "hlo"
+    monkeypatch.setenv("BA_TPU_HLO", str(hlo))
+    f = jax.jit(lambda x: x * 2)
+    rec = obs.xla.introspect(f, "doubler", (jnp.ones((8,)),), axes={"n": 8})
+    assert rec["hlo_dump"] is not None
+    dumps = sorted(p.name for p in hlo.iterdir())
+    assert any(n.startswith("doubler-") and n.endswith(".stablehlo.txt")
+               for n in dumps)
+    text = next(
+        p for p in hlo.iterdir() if p.name.endswith(".stablehlo.txt")
+    ).read_text()
+    assert "stablehlo" in text or "mhlo" in text or "func" in text
+
+
+def test_pipeline_sweep_emits_one_artifact_per_specialization(fresh_obs):
+    import jax.random as jr
+
+    from ba_tpu.parallel import make_sweep_state, pipeline_sweep
+
+    state = make_sweep_state(jr.key(61), 8, 8)
+    out = pipeline_sweep(
+        jr.key(62), state, 4, depth=2, rounds_per_dispatch=2,
+        with_counters=True,
+    )
+    assert out["stats"]["dispatches"] == 2
+    metrics.default_sink().close()
+    arts = _records(fresh_obs, "compiled_artifact")
+    # One specialization (no ragged remainder) -> exactly one artifact.
+    assert len(arts) == 1 and arts[0]["fn"] == "pipeline_megastep"
+    assert arts[0]["flops"] > 0 and arts[0]["bytes_accessed"] > 0
+    # Donation evidence: the aliased bytes cover the whole donated
+    # carry — SimState planes + KeySchedule (key data + counter).
+    import jax
+
+    donated = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves((state,))
+    )
+    assert arts[0]["alias_bytes"] >= donated > 0
+    assert arts[0]["axes"]["capacity"] == 8
+    assert arts[0]["axes"]["counters"] is True
+
+
+def test_introspection_failure_is_nonfatal(fresh_obs, capsys):
+    rec = obs.xla.introspect(object(), "not_jitted", (), axes={})
+    assert rec is None
+    assert "introspection of 'not_jitted' failed" in capsys.readouterr().err
+    metrics.default_sink().close()
+    assert _records(fresh_obs, "compiled_artifact") == []
+
+
+# -- 2. recompile explainer ---------------------------------------------------
+
+
+def test_recompile_record_names_changed_axis_exactly_once(fresh_obs):
+    with obs.compile_or_dispatch_span("fnx", axes={"capacity": 4, "m": 1}) as p:
+        assert p == "compile"  # first ever: compile, but nothing to diff
+    with obs.compile_or_dispatch_span("fnx", axes={"capacity": 4, "m": 1}) as p:
+        assert p == "dispatch"  # cached: no record
+    with obs.compile_or_dispatch_span("fnx", axes={"capacity": 8, "m": 1}) as p:
+        assert p == "compile"  # re-specialization: THE recompile
+    with obs.compile_or_dispatch_span("fnx", axes={"capacity": 8, "m": 1}) as p:
+        assert p == "dispatch"  # cached again: still one record
+    metrics.default_sink().close()
+    recs = _records(fresh_obs, "recompile")
+    assert len(recs) == 1
+    assert recs[0]["fn"] == "fnx"
+    assert recs[0]["changed"] == {"capacity": [4, 8]}  # m unchanged: absent
+    assert recs[0]["axes"] == {"capacity": 8, "m": 1}
+    # The instant marker and counter ride along.
+    names = [e["name"] for e in obs.default_tracer().chrome_events()
+             if e["ph"] == "i"]
+    assert names.count("recompile") == 1
+    snap = obs.default_registry().snapshot()
+    assert snap["recompiles_total"]["value"] == 1
+
+
+def test_backend_capacity_recompile_is_attributed(fresh_obs):
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import General
+
+    backend = JaxBackend(platform="cpu")
+    generals = [General(id=i + 1, port=0) for i in range(4)]
+    backend.run_round(generals, 0, 1, seed=0)  # capacity 4: first compile
+    backend.run_round(generals, 0, 1, seed=1)  # cached dispatch
+    generals.append(General(id=5, port=0))
+    backend.run_round(generals, 0, 1, seed=2)  # capacity 8: recompile
+    metrics.default_sink().close()
+    recs = _records(fresh_obs, "recompile")
+    assert len(recs) == 1 and recs[0]["fn"] == "jax_backend_step"
+    assert recs[0]["changed"] == {"capacity": [4, 8]}
+    # The interactive step's artifacts rode along, one per capacity.
+    arts = _records(fresh_obs, "compiled_artifact")
+    caps = sorted(a["axes"]["capacity"] for a in arts
+                  if a["fn"] == "jax_backend_step")
+    assert caps == [4, 8]
+
+
+# -- 3. disabled path ---------------------------------------------------------
+
+
+def test_disabled_path_no_records_no_introspection(monkeypatch, tmp_path):
+    import jax.random as jr
+
+    from ba_tpu.parallel import make_sweep_state, pipeline_sweep
+
+    for var in ("BA_TPU_METRICS", "BA_TPU_TRACE", "BA_TPU_HLO",
+                "BA_TPU_XPROF"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(obs.trace, "_default", Tracer(enabled=False))
+    monkeypatch.setattr(obs.registry, "_default", MetricsRegistry())
+    monkeypatch.setattr(metrics, "_default", metrics.MetricsSink())
+    monkeypatch.chdir(tmp_path)
+    assert not obs.xla.enabled()
+
+    calls = []
+    monkeypatch.setattr(
+        obs.xla, "introspect",
+        lambda *a, **k: calls.append(a) or None,
+    )
+    obs.reset_first_calls()
+    state = make_sweep_state(jr.key(63), 8, 8)
+    out = pipeline_sweep(jr.key(64), state, 4, depth=2, with_counters=True)
+    assert out["stats"]["dispatches"] == 4
+    assert out["counters"].keys() == {
+        "quorum_failures", "unanimous_rounds", "equivocation_observed"
+    }
+    assert calls == []  # gated out before the (expensive) AOT compile
+    assert list(tmp_path.iterdir()) == []  # zero file writes
+    assert len(obs.default_tracer()) == 0
+
+
+def test_annotate_inactive_is_free_nullcontext(monkeypatch):
+    monkeypatch.delenv("BA_TPU_XPROF", raising=False)
+    cm = obs.xla.annotate("megastep_dispatch", dispatch=0)
+    assert isinstance(cm, contextlib.nullcontext)
+    with cm:
+        pass

@@ -17,14 +17,17 @@ Three contracts, each pinned independently:
    raise for the duration).
 """
 
+import dataclasses
+
 import jax
 import jax.random as jr
 import numpy as np
 import pytest
 
-from ba_tpu.core.types import ATTACK, RETREAT
+from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
 from ba_tpu.parallel import make_mesh, make_sweep_state, pipeline_sweep
 from ba_tpu.parallel.pipeline import (
+    COUNTER_NAMES,
     fresh_copy as _fresh,
     make_key_schedule,
     pipeline_megastep,
@@ -156,11 +159,13 @@ def test_depth_k_inflight_no_intermediate_blocking(monkeypatch):
 
 
 def test_depth_k_no_blocking_with_instrumentation_enabled(monkeypatch):
-    # ISSUE 2 acceptance: the observability layer's only added work is
-    # clock reads + in-memory appends — with tracing AND the registry
+    # ISSUE 2 acceptance (extended by ISSUE 4): the observability
+    # layer's only added work is clock reads + in-memory appends — with
+    # tracing, the registry, AND the on-device agreement counters all
     # live, the engine still never calls block_until_ready and the
     # dispatch/retire schedule is unchanged (depth dispatches genuinely
-    # in flight before the first retire fetch).
+    # in flight before the first retire fetch; counter rows piggyback
+    # the existing retire fetch).
     from ba_tpu import obs
     from ba_tpu.obs.registry import MetricsRegistry
     from ba_tpu.obs.trace import Tracer
@@ -177,7 +182,7 @@ def test_depth_k_no_blocking_with_instrumentation_enabled(monkeypatch):
     events = []
     out = pipeline_sweep(
         jr.key(56), state, R,
-        depth=depth, rounds_per_dispatch=1,
+        depth=depth, rounds_per_dispatch=1, with_counters=True,
         on_event=lambda kind, i: events.append((kind, i)),
     )
     assert [i for kind, i in events if kind == "dispatch"] == list(range(R))
@@ -193,6 +198,92 @@ def test_depth_k_no_blocking_with_instrumentation_enabled(monkeypatch):
     snap = obs.default_registry().snapshot()
     assert snap["pipeline_dispatch_latency_s"]["count"] == R
     assert snap["pipeline_depth_occupancy"]["count"] == R
+
+
+def test_on_device_counters_bit_match_host_derivation():
+    # ISSUE 4: the counter block folded inside the compiled scan must
+    # bit-match the same counts derived ON THE HOST from the blocking
+    # reference driver's decisions/majorities streams — and enabling it
+    # must not change a single decision bit.
+    B, cap, R = 32, 8, 7
+    key = jr.key(71)
+    state = make_sweep_state(jr.key(70), B, cap, order=ATTACK)
+    # Flip half the leaders faulty so equivocation and quorum failures
+    # actually occur (make_sweep_state keeps leaders honest by default).
+    state = dataclasses.replace(
+        state, faulty=state.faulty.at[: B // 2, 0].set(True)
+    )
+
+    # Host derivation from the round-by-round reference driver.
+    step = jax.jit(agreement_step, static_argnames=("m", "max_liars"))
+    keys_fn = jax.jit(round_keys, static_argnums=1)
+    alive = np.asarray(state.alive)
+    faulty = np.asarray(state.faulty)
+    leader = np.asarray(state.leader)
+    lieutenants = alive & (np.arange(cap)[None, :] != leader[:, None])
+    traitor_present = (faulty & alive).any(axis=1)
+    want = np.zeros(len(COUNTER_NAMES), np.int64)
+    ref_decisions = []
+    for r in range(R):
+        out = step(keys_fn(make_key_schedule(key, r), B), state, m=1)
+        dec = np.asarray(out["decision"])
+        maj = np.asarray(out["majorities"])
+        ref_decisions.append(dec)
+        want[0] += (dec == UNDEFINED).sum()
+        want[1] += int((dec == dec[0]).all())
+        mmax = np.where(lieutenants, maj, -127).max(axis=1)
+        mmin = np.where(lieutenants, maj, 127).min(axis=1)
+        disagree = (mmax != mmin) & lieutenants.any(axis=1)
+        want[2] += (disagree & traitor_present).sum()
+
+    out = pipeline_sweep(
+        key, _fresh(state), R,
+        depth=2, rounds_per_dispatch=3,
+        collect_decisions=True, with_counters=True,
+    )
+    np.testing.assert_array_equal(out["decisions"], np.stack(ref_decisions))
+    got = np.array([out["counters"][name] for name in COUNTER_NAMES])
+    np.testing.assert_array_equal(got, want)
+    # The per-round rows are cumulative and end at the final block.
+    rows = out["counters_per_round"]
+    assert rows.shape == (R, len(COUNTER_NAMES))
+    assert (np.diff(rows, axis=0) >= 0).all()
+    np.testing.assert_array_equal(rows[-1], want)
+    # Sanity: faulty leaders actually exercised the failure counters
+    # (no batch-unanimous rounds under this split, by construction).
+    assert want[0] > 0 and want[2] > 0, want
+    assert want[1] == 0
+
+    # An honest OM(1) sweep with t <= n/4 decides the order everywhere:
+    # every round is batch-unanimous, nothing fails quorum.
+    honest = make_sweep_state(
+        jr.key(74), B, cap, min_n=8, max_traitor_frac=0.25, order=ATTACK
+    )
+    out_h = pipeline_sweep(jr.key(75), honest, 4, with_counters=True)
+    assert out_h["counters"]["unanimous_rounds"] == 4
+    assert out_h["counters"]["quorum_failures"] == 0
+
+
+def test_counters_continue_across_engine_runs():
+    # final_counters continues the thread: head + tail == full run.
+    B, cap = 16, 8
+    key = jr.key(73)
+    state = make_sweep_state(jr.key(72), B, cap, order=ATTACK)
+    state = dataclasses.replace(
+        state, faulty=state.faulty.at[: B // 2, 0].set(True)
+    )
+    full = pipeline_sweep(key, _fresh(state), 6, with_counters=True)
+    head = pipeline_sweep(key, _fresh(state), 3, with_counters=True)
+    tail = pipeline_megastep(
+        head["final_state"],
+        head["final_schedule"],
+        rounds=3,
+        counters=head["final_counters"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tail[-1])[-1],
+        np.array([full["counters"][n] for n in COUNTER_NAMES]),
+    )
 
 
 def test_pipeline_host_work_overlaps_dispatches():
