@@ -24,6 +24,11 @@ Layout (mirrors SURVEY.md section 1's layer map, TPU-first):
 - ``ba_tpu.runtime``  — the thin stateful host shell: membership registry,
   election-for-life, failure detection, and the REPL with byte-identical
   output (reference L2/L4, ba.py:66-122,354-445).
+- ``ba_tpu.scenario`` — declarative adversary & membership campaigns:
+  the REPL's ``g-kill``/``g-add``/``g-state`` session as data (JSON
+  specs -> dense per-round device planes) plus coordinated adversary
+  strategies, executed by the pipelined mutating megastep
+  (``parallel.scenario_sweep``) with on-device IC1/IC2 verdicts.
 """
 
 __version__ = "0.1.0"

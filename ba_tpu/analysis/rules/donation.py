@@ -9,12 +9,30 @@ when the path executes: after a statement that passes local name ``x``
 at a donated position, any read of ``x`` before a rebinding is a
 finding.
 
-Donating callables come from the project-wide registry
-(``@functools.partial(jax.jit, donate_argnums=...)`` decorators and
-``g = jax.jit(f, donate_argnums=...)`` rebindings, resolved through
-import aliases so cross-module call sites are checked), plus the
-CONVENTION table below for wrappers whose jit lives inside but whose
-documented contract donates an argument.
+Donating callables come from three places, merged in this order:
+
+1. the project-wide registry (``@functools.partial(jax.jit,
+   donate_argnums=...)`` decorators and ``g = jax.jit(f,
+   donate_argnums=...)`` rebindings, resolved through import aliases so
+   cross-module call sites are checked);
+2. the ``# ba-lint: donates(name, ...)`` ANNOTATION (ISSUE 5, the
+   ROADMAP PR 3 item): a wrapper whose jit lives inside but whose
+   documented contract consumes an argument declares it on its own
+   ``def`` line::
+
+       def scenario_sweep(  # ba-lint: donates(state)
+           key, state, ...
+
+   The comment must sit on the ``def`` line itself (real comment
+   placement — a docstring that merely documents the syntax, like this
+   one, never registers), and the names must be positional parameters
+   of that function.  Parsed here into the same registry the jit
+   decorators feed, so call sites in OTHER modules resolve through
+   their import aliases identically;
+3. the hand-maintained CONVENTION table below — kept as the fallback
+   for wrappers that cannot carry the annotation (and as the
+   bootstrap the annotation replaced; entries should migrate to
+   annotations over time).
 
 Analysis is the shared must-flow walk (``analysis/flow.py``):
 evaluation-ordered events, intersection joins at branches (a donate on
@@ -28,6 +46,9 @@ the donate flag.
 from __future__ import annotations
 
 import ast
+import io
+import re
+import tokenize
 
 from ba_tpu.analysis.base import Rule, register
 from ba_tpu.analysis.flow import (
@@ -41,12 +62,106 @@ from ba_tpu.analysis.project import DonationSpec
 # Wrappers that donate by documented contract rather than a visible
 # donate_argnums: pipeline_sweep consumes its `state` (arg 1) — the
 # first megastep inside it donates it — while `key` survives (the
-# schedule copies the key data; make_key_schedule's contract).
+# schedule copies the key data; make_key_schedule's contract).  Kept as
+# the FALLBACK behind the donates annotation (the annotated real
+# signatures shadow these entries via the merge order in
+# ``_donation_table``); pipeline_sweep itself now carries the
+# annotation too, so this table is belt-and-braces.
 KNOWN_DONATING = {
     "ba_tpu.parallel.pipeline.pipeline_sweep": DonationSpec(
         frozenset([1]), ("key", "state")
     ),
 }
+
+_DONATES_RE = re.compile(r"#\s*ba-lint:\s*donates\(([^)]*)\)")
+
+
+def annotated_donations(mod) -> tuple:
+    """``({qualified name: DonationSpec}, [(lineno, message)])`` for
+    every function in ``mod`` whose ``def`` line carries a ``# ba-lint:
+    donates(a, b)`` comment.
+
+    Directives parse from REAL comment tokens (``tokenize``, exactly
+    like the suppression index) — a docstring that merely documents the
+    syntax never registers — and anchor by line number: the comment
+    must sit on the exact line a ``FunctionDef`` starts on (multi-line
+    signatures annotate the ``def foo(`` line).  A name that is not a
+    positional parameter of its function comes back as an error entry
+    (BA201 reports it at the annotation line): a typo'd annotation
+    silently protecting nothing is worse than none.
+    """
+    hits = {}
+    try:
+        tokens = tokenize.generate_tokens(
+            io.StringIO(mod.source).readline
+        )
+        comments = [
+            (tok.start[0], tok.string)
+            for tok in tokens
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return {}, []  # unparseable files already surface as BA900
+    for lineno, text in comments:
+        m = _DONATES_RE.search(text)
+        if m:
+            names = tuple(
+                s.strip() for s in m.group(1).split(",") if s.strip()
+            )
+            if names:
+                hits[lineno] = names
+    if not hits:
+        return {}, []
+    specs, errors = {}, []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        names = hits.pop(node.lineno, None)
+        if names is None:
+            continue
+        params = [
+            p.arg for p in node.args.posonlyargs + node.args.args
+        ]
+        unknown = [nm for nm in names if nm not in params]
+        if unknown:
+            errors.append(
+                (
+                    node.lineno,
+                    f"donates() annotation names {unknown} which are "
+                    f"not positional parameters of {node.name}() "
+                    f"(has {params})",
+                )
+            )
+            continue
+        specs[f"{mod.modname}.{node.name}"] = DonationSpec(
+            frozenset(params.index(nm) for nm in names), tuple(params)
+        )
+    # Hits left over never matched a def line (e.g. a stray annotation
+    # on a call site): also a declaration defect worth surfacing.
+    errors.extend(
+        (lineno, "donates() annotation is not on a function def line")
+        for lineno in sorted(hits)
+    )
+    return specs, errors
+
+
+def _donation_table(project) -> tuple:
+    """``(merged table, {modname: [(lineno, message)]})``:
+    KNOWN_DONATING overlaid by every module's ``donates()`` annotations.
+    Built once per Project and memoized on it (rule instances are
+    registry singletons; a cross-run cache would go stale)."""
+    cached = project.__dict__.get("_ba201_annotations")
+    if cached is None:
+        table = dict(KNOWN_DONATING)
+        bad = {}
+        for mod in project.modules.values():
+            specs, errors = annotated_donations(mod)
+            table.update(specs)
+            if errors:
+                bad[mod.modname] = errors
+        cached = (table, bad)
+        project.__dict__["_ba201_annotations"] = cached
+    return cached
 
 
 class _PoisonState(FlowState):
@@ -69,10 +184,11 @@ class _PoisonState(FlowState):
 
 
 class _Handler(FlowHandler):
-    def __init__(self, rule, mod, project):
+    def __init__(self, rule, mod, project, extra):
         self.rule = rule
         self.mod = mod
         self.project = project
+        self.extra = extra
         self.findings = {}
 
     def on_load(self, node, state):
@@ -96,7 +212,7 @@ class _Handler(FlowHandler):
 
     def on_call(self, call, state):
         spec = self.project.donation_for(
-            self.mod, call.func, KNOWN_DONATING
+            self.mod, call.func, self.extra
         )
         if spec is None:
             return
@@ -117,7 +233,12 @@ class UseAfterDonate(Rule):
     severity = "error"
 
     def check_module(self, mod, project):
-        handler = _Handler(self, mod, project)
+        table, bad = _donation_table(project)
+        for lineno, message in bad.get(mod.modname, ()):
+            anchor = ast.Module(body=[], type_ignores=[])
+            anchor.lineno, anchor.col_offset = lineno, 0
+            yield self.finding(mod, anchor, message)
+        handler = _Handler(self, mod, project, table)
         for _scope, body in function_scopes(mod.tree):
             walk_body(body, handler, _PoisonState())
         yield from handler.findings.values()

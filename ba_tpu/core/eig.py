@@ -41,6 +41,7 @@ from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.quorum import majority_counts, quorum_decision, strict_majority
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, RETREAT
+from ba_tpu.scenario.strategies import lie_values
 
 
 def _coin(key: jax.Array, shape) -> jnp.ndarray:
@@ -58,23 +59,36 @@ def _in_path_mask(n: int, level: int) -> np.ndarray:
     return mask
 
 
-def eig_send(key: jax.Array, state: SimState, m: int) -> list[jnp.ndarray]:
+def eig_send(
+    key: jax.Array,
+    state: SimState,
+    m: int,
+    strategies: jnp.ndarray | None = None,
+) -> list[jnp.ndarray]:
     """Sending phase: build levels V_0..V_m of every general's EIG tree.
 
     V_0[b, i] is what the leader told i (round-1 broadcast with per-recipient
     equivocation coins, ba.py:258-282).  Each subsequent level is one relay
     round: V_{l+1}[b, i, p*n + j] = what j told i about path p — j's honest
     copy V_l[b, j, p], or a fresh coin if j is faulty (self-messages stay
-    honest).
+    honest).  ``strategies`` replaces faulty relay j's coin with its
+    strategy value per receiver i (scenario engine); all-RANDOM is the
+    coin path bit-exactly.
     """
     B, n = state.faulty.shape
     keys = jr.split(key, m + 1)
-    levels = [round1_broadcast(keys[0], state)]
+    levels = [round1_broadcast(keys[0], state, strategies)]
     eye = jnp.eye(n, dtype=bool)
     for level in range(m):
         prev = levels[-1].reshape(B, n, n**level)
         P = n**level
         coins = _coin(keys[level + 1], (B, n, P, n))
+        if strategies is not None:
+            coins = lie_values(
+                strategies[:, None, None, :],
+                coins,
+                jnp.arange(n)[None, :, None, None],
+            )
         # relayed[b, i, p, j] = V_l[b, j, p], broadcast over receivers i.
         relayed = jnp.transpose(prev, (0, 2, 1))[:, None, :, :]
         relayed = jnp.broadcast_to(relayed, (B, n, P, n))
@@ -259,7 +273,11 @@ def eig_deepest_fused(
 
 
 def eig_round(
-    key: jax.Array, state: SimState, m: int, max_liars: int | None = None
+    key: jax.Array,
+    state: SimState,
+    m: int,
+    max_liars: int | None = None,
+    strategies: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Full OM(m) exchange -> per-general majorities [B, n] int8.
 
@@ -273,15 +291,25 @@ def eig_round(
     ``BA_TPU_EIG_FUSED=0`` restores the fully-dense path (the two are
     differential-tested against each other).  m=1 always uses the dense
     path, which is bit-exact with om1_round (test_eig.py pins that).
+
+    ``strategies`` (scenario engine) forces the DENSE path for m >= 2:
+    the fused level's Binomial coin-collapse is a fair-coin identity and
+    does not hold for coordinated adversaries (a strategy-aware fused
+    level is a ROADMAP follow-on).  Passing it as None keeps today's
+    fused behaviour bit-for-bit.
     """
     import os
 
     if m == 0:
         # round1_broadcast already pins the leader slot to the true order.
-        return round1_broadcast(key, state)
-    fused = m >= 2 and os.environ.get("BA_TPU_EIG_FUSED", "1") != "0"
+        return round1_broadcast(key, state, strategies)
+    fused = (
+        m >= 2
+        and strategies is None
+        and os.environ.get("BA_TPU_EIG_FUSED", "1") != "0"
+    )
     if not fused:
-        levels = eig_send(key, state, m)
+        levels = eig_send(key, state, m, strategies)
         return eig_resolve(state, levels)
     k_send, k_coin = jr.split(key)
     levels = eig_send(k_send, state, m - 1)  # levels 0..m-1 only

@@ -16,6 +16,14 @@ round 1 is a masked select on the leader row, round 2 is the all-to-all
 "answers" matrix (the O(n^2) RPC mesh becomes a broadcast) and a masked
 reduction per receiver.  Faulty behaviour is injected as seeded Bernoulli
 masks — the vectorized equivalent of ``random.randint(0, 1)`` per call.
+
+Adversary strategies (scenario engine, ISSUE 5): every send path takes
+an optional per-general ``strategies`` plane ([B, n] int8,
+``ba_tpu.scenario.strategies`` ids).  ``None`` (the default) and the
+all-RANDOM plane are bit-exact with the historical coin behaviour (the
+coins are drawn identically and selected through unchanged); other ids
+replace a faulty sender's coin values branch-free (collusion, silence,
+vote-splitting) so vmap/scan fusion is untouched.
 """
 
 from __future__ import annotations
@@ -28,6 +36,7 @@ from ba_tpu.core.quorum import majority_counts, quorum_decision, strict_majority
 from ba_tpu.core.rng import coin_bits
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, RETREAT
+from ba_tpu.scenario.strategies import lie_values
 
 
 def _coin(key: jax.Array, shape) -> jnp.ndarray:
@@ -35,16 +44,25 @@ def _coin(key: jax.Array, shape) -> jnp.ndarray:
     return coin_bits(key, shape)
 
 
-def round1_broadcast(key: jax.Array, state: SimState) -> jnp.ndarray:
+def round1_broadcast(
+    key: jax.Array, state: SimState, strategies: jnp.ndarray | None = None
+) -> jnp.ndarray:
     """What each general received from the leader: [B, n] int8.
 
     Honest leader: everyone gets ``order``.  Faulty leader: an independent
-    coin per recipient (ba.py:268-273).  The leader itself always holds the
-    true order (ba.py:261).  Dead recipients' slots are computed but masked
-    out downstream — keeping the shape static for XLA.
+    coin per recipient (ba.py:268-273) — or, with ``strategies``, the
+    leader's strategy applied per recipient (a SILENT leader's recipients
+    receive UNDEFINED, the dropped-message encoding).  The leader itself
+    always holds the true order (ba.py:261).  Dead recipients' slots are
+    computed but masked out downstream — keeping the shape static for XLA.
     """
     B, n = state.faulty.shape
     coins = _coin(key, (B, n))
+    if strategies is not None:
+        leader_strategy = jnp.take_along_axis(
+            strategies, state.leader[:, None], axis=1
+        )
+        coins = lie_values(leader_strategy, coins, jnp.arange(n)[None, :])
     leader_onehot = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0
     leader_faulty = jnp.take_along_axis(state.faulty, state.leader[:, None], axis=1)
     received = jnp.where(leader_faulty, coins, state.order[:, None])
@@ -52,19 +70,31 @@ def round1_broadcast(key: jax.Array, state: SimState) -> jnp.ndarray:
     return received
 
 
-def round2_votes(key: jax.Array, state: SimState, received: jnp.ndarray) -> jnp.ndarray:
+def round2_votes(
+    key: jax.Array,
+    state: SimState,
+    received: jnp.ndarray,
+    strategies: jnp.ndarray | None = None,
+) -> jnp.ndarray:
     """The all-to-all answer cube: answers[b, i, j] = what j tells asker i.
 
     Replaces the reference's O(n^2) ``get_order()`` RPC mesh (ba.py:169-186)
     with one broadcast + masked select.  Faulty responders lie with a fresh
     coin *per asker* — different callers can get different answers, the
-    Byzantine behaviour of ba.py:44-49.  A general answers itself truthfully
-    (its own received command is its own first vote, ba.py:163-167) — note a
-    faulty general still *tallies* honestly; its lies only affect what others
+    Byzantine behaviour of ba.py:44-49 — or, with ``strategies``, with
+    responder j's strategy applied per asker (SILENT answers UNDEFINED,
+    which no tally counts: the dead-peer try/except of ba.py:185-186 as an
+    adversary choice).  A general answers itself truthfully (its own
+    received command is its own first vote, ba.py:163-167) — note a faulty
+    general still *tallies* honestly; its lies only affect what others
     hear from it (SURVEY.md Q3).
     """
     B, n = state.faulty.shape
     coins = _coin(key, (B, n, n))
+    if strategies is not None:
+        coins = lie_values(
+            strategies[:, None, :], coins, jnp.arange(n)[None, :, None]
+        )
     answers = jnp.where(state.faulty[:, None, :], coins, received[:, None, :])
     eye = jnp.eye(n, dtype=bool)[None]
     answers = jnp.where(eye, received[:, None, :], answers)
@@ -91,11 +121,18 @@ def tally_majorities(state: SimState, received: jnp.ndarray, answers: jnp.ndarra
     return majority
 
 
-def om1_round(key: jax.Array, state: SimState) -> jnp.ndarray:
-    """Full OM(1) message exchange -> per-general majorities [B, n] int8."""
+def om1_round(
+    key: jax.Array, state: SimState, strategies: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """Full OM(1) message exchange -> per-general majorities [B, n] int8.
+
+    ``strategies`` ([B, n] int8, scenario engine) selects each faulty
+    general's adversary behaviour; ``None`` and the all-RANDOM plane are
+    bit-exact with the coin-only fault model under the same key.
+    """
     k1, k2 = jr.split(key)
-    received = round1_broadcast(k1, state)
-    answers = round2_votes(k2, state, received)
+    received = round1_broadcast(k1, state, strategies)
+    answers = round2_votes(k2, state, received, strategies)
     return tally_majorities(state, received, answers)
 
 

@@ -59,6 +59,7 @@ from ba_tpu.core.rng import coin_bits, or_coin_threshold8, uniform_u8
 from ba_tpu.core.quorum import majority_counts, quorum_decision
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+from ba_tpu.scenario.strategies import send_gate
 
 
 def _initial_seen(state: SimState, received: jnp.ndarray) -> jnp.ndarray:
@@ -74,6 +75,7 @@ def sm_relay_rounds(
     seen: jnp.ndarray,
     m: int,
     withhold: jnp.ndarray | None = None,
+    strategies: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Run m relay rounds; returns the final seen[b, i, v] masks.
 
@@ -81,7 +83,21 @@ def sm_relay_rounds(
     per-(round, receiver, sender, value) withholding decisions — the
     adversary schedule.  Default: fair coins, the vectorized analogue of
     the reference's per-call randomness (ba.py:44-49).
+
+    ``strategies`` ([B, n] int8, scenario engine) shapes the coin gates
+    instead: in SM(m) forgery-freeness is structural, so a strategy can
+    only choose WHAT a faulty holder forwards — colluders forward only
+    the coalition value, SILENT generals never forward (the ``withhold``
+    schedule generalized), ADAPTIVE_SPLIT routes values by receiver
+    parity.  Mutually exclusive with ``withhold`` (which pins the full
+    cube); all-RANDOM is bit-exact with the default coins.  The
+    chain-length soundness bound applies unchanged — gates only restrict
+    sends the exact model already allowed.
     """
+    if withhold is not None and strategies is not None:
+        raise ValueError(
+            "withhold pins the full send cube; strategies cannot also apply"
+        )
     B, n = state.faulty.shape
     # Coalition size: traitors among the living (incl. a faulty commander).
     t = jnp.sum(state.faulty & state.alive, axis=-1)  # [B]
@@ -90,6 +106,13 @@ def sm_relay_rounds(
     for r in range(1, m + 1):  # relay round r: chains have r+1 signers
         if withhold is None:
             coins = coin_bits(jr.fold_in(key, r), (B, n, n, 2), bool)
+            if strategies is not None:
+                coins = send_gate(
+                    strategies[:, None, :, None],
+                    coins,
+                    jnp.arange(n)[None, :, None, None],
+                    jnp.arange(2)[None, None, None, :],
+                )
         else:
             coins = ~withhold[r - 1]
         # Who was held by some honest general *before* this round: those
@@ -212,6 +235,7 @@ def sm_round(
     sig_valid: jnp.ndarray | None = None,
     received: jnp.ndarray | None = None,
     collapsed: bool = False,
+    strategies: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Full SM(m) exchange -> per-general choices [B, n] int8.
 
@@ -226,19 +250,29 @@ def sm_round(
     ``collapsed`` selects the O(B*n) fair-coin relay
     (``sm_relay_rounds_collapsed``); incompatible with ``withhold``, which
     needs the per-(receiver, sender) cube.
+    ``strategies`` ([B, n] int8, scenario engine) shapes the commander's
+    round-1 equivocation and the relay's withhold gates (see
+    ``sm_relay_rounds``); it needs the exact cube too, so it is
+    incompatible with ``collapsed`` (the collapsed relay's OR-collapse is
+    a fair-coin identity) and with an explicit ``withhold``.
     """
     k1, k2 = jr.split(key)
     if received is None:
-        received = round1_broadcast(k1, state)
+        received = round1_broadcast(k1, state, strategies)
     seen = _initial_seen(state, received)
     if sig_valid is not None:
         seen = seen & sig_valid[..., None]
     if collapsed:
         if withhold is not None:
             raise ValueError("collapsed relay cannot honor a withhold schedule")
+        if strategies is not None:
+            raise ValueError(
+                "collapsed relay is a fair-coin identity; strategies need "
+                "the exact per-(receiver, sender) cube"
+            )
         seen = sm_relay_rounds_collapsed(k2, state, seen, m)
     else:
-        seen = sm_relay_rounds(k2, state, seen, m, withhold)
+        seen = sm_relay_rounds(k2, state, seen, m, withhold, strategies)
     return sm_choice(state, seen)
 
 
@@ -250,13 +284,16 @@ def sm_agreement(
     sig_valid: jnp.ndarray | None = None,
     received: jnp.ndarray | None = None,
     collapsed: bool = False,
+    strategies: jnp.ndarray | None = None,
 ):
     """SM(m) agreement + the 3f+1 quorum layer: the signed ``actual-order``.
 
     Same output dict as ``om1_agreement`` (the REPL's hot path,
     ba.py:376-399) so backends can swap OM for SM transparently.
     """
-    majorities = sm_round(key, state, m, withhold, sig_valid, received, collapsed)
+    majorities = sm_round(
+        key, state, m, withhold, sig_valid, received, collapsed, strategies
+    )
     n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
     decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
     return {

@@ -26,6 +26,7 @@ from ba_tpu.parallel.mesh import make_mesh
 from ba_tpu.parallel.multihost import init_distributed, make_global_mesh, put_global
 from ba_tpu.parallel.pipeline import (
     COUNTER_NAMES,
+    SCENARIO_COUNTER_NAMES,
     KeySchedule,
     agreement_counters_init,
     fresh_copy,
@@ -33,6 +34,9 @@ from ba_tpu.parallel.pipeline import (
     pipeline_megastep,
     pipeline_sweep,
     round_keys,
+    scenario_counters_init,
+    scenario_megastep,
+    scenario_sweep,
 )
 from ba_tpu.parallel.sweep import (
     bucketed_sweep_states,
@@ -50,6 +54,7 @@ __all__ = [
     "make_global_mesh",
     "put_global",
     "COUNTER_NAMES",
+    "SCENARIO_COUNTER_NAMES",
     "KeySchedule",
     "agreement_counters_init",
     "fresh_copy",
@@ -57,6 +62,9 @@ __all__ = [
     "pipeline_megastep",
     "pipeline_sweep",
     "round_keys",
+    "scenario_counters_init",
+    "scenario_megastep",
+    "scenario_sweep",
     "failover_sweep",
     "sharded_sweep",
     "make_sweep_state",

@@ -63,6 +63,7 @@ import jax.random as jr
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ba_tpu import obs
+from ba_tpu.core.election import elect_lowest_id
 from ba_tpu.core.state import SimState
 from ba_tpu.core.types import UNDEFINED
 from ba_tpu.parallel.multihost import put_global
@@ -73,6 +74,16 @@ from ba_tpu.parallel.sweep import agreement_step
 # engine's existing depth-delayed retire fetch (counter rows piggyback
 # the histogram block), so BA101 and the no-blocking test stay clean.
 COUNTER_NAMES = ("quorum_failures", "unanimous_rounds", "equivocation_observed")
+
+# Scenario campaigns (ISSUE 5) extend the block with per-round IC1/IC2
+# property verdicts — the Interactive Consistency conditions of the
+# Byzantine Generals paper, checked on device every round and drained at
+# the same retire points: IC1 = all honest alive lieutenants of an
+# instance agree; IC2 = under an honest commander they agree on ITS
+# order.  The first len(COUNTER_NAMES) entries are bit-identical to the
+# PR 4 block (the counters stay protocol-agnostic: everything reads
+# ``agreement_step`` outputs + the state, never the protocol's RNG).
+SCENARIO_COUNTER_NAMES = COUNTER_NAMES + ("ic1_violations", "ic2_violations")
 
 
 @jax.tree_util.register_dataclass
@@ -98,6 +109,13 @@ def fresh_copy(tree):
     The one sanctioned way to keep a usable handle on buffers about to
     enter the engine's donation thread: dispatches CONSUME their inputs,
     so a caller that needs the pre-run state afterwards copies it first.
+
+    Also the sanctioned way to LAUNDER host-staged arrays into the
+    donation thread: ``jnp.asarray(numpy)`` may ZERO-COPY on CPU, and
+    donating a buffer that aliases live host memory makes the returned
+    aliased carry nondeterministically wrong — copy first when the
+    pytree was built from numpy (runtime/backends.run_scenario learned
+    this the hard way).
     """
     return jax.tree.map(lambda x: x.copy(), tree)
 
@@ -237,7 +255,194 @@ def pipeline_megastep(
     return (carry[0], carry[1], *ys)
 
 
-def pipeline_sweep(
+def scenario_counters_init() -> jax.Array:
+    """A zeroed scenario counter block (one int32 per
+    SCENARIO_COUNTER_NAMES: the PR 4 agreement counters + the IC1/IC2
+    verdict tallies)."""
+    return jnp.zeros((len(SCENARIO_COUNTER_NAMES),), jnp.int32)
+
+
+def scenario_counter_delta(out: dict, state: SimState) -> jax.Array:
+    """One round's scenario counter increments (trace-time, in-scan).
+
+    The PR 4 agreement deltas (:func:`agreement_counter_delta`, first
+    three entries — bit-identical to the non-scenario path) followed by
+    the per-round IC1/IC2 property verdicts:
+
+    - ``ic1_violations``: instances whose honest ALIVE lieutenants'
+      majorities disagree — Interactive Consistency condition 1 broken
+      this round (with t too large or a coordinated adversary this is
+      reachable; under the classical n > 3t bound it must stay 0, which
+      the property tests assert);
+    - ``ic2_violations``: instances whose commander is honest yet some
+      honest alive lieutenant's majority differs from the commander's
+      order — IC2 broken.
+
+    Protocol-agnostic like the base block: reads ``agreement_step``
+    outputs and the (post-mutation) state only, never the round's RNG —
+    and host-reproducible from the majorities stream, which the
+    kill-mid-campaign bit-match test pins.
+    """
+    base = agreement_counter_delta(out, state)
+    maj = out["majorities"]
+    idx = jnp.arange(state.faulty.shape[1])[None, :]
+    honest_lt = (
+        state.alive & ~state.faulty & (idx != state.leader[:, None])
+    )
+    big = jnp.asarray(127, maj.dtype)
+    mmax = jnp.max(jnp.where(honest_lt, maj, -big), axis=1)
+    mmin = jnp.min(jnp.where(honest_lt, maj, big), axis=1)
+    ic1 = jnp.sum(
+        (mmax != mmin) & honest_lt.any(axis=1), dtype=jnp.int32
+    )
+    leader_faulty = jnp.take_along_axis(
+        state.faulty, state.leader[:, None], axis=1
+    )[:, 0]
+    disobey = (honest_lt & (maj != state.order[:, None])).any(axis=1)
+    ic2 = jnp.sum(~leader_faulty & disobey, dtype=jnp.int32)
+    return jnp.concatenate([base, jnp.stack([ic1, ic2])])
+
+
+def _scenario_scan(
+    state: SimState,
+    sched: KeySchedule,
+    strategy: jax.Array,
+    counters: jax.Array,
+    events: dict,
+    *,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+):
+    """The mutating-round scan core (trace-time; shared verbatim by the
+    donated :func:`scenario_megastep` and the jittable
+    ``parallel.sweep.failover_sweep`` wrapper, so there is exactly ONE
+    implementation of the kill → re-elect → agree transition).
+
+    ``events`` is a dict of ``[rounds, B, n]`` planes (a
+    ``ScenarioBlock.chunk``): ``kill``/``revive`` bool alive-mask
+    deltas, ``set_faulty``/``set_strategy`` int8 tri-states (-1 keep).
+    Per round, in REPL order (commands land between rounds,
+    ba.py:354-445):
+
+    1. membership + fault-flag + strategy mutations apply;
+    2. instances whose leader died re-elect by lowest alive id
+       (ba.py:126-157); a living leader is never displaced — "election
+       is for life" (ba.py:124-125), so a revived lower id waits;
+    3. the strategy-aware agreement round runs
+       (``agreement_step(strategies=...)``) and the scenario counter
+       block folds the round's deltas (incl. IC1/IC2 verdicts).
+
+    Returns ``(carry, ys)`` with carry ``(state, sched, strategy,
+    counters)`` and ys ``(histograms, leaders, counter_rows[,
+    decisions])`` — leaders are post-election, counter rows cumulative.
+    """
+
+    def body(carry, ev):
+        st, sc, strat, ctr = carry
+        kill, revive, fset, sset = ev
+        alive = (st.alive & ~kill) | revive
+        faulty = jnp.where(fset >= 0, fset > 0, st.faulty)
+        strat = jnp.where(sset >= 0, sset, strat)
+        leader_alive = jnp.take_along_axis(
+            alive, st.leader[:, None], axis=1
+        )[:, 0]
+        leader = jnp.where(
+            leader_alive, st.leader, elect_lowest_id(st.ids, alive)
+        )
+        st = SimState(st.order, leader, faulty, alive, st.ids)
+        keys = round_keys(sc, st.batch)
+        out = agreement_step(
+            keys, st, m=m, max_liars=max_liars, strategies=strat
+        )
+        ctr = ctr + scenario_counter_delta(out, st)
+        nxt = KeySchedule(sc.key_data, sc.counter + 1)
+        ys = (out["histogram"], leader, ctr)
+        if collect_decisions:
+            ys += (out["decision"],)
+        return (st, nxt, strat, ctr), ys
+
+    xs = (
+        events["kill"],
+        events["revive"],
+        events["set_faulty"],
+        events["set_strategy"],
+    )
+    return jax.lax.scan(
+        body, (state, sched, strategy, counters), xs,
+        length=rounds, unroll=unroll,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rounds", "m", "max_liars", "unroll", "collect_decisions"),
+    donate_argnums=(0, 1, 2),
+)
+def scenario_megastep(
+    state: SimState,
+    sched: KeySchedule,
+    strategy: jax.Array,
+    counters: jax.Array,
+    events: dict,
+    *,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+):
+    """``rounds`` MUTATING agreement rounds in one donated dispatch: the
+    scenario engine's megastep (ISSUE 5 tentpole).
+
+    The mutating scenario state — the :class:`SimState` (alive/faulty/
+    leader now change in-scan), the key schedule, and the live
+    per-general strategy plane — rides the donated carry next to PR 4's
+    counter slots, so every steady-state buffer aliases in place and a
+    campaign dispatch allocates only its small outputs.  The per-round
+    event planes enter as the scan's consumed ``xs``; like the counter
+    block they are NOT donate-annotated — none of the outputs matches
+    their shapes, so XLA could alias nothing (the counter thread
+    continues through the stacked rows, the PR 4 pattern).
+
+    DONATION CONTRACT: ``state``, ``sched`` and ``strategy`` are
+    CONSUMED — thread the returned ``(state, sched, strategy, ...)``
+    and never touch the donated inputs again
+    (``pipeline_sweep(scenario=...)`` is the driver that does this for
+    you).
+
+    Returns ``(state, sched, strategy, histograms, leaders,
+    counter_rounds[, decisions])``: histograms ``[rounds, 3]``, leaders
+    ``[rounds, B]`` (post-election, the ``failover_sweep`` output
+    generalized), counter_rounds ``[rounds, len(SCENARIO_COUNTER_NAMES)]``
+    cumulative rows whose last row continues the counter thread — all
+    reaching the host inside the engine's existing depth-delayed retire
+    fetch, zero added synchronization.
+
+    Bit-compat contract: with the all-RANDOM strategy plane and no-op
+    event planes, round ``sched.counter + r`` is bit-identical to
+    :func:`pipeline_megastep`'s round (the empty-scenario parity test);
+    with kill planes only it is bit-identical to ``failover_sweep``
+    (same scan core, same schedule).
+    """
+    carry, ys = _scenario_scan(
+        state,
+        sched,
+        strategy,
+        counters,
+        events,
+        rounds=rounds,
+        m=m,
+        max_liars=max_liars,
+        unroll=unroll,
+        collect_decisions=collect_decisions,
+    )
+    return (carry[0], carry[1], carry[2], *ys)
+
+
+def pipeline_sweep(  # ba-lint: donates(state)
     key: jax.Array,
     state: SimState,
     rounds: int,
@@ -252,6 +457,8 @@ def pipeline_sweep(
     host_work=None,
     mesh: Mesh | None = None,
     on_event=None,
+    scenario=None,
+    initial_strategy: jax.Array | None = None,
 ):
     """Run ``rounds`` sweep rounds through the depth-k pipelined engine.
 
@@ -290,6 +497,26 @@ def pipeline_sweep(
       ``rounds_per_dispatch``, ``max_in_flight``, and
       ``retires_before_drain`` (how many retires the steady-state loop
       performed; the rest drained at the end).
+
+    SCENARIO MODE (ISSUE 5): pass ``scenario`` (a compiled
+    ``ba_tpu.scenario.compile.ScenarioBlock`` whose ``rounds``/shape
+    match) and every dispatch runs :func:`scenario_megastep` instead —
+    kills, revivals, fault-flag flips, strategy reassignment, and
+    lowest-alive-id leader re-election all ride the same donated scan,
+    with the per-general strategy plane (``initial_strategy``, default
+    all-RANDOM) as an extra donated carry slot.  Counters are always on
+    (the block grows the IC1/IC2 verdict entries —
+    ``SCENARIO_COUNTER_NAMES``) and the result additionally carries:
+
+    - ``leaders`` [rounds, B] host int32 — each round's post-election
+      leader (``failover_sweep``'s output, pipelined);
+    - ``final_strategy`` — the live strategy plane continuing the
+      campaign.
+
+    The per-dispatch event chunks are sliced/staged asynchronously
+    (uploads queue behind the in-flight dispatches; the no-blocking
+    test runs with a live scenario block), and an empty scenario is
+    bit-exact with the plain engine under the same key.
     """
     if rounds < 1:
         raise ValueError(f"rounds={rounds} must be >= 1")
@@ -301,9 +528,46 @@ def pipeline_sweep(
         )
     if unroll < 1:
         raise ValueError(f"unroll={unroll} must be >= 1")
+    strategy = None
+    if scenario is not None:
+        if scenario.rounds != rounds:
+            raise ValueError(
+                f"scenario block covers {scenario.rounds} round(s), "
+                f"sweep asked for {rounds}"
+            )
+        B, n = state.faulty.shape
+        if (scenario.batch, scenario.n) != (B, n):
+            raise ValueError(
+                f"scenario block is [{scenario.batch}, {scenario.n}] "
+                f"per round, state is [{B}, {n}]"
+            )
+        # Scenario campaigns always thread the (extended) counter block:
+        # the IC1/IC2 verdicts ARE the campaign's product, and they ride
+        # the existing retire fetch for free.
+        with_counters = True
+        if initial_strategy is None:
+            strategy = jnp.zeros((B, n), jnp.int8)  # everyone RANDOM
+        else:
+            strategy = jnp.asarray(initial_strategy, jnp.int8)
+            if strategy.shape != (B, n):
+                raise ValueError(
+                    f"initial_strategy shape {strategy.shape} != {(B, n)}"
+                )
+            # The plane joins the donated carry, but initial_strategy is
+            # NOT part of the documented donation contract (only state
+            # is) — and jnp.asarray zero-copies both device arrays and
+            # int8 numpy, so without this copy the first dispatch would
+            # consume the CALLER's buffer (or worse, donate live host
+            # memory — the fresh_copy hazard).
+            strategy = strategy.copy()
+    elif initial_strategy is not None:
+        raise ValueError("initial_strategy needs a scenario block")
 
     sched = make_key_schedule(key)
-    counters = agreement_counters_init() if with_counters else None
+    if scenario is not None:
+        counters = scenario_counters_init()
+    else:
+        counters = agreement_counters_init() if with_counters else None
     if mesh is not None:
         state = jax.tree.map(
             lambda x: put_global(
@@ -319,6 +583,9 @@ def pipeline_sweep(
             # global deltas (agreement_counter_delta reduces over the
             # full batch, which XLA turns into the histogram's psum).
             counters = put_global(mesh, counters, P(None))
+        if strategy is not None:
+            # The strategy plane shards with the batch it describes.
+            strategy = put_global(mesh, strategy, P("data", None))
 
     chunks = [rounds_per_dispatch] * (rounds // rounds_per_dispatch)
     if rounds % rounds_per_dispatch:
@@ -341,6 +608,18 @@ def pipeline_sweep(
     occ_h = reg.histogram("pipeline_depth_occupancy", base=1.0, n_buckets=16)
     disp_c = reg.counter("pipeline_dispatches_total")
     ret_c = reg.counter("pipeline_retires_total")
+    if scenario is not None:
+        # Scenario-phase instants + scenario_* counters (ISSUE 5 obs
+        # wiring): clock reads and in-memory scalar ops only — the
+        # no-blocking test runs with a live scenario block to pin it.
+        obs.instant(
+            "scenario_start",
+            rounds=rounds,
+            batch=state.faulty.shape[0],
+            capacity=state.faulty.shape[1],
+        )
+        reg.counter("scenario_campaigns_total").inc()
+        reg.counter("scenario_rounds_total").inc(rounds)
 
     def retire():
         # t_sub rides the in-flight tuple (perf_counter_ns at submit).
@@ -358,6 +637,7 @@ def pipeline_sweep(
         if on_event is not None:
             on_event("retire", d)
 
+    round_base = 0
     for d, nr in enumerate(chunks):
         # First dispatch of a fresh static specialization pays trace +
         # compile (or a persistent-cache load) synchronously before the
@@ -367,14 +647,6 @@ def pipeline_sweep(
         # `recompile` record diffing exactly these axes).  "meshed"
         # rides the axes because sharded inputs force a fresh
         # specialization even at equal shapes/statics.
-        kwargs = dict(
-            rounds=nr,
-            m=m,
-            max_liars=max_liars,
-            unroll=min(unroll, nr),
-            collect_decisions=collect_decisions,
-            counters=counters,
-        )
         axes = {
             "batch": state.faulty.shape[0],
             "capacity": state.faulty.shape[1],
@@ -385,40 +657,101 @@ def pipeline_sweep(
             "collect_decisions": collect_decisions,
             "counters": with_counters,
             "meshed": mesh is not None,
+            "scenario": scenario is not None,
         }
-        with obs.compile_or_dispatch_span(
-            "pipeline_megastep", axes=axes, dispatch=d, rounds=nr
-        ) as phase:
-            with obs.xla.annotate("megastep_dispatch", dispatch=d):
-                out = pipeline_megastep(state, sched, **kwargs)
-        if phase == "compile" and obs.xla.enabled():
-            # Device-tier artifact: AOT-harvest this specialization's
-            # cost/memory analysis (flops, bytes, donation-alias
-            # evidence).  The abstract signature is read off the
-            # RETURNED carry — the megastep threads state/sched through
-            # at unchanged shapes/dtypes, so the outputs' signature
-            # equals the consumed (donated) inputs' — and is built only
-            # on the one-or-two compile dispatches per sweep, keeping
-            # the steady-state loop free of tree walks.  After the span
-            # and before t_sub, so the extra AOT compile inflates
-            # neither compile_time_s nor dispatch latency (it has its
-            # own xla_introspect_s histogram).
-            obs.xla.introspect(
-                pipeline_megastep,
-                "pipeline_megastep",
-                obs.xla.abstractify((out[0], out[1])),
-                obs.xla.abstractify(kwargs),
-                axes=axes,
+        if scenario is not None:
+            # Stage this dispatch's event planes: a host-array slice is
+            # an ASYNC upload, a device-array slice a lazy device op —
+            # neither waits on the in-flight dispatches.
+            ev = {
+                k: jnp.asarray(v)
+                for k, v in scenario.chunk(round_base, round_base + nr).items()
+            }
+            if mesh is not None:
+                ev = {
+                    k: put_global(mesh, v, P(None, "data", None))
+                    for k, v in ev.items()
+                }
+            kwargs = dict(
+                rounds=nr,
+                m=m,
+                max_liars=max_liars,
+                unroll=min(unroll, nr),
+                collect_decisions=collect_decisions,
             )
+            with obs.compile_or_dispatch_span(
+                "scenario_megastep", axes=axes, dispatch=d, rounds=nr
+            ) as phase:
+                with obs.xla.annotate("megastep_dispatch", dispatch=d):
+                    out = scenario_megastep(
+                        state, sched, strategy, counters, ev, **kwargs
+                    )
+            if phase == "compile" and obs.xla.enabled():
+                # Donated args keep their shape/dtype metadata after the
+                # dispatch consumes them, which is all abstractify reads
+                # (same contract the plain path relies on for kwargs).
+                obs.xla.introspect(
+                    scenario_megastep,
+                    "scenario_megastep",
+                    obs.xla.abstractify(
+                        (out[0], out[1], out[2], counters, ev)
+                    ),
+                    obs.xla.abstractify(kwargs),
+                    axes=axes,
+                )
+        else:
+            kwargs = dict(
+                rounds=nr,
+                m=m,
+                max_liars=max_liars,
+                unroll=min(unroll, nr),
+                collect_decisions=collect_decisions,
+                counters=counters,
+            )
+            with obs.compile_or_dispatch_span(
+                "pipeline_megastep", axes=axes, dispatch=d, rounds=nr
+            ) as phase:
+                with obs.xla.annotate("megastep_dispatch", dispatch=d):
+                    out = pipeline_megastep(state, sched, **kwargs)
+            if phase == "compile" and obs.xla.enabled():
+                # Device-tier artifact: AOT-harvest this specialization's
+                # cost/memory analysis (flops, bytes, donation-alias
+                # evidence).  The abstract signature is read off the
+                # RETURNED carry — the megastep threads state/sched
+                # through at unchanged shapes/dtypes, so the outputs'
+                # signature equals the consumed (donated) inputs' — and
+                # is built only on the one-or-two compile dispatches per
+                # sweep, keeping the steady-state loop free of tree
+                # walks.  After the span and before t_sub, so the extra
+                # AOT compile inflates neither compile_time_s nor
+                # dispatch latency (it has its own xla_introspect_s
+                # histogram).
+                obs.xla.introspect(
+                    pipeline_megastep,
+                    "pipeline_megastep",
+                    obs.xla.abstractify((out[0], out[1])),
+                    obs.xla.abstractify(kwargs),
+                    axes=axes,
+                )
+        round_base += nr
         t_sub = time.perf_counter_ns()
         disp_c.inc()
-        state, sched = out[0], out[1]
-        ys = out[2:]
-        if with_counters:
-            # The stacked cumulative rows' last row continues the
-            # counter thread into the next dispatch — a lazy device
-            # slice, not a fetch.
-            counters = ys[-1][-1]
+        if scenario is not None:
+            state, sched, strategy = out[0], out[1], out[2]
+            ys = out[3:]
+            # Cumulative counter rows sit at ys[2] on the scenario path
+            # (histograms, leaders, counter_rows[, decisions]); the last
+            # row continues the thread — a lazy device slice, not a
+            # fetch.
+            counters = ys[2][-1]
+        else:
+            state, sched = out[0], out[1]
+            ys = out[2:]
+            if with_counters:
+                # The stacked cumulative rows' last row continues the
+                # counter thread into the next dispatch — a lazy device
+                # slice, not a fetch.
+                counters = ys[-1][-1]
         if on_event is not None:
             on_event("dispatch", d)
         inflight.append((d, ys, t_sub))
@@ -452,6 +785,28 @@ def pipeline_sweep(
             "retires_before_drain": retires_before_drain,
         },
     }
+    if scenario is not None:
+        # Everything below is host arithmetic over blocks the retire
+        # fetches already brought back — the campaign "drain" adds no
+        # synchronization (the no-blocking test runs a live block).
+        result["leaders"] = _host_np.concatenate([ys[1] for ys in retired])
+        counter_rows = _host_np.concatenate([ys[2] for ys in retired])
+        final = {
+            name: int(v)
+            for name, v in zip(SCENARIO_COUNTER_NAMES, counter_rows[-1])
+        }
+        result["counters"] = final
+        result["counters_per_round"] = counter_rows
+        result["final_counters"] = counters
+        result["final_strategy"] = strategy
+        if collect_decisions:
+            result["decisions"] = _host_np.concatenate(
+                [ys[3] for ys in retired]
+            )
+        for name, value in final.items():
+            reg.gauge(f"scenario_{name}").set(value)
+        obs.instant("scenario_drain", rounds=rounds, **final)
+        return result
     if collect_decisions:
         result["decisions"] = _host_np.concatenate([ys[1] for ys in retired])
     if with_counters:
@@ -468,3 +823,23 @@ def pipeline_sweep(
         for name, value in final.items():
             reg.gauge(f"agreement_{name}").set(value)
     return result
+
+
+def scenario_sweep(  # ba-lint: donates(state)
+    key: jax.Array,
+    state: SimState,
+    scenario,
+    **kwargs,
+):
+    """Run a compiled scenario campaign through the pipelined engine.
+
+    The named front door of scenario mode — literally
+    ``pipeline_sweep(..., scenario=block)`` with the round count read
+    off the block, so every engine dial (``depth``,
+    ``rounds_per_dispatch``, ``unroll``, ``mesh``, ``host_work``,
+    ``initial_strategy``, ...) passes through unchanged.  DONATION:
+    ``state`` is consumed exactly as in ``pipeline_sweep`` — thread the
+    returned ``final_state``.
+    """
+    return pipeline_sweep(key, state, scenario.rounds, scenario=scenario,
+                          **kwargs)

@@ -160,6 +160,7 @@ def agreement_step(
     state: SimState,
     m: int = 1,
     max_liars: int | None = None,
+    strategies: jax.Array | None = None,
 ):
     """One agreement round per instance with per-instance PRNG keys.
 
@@ -170,18 +171,36 @@ def agreement_step(
     level's popcount draw for m >= 2 — derive it from the CONCRETE state
     before jitting (it cannot be computed from a tracer); None is always
     safe (n-1 words).
+
+    ``strategies`` ([B, n] int8, ``ba_tpu.scenario.strategies`` ids)
+    selects each faulty general's adversary behaviour; ``None`` keeps the
+    historical coin-only path bit-for-bit, and the all-RANDOM plane is
+    bit-exact with it under the same keys (for m >= 2 a strategies plane
+    forces the dense EIG path — see ``eig_round``).
     """
 
-    def one(k, order, leader, faulty, alive, ids):
+    def one(k, order, leader, faulty, alive, ids, strat):
         st = SimState(order[None], leader[None], faulty[None], alive[None], ids[None])
+        sb = None if strat is None else strat[None]
         maj = (
-            om1_round(k, st) if m == 1 else eig_round(k, st, m, max_liars)
+            om1_round(k, st, sb)
+            if m == 1
+            else eig_round(k, st, m, max_liars, sb)
         )
         return maj[0]
 
-    majorities = jax.vmap(one)(
-        keys, state.order, state.leader, state.faulty, state.alive, state.ids
-    )
+    if strategies is None:
+        majorities = jax.vmap(
+            lambda k, o, l, f, a, i: one(k, o, l, f, a, i, None)
+        )(
+            keys, state.order, state.leader, state.faulty, state.alive,
+            state.ids,
+        )
+    else:
+        majorities = jax.vmap(one)(
+            keys, state.order, state.leader, state.faulty, state.alive,
+            state.ids, strategies,
+        )
     n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
     decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
     histogram = decision_histogram(decision)
@@ -205,50 +224,58 @@ def failover_sweep(
     detect -> elect -> continue loop of the reference's run thread
     (ba.py:306-314, ping failure -> elect -> next round).
 
+    Since ISSUE 5 this is a THIN WRAPPER over the scenario engine's scan
+    core (``parallel.pipeline._scenario_scan``) driven by a kill-only
+    campaign: per scan step it applies the kills, re-elects dead leaders
+    by lowest alive id (``elect_lowest_id``, the argmin form of
+    ba.py:126-157; "election is for life", ba.py:124-125), and runs the
+    strategy-aware agreement round with every strategy at RANDOM — the
+    reference adversary, bit-exact with the pre-scenario coin path.  One
+    transition implementation now serves interactive failover studies,
+    this jittable single-dispatch form, and the pipelined mutating
+    campaigns (``pipeline_sweep(scenario=...)``), and the kill-only
+    parity test (tests/test_scenario.py) pins all of them together.
+
+    Keys derive from the engine's on-device :class:`KeySchedule`
+    (``fold_in(fold_in(base, r), i)``) — the same schedule the pipelined
+    engine threads, which is what makes the parity bit-exact.
+
     ``kill_schedule`` [R, B, n] bool: who dies before each of the R rounds
-    (crash faults, the batched ``g-kill`` ba.py:415-425).  Per scan step,
-    entirely on device — zero host round-trips between rounds:
-
-    1. apply the kills to the alive mask;
-    2. instances whose leader died re-elect by lowest alive id
-       (``elect_lowest_id``, the argmin form of ba.py:126-157) — survivors
-       keep their leader ("election is for life", ba.py:124-125);
-    3. run the agreement round and record the decision histogram.
-
-    Returns dict with ``leaders`` [R, B] (leader after each round's
-    election), ``decisions`` [R, B] int8, ``histograms`` [R, 3], and the
-    final SimState.  Jittable; shard the batch axis for multi-chip use
+    (crash faults, the batched ``g-kill`` ba.py:415-425).  Returns dict
+    with ``leaders`` [R, B] (leader after each round's election),
+    ``decisions`` [R, B] int8, ``histograms`` [R, 3], and the final
+    SimState.  Jittable; shard the batch axis for multi-chip use
     (sharded_sweep's layout applies unchanged).
     """
-    from ba_tpu.core.election import elect_lowest_id
+    # Runtime import: pipeline.py imports this module at load time (for
+    # agreement_step), so the back-edge must resolve lazily.
+    from ba_tpu.parallel import pipeline as _pipeline
 
     R = kill_schedule.shape[0]
-
-    def step(carry, inp):
-        leader, alive = carry
-        k, kill = inp
-        alive = alive & ~kill
-        leader_dead = ~jnp.take_along_axis(alive, leader[:, None], axis=1)[:, 0]
-        elected = elect_lowest_id(state.ids, alive)
-        leader = jnp.where(leader_dead, elected, leader)
-        st = SimState(state.order, leader, state.faulty, alive, state.ids)
-        majorities = (
-            om1_round(k, st) if m == 1 else eig_round(k, st, m, max_liars)
-        )
-        n_a, n_r, n_u = majority_counts(majorities, alive)
-        decision, needed, total = quorum_decision(n_a, n_r, n_u)
-        return (leader, alive), (leader, decision, decision_histogram(decision))
-
-    keys = jr.split(key, R)
-    (leader, alive), (leaders, decisions, hists) = jax.lax.scan(
-        step, (state.leader, state.alive), (keys, kill_schedule)
+    B, n = state.faulty.shape
+    events = {
+        "kill": kill_schedule,
+        "revive": jnp.zeros((R, B, n), bool),
+        "set_faulty": jnp.full((R, B, n), -1, jnp.int8),
+        "set_strategy": jnp.full((R, B, n), -1, jnp.int8),
+    }
+    carry, ys = _pipeline._scenario_scan(
+        state,
+        _pipeline.make_key_schedule(key),
+        jnp.zeros((B, n), jnp.int8),  # every general starts RANDOM
+        _pipeline.scenario_counters_init(),
+        events,
+        rounds=R,
+        m=m,
+        max_liars=max_liars,
+        unroll=1,
+        collect_decisions=True,
     )
-    final = SimState(state.order, leader, state.faulty, alive, state.ids)
     return {
-        "leaders": leaders,
-        "decisions": decisions,
-        "histograms": hists,
-        "final_state": final,
+        "leaders": ys[1],
+        "decisions": ys[3],
+        "histograms": ys[0],
+        "final_state": carry[0],
     }
 
 

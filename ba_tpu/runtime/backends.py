@@ -358,3 +358,71 @@ class JaxBackend:
         # were drained inside the engine's existing retire fetches).
         stats = dict(out["stats"], counters=out["counters"])
         return majorities, decisions, stats
+
+    def run_scenario(self, generals, leader_idx, order_code, seed, spec):
+        """A declarative scenario campaign on the B=1 interactive cluster.
+
+        Compiles the spec against the ROSTER's ids at the padded roster
+        capacity (unknown ids raise eagerly, matching ``g-kill``'s
+        silent-ignore being a roster-layer decision, not a device one),
+        then drives the pipelined mutating engine
+        (``pipeline_sweep(scenario=...)``): kills, revives, fault flips,
+        strategy assignment and lowest-alive-id re-election all run on
+        device, depth-k dispatches in flight.  Oral-message protocols
+        only, exactly like ``run_rounds`` — returns None for sm/signed.
+
+        Returns a dict: ``decisions`` (per-round quorum codes),
+        ``leaders`` (per-round roster indices), ``counters``
+        (SCENARIO_COUNTER_NAMES incl. IC1/IC2 verdicts), ``stats``,
+        and the final ``alive``/``faulty`` rows for the roster update.
+        """
+        import os
+
+        import jax.random as jr
+        import numpy as np
+
+        if self.protocol != "om" or self.signed:
+            return None
+
+        from ba_tpu.parallel.pipeline import fresh_copy, pipeline_sweep
+        from ba_tpu.scenario.compile import compile_scenario
+
+        n = len(generals)
+        cap = self._capacity(n)
+        ids = np.zeros(cap, np.int64)
+        for i, g in enumerate(generals):
+            ids[i] = g.id
+        block = compile_scenario(spec, batch=1, capacity=cap, ids=ids)
+        # fresh_copy is LOAD-BEARING, not defensive: _make_state stages
+        # numpy and jnp.asarray may ZERO-COPY it on CPU — donating a
+        # buffer that aliases live host memory makes the returned
+        # (aliased) final_state nondeterministically garbage, which this
+        # path is the first to actually read back (run_rounds only
+        # consumes the retire outputs).  The copy puts a real device
+        # buffer into the donation thread.
+        state = fresh_copy(self._make_state(generals, leader_idx, order_code))
+        depth = int(os.environ.get("BA_TPU_PIPELINE_DEPTH", 2))
+        per_dispatch = min(
+            spec.rounds, int(os.environ.get("BA_TPU_PIPELINE_ROUNDS", 8))
+        )
+        out = pipeline_sweep(
+            jr.key(seed),
+            state,
+            spec.rounds,
+            m=self.m,
+            depth=depth,
+            rounds_per_dispatch=per_dispatch,
+            collect_decisions=True,
+            scenario=block,
+        )
+        final = out["final_state"]
+        # ONE fetch per row, as in run_round (elementwise fetches pay a
+        # tunnel round-trip per element).
+        return {
+            "decisions": [int(v) for v in out["decisions"][:, 0]],
+            "leaders": [int(v) for v in out["leaders"][:, 0]],
+            "counters": out["counters"],
+            "stats": out["stats"],
+            "alive": [bool(v) for v in np.asarray(final.alive[0, :n])],
+            "faulty": [bool(v) for v in np.asarray(final.faulty[0, :n])],
+        }

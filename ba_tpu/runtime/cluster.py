@@ -295,6 +295,86 @@ class Cluster:
         )
         return res, counts, stats
 
+    def run_scenario(self, spec):
+        """Run a declarative scenario campaign (ba_tpu.scenario) on this
+        cluster: the whole ``g-kill``/``g-add``/``g-state`` REPL session
+        the spec encodes, executed as ONE pipelined device run.
+
+        The backend (``run_scenario``) compiles the spec against the
+        current roster and drives the mutating megastep; afterwards the
+        host roster adopts the campaign's FINAL state — generals dead at
+        the end leave the roster (exactly what a ``g-kill`` would have
+        done), fault flags follow the last ``set_faulty``, and the
+        leader is the scenario's final elected leader ("election is for
+        life" holds across the boundary: a revived lower id does not
+        displace it).  Returns ``(counts, result)`` — the per-round
+        decision tally plus the backend's result dict (counters incl.
+        IC1/IC2 verdicts, stats) — or None when the cluster is empty or
+        the backend cannot run scenarios (PyBackend, signed paths).
+        """
+        if not self.generals:
+            return None  # the reference would crash here (SURVEY.md Q4)
+        self.tick()
+        run = getattr(self.backend, "run_scenario", None)
+        if run is None:
+            return None
+        order_code = command_from_name(spec.order)
+        leader_idx = next(
+            i for i, g in enumerate(self.generals) if g.id == self.leader_id
+        )
+        obs.instant("scenario_repl", scenario=spec.name, rounds=spec.rounds)
+        with obs.span(
+            "scenario_campaign", rounds=spec.rounds, n=len(self.generals)
+        ):
+            res = run(
+                self.generals, leader_idx, order_code, self._round_seed(),
+                spec,
+            )
+        if res is None:
+            return None
+        self._round += spec.rounds
+        roster = list(self.generals)
+        for g, alive, faulty in zip(roster, res["alive"], res["faulty"]):
+            g.faulty = faulty
+            g.alive = alive
+        dead = [g.id for g in roster if not g.alive]
+        self.generals = [g for g in roster if g.alive]
+        # The scenario's final leader is authoritative (election is for
+        # life, on device as on host); tick() only covers the corner
+        # where the campaign left the cluster leaderless.
+        prev = self.leader_id
+        last_leader = res["leaders"][-1]
+        if (
+            0 <= last_leader < len(roster)
+            and roster[last_leader].alive
+        ):
+            self.leader_id = roster[last_leader].id
+        else:
+            self.leader_id = None
+        if self.leader_id != prev and self.leader_id is not None:
+            obs.instant("election", leader_id=self.leader_id, prev=prev)
+            obs.default_registry().counter("elections_total").inc()
+        self.tick()
+        names = {ATTACK: "attack", RETREAT: "retreat"}
+        counts = {"attack": 0, "retreat": 0, "undefined": 0}
+        for d in res["decisions"]:
+            counts[names.get(d, "undefined")] += 1
+        metrics.emit(
+            {
+                "event": "scenario_campaign",
+                "name": spec.name,
+                "rounds": spec.rounds,
+                "order": spec.order,
+                "decision_counts": counts,
+                "counters": res["counters"],
+                "killed": dead,
+                "leader_id": self.leader_id,
+                "n": len(self.generals),
+                "dispatches": res["stats"]["dispatches"],
+            }
+        )
+        return counts, res
+
     def _tally(self, command: str, leader_idx: int, majorities) -> RoundResult:
         """REPL-level bookkeeping for one round's majorities (ba.py:383-399
         + 197-255), shared by the per-round and pipelined paths."""

@@ -20,6 +20,13 @@ stay byte-identical):
 - ``run-rounds <cmd> <R>`` — R agreement rounds in one pipelined device
   run (the last round's block in ``actual-order`` format, plus a
   ``Rounds: ...`` decision tally).
+- ``scenario <file>`` — run a declarative scenario campaign
+  (``ba_tpu.scenario`` JSON spec: kills, revivals, fault flips, adversary
+  strategies, per round) through the pipelined mutating engine; prints
+  the decision tally and the on-device scenario counters (incl. IC1/IC2
+  verdicts), then leaves the roster in the campaign's final state — the
+  whole ``g-kill``/``g-state`` session the spec encodes, as one device
+  run.
 - ``stats`` — dump the observability registry (``ba_tpu.obs``) as
   Prometheus-style text: round wall-time histogram, pipeline dispatch /
   retire latencies and depth occupancy, election and failover counters.
@@ -39,6 +46,7 @@ from __future__ import annotations
 
 from ba_tpu import obs
 from ba_tpu.runtime.cluster import Cluster
+from ba_tpu.scenario import spec as scenario_spec
 
 
 def _fmt_state(faulty: bool) -> str:
@@ -113,6 +121,38 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
         out(
             f"Rounds: {rounds} - attack={counts['attack']}, "
             f"retreat={counts['retreat']}, undefined={counts['undefined']}"
+        )
+
+    elif command == "scenario":
+        # Framework extension (additive, like run-rounds): a whole
+        # declarative campaign — membership churn, fault injection,
+        # adversary strategies — as one pipelined device run.  Spec
+        # problems print a one-line error; an incapable backend
+        # (PyBackend, signed) is silently ignored like other guarded
+        # divergences.
+        if len(cmd) == 1:
+            return True
+        try:
+            spec = scenario_spec.load(cmd[1])
+        except (OSError, ValueError) as e:
+            out(f"scenario error: {e}")
+            return True
+        try:
+            ran = cluster.run_scenario(spec)
+        except ValueError as e:  # e.g. spec names ids not in the roster
+            out(f"scenario error: {e}")
+            return True
+        if ran is None:
+            return True
+        counts, res = ran
+        out(
+            f"Scenario {spec.name}: {spec.rounds} rounds - "
+            f"attack={counts['attack']}, retreat={counts['retreat']}, "
+            f"undefined={counts['undefined']}"
+        )
+        out(
+            "Scenario counters: "
+            + ", ".join(f"{k}={v}" for k, v in res["counters"].items())
         )
 
     elif command == "g-state":

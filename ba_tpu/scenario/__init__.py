@@ -1,0 +1,86 @@
+"""ba_tpu.scenario — declarative adversary & membership campaigns.
+
+Three parts (docs/DESIGN.md §9):
+
+- **spec** (``scenario/spec.py``): scenarios are plain data — rounds ×
+  events (``kill`` / ``revive`` / ``set_faulty`` / ``set_strategy``,
+  with per-instance batch masks) — validated eagerly on host and
+  round-tripping through JSON (``python -m ba_tpu.scenario`` is the CI
+  validator).
+- **compiler** (``scenario/compile.py``): lowers a spec to dense packed
+  ``[R, B, n]`` planes (:class:`~ba_tpu.scenario.compile.ScenarioBlock`)
+  — no Python in the hot loop.
+- **strategies** (``scenario/strategies.py``): the vectorized adversary
+  engine — per-general strategy ids select among branch-free behaviours
+  (RANDOM / COLLUDE_ATTACK / COLLUDE_RETREAT / SILENT / ADAPTIVE_SPLIT)
+  inside the send paths of ``core/om.py``/``core/eig.py``/``core/sm.py``.
+
+The execution engine lives with the pipeline it rides:
+``ba_tpu.parallel.pipeline.scenario_sweep`` (re-exported here lazily)
+runs a compiled block through the donated, depth-k pipelined megastep —
+kills, lowest-alive-id re-election, strategy-aware agreement, and
+IC1/IC2 verdicts folding into the on-device counter block, all inside
+``lax.scan``.
+
+Import discipline: this ``__init__`` eagerly imports only the jax-free
+spec + compiler layers (CI validates specs without an accelerator
+stack); ``strategies`` (jax) and ``scenario_sweep`` (the engine) load
+on attribute access.  ``core/om.py`` etc. import
+``ba_tpu.scenario.strategies`` directly, which keeps the package init
+off the jitted tree's import hot path.
+"""
+
+from ba_tpu.scenario.spec import (
+    EVENT_KINDS,
+    STRATEGY_NAMES,
+    Event,
+    Scenario,
+    ScenarioError,
+    from_dict,
+    load,
+    save,
+    strategy_id,
+    to_dict,
+    validate,
+)
+from ba_tpu.scenario.compile import (
+    ScenarioBlock,
+    block_from_kills,
+    compile_scenario,
+    empty_block,
+)
+
+__all__ = [
+    "EVENT_KINDS",
+    "STRATEGY_NAMES",
+    "Event",
+    "Scenario",
+    "ScenarioBlock",
+    "ScenarioError",
+    "block_from_kills",
+    "compile_scenario",
+    "empty_block",
+    "from_dict",
+    "load",
+    "save",
+    "scenario_sweep",
+    "strategies",
+    "strategy_id",
+    "to_dict",
+    "validate",
+]
+
+
+def __getattr__(name):
+    # Lazy: `strategies` pulls jax, `scenario_sweep` pulls the whole
+    # parallel engine — neither belongs in the jax-free spec/compile
+    # path CI uses to validate committed scenario files.
+    if name == "strategies":
+        from ba_tpu.scenario import strategies
+
+        return strategies
+    if name == "scenario_sweep":
+        from ba_tpu.parallel.pipeline import scenario_sweep
+
+        return scenario_sweep
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,54 @@
+"""``python -m ba_tpu.scenario <spec.json> ...`` — the CI spec validator.
+
+For every path: load + eagerly validate the spec, round-trip it through
+``to_dict``/``from_dict`` (byte-stable grammar), and lower it through
+the compiler at a probe shape (batch 2, capacity = the largest general
+id the events name, floor 4) so every event's ids/instances/values are
+proven loweable.  Exits non-zero with the offending path on the first
+failure.  Jax-free by construction (spec + compiler are numpy/stdlib
+only) — the same property ba-lint relies on, so this stage costs
+milliseconds in ``scripts/ci.sh``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ba_tpu.scenario.compile import compile_scenario
+from ba_tpu.scenario.spec import ScenarioError, from_dict, load, to_dict
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: python -m ba_tpu.scenario <spec.json> ...",
+              file=sys.stderr)
+        return 2
+    for path in argv:
+        try:
+            spec = load(path)
+            doc = to_dict(spec)
+            if to_dict(from_dict(doc)) != doc:
+                raise ScenarioError("to_dict/from_dict round-trip drifted")
+            capacity = max(
+                [4] + [gid for ev in spec.events for gid in ev.ids]
+            )
+            block = compile_scenario(spec, batch=2, capacity=capacity)
+            mutations = int(
+                block.kill.sum()
+                + block.revive.sum()
+                + (block.set_faulty >= 0).sum()
+                + (block.set_strategy >= 0).sum()
+            )
+            print(
+                f"{path}: OK — {spec.name!r}, {spec.rounds} round(s), "
+                f"{len(spec.events)} event(s), {mutations} mutated "
+                f"cell(s) at probe capacity {capacity}"
+            )
+        except (OSError, ScenarioError) as e:
+            print(f"{path}: FAIL — {e}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,177 @@
+"""Scenario compiler: spec events -> dense per-round device planes.
+
+The lowering contract of the scenario engine (docs/DESIGN.md §9): a
+validated :class:`~ba_tpu.scenario.spec.Scenario` compiles ONCE, on
+host, into a :class:`ScenarioBlock` of dense ``[R, B, n]`` planes —
+packed bool/int8, numpy — and from then on the campaign is pure data
+riding the pipelined megastep's scan (``parallel/pipeline.py``).  No
+Python callback, dict lookup, or event list survives into the hot loop;
+the only per-dispatch host work is slicing the next chunk of rounds off
+these arrays (``chunk``), which is an async upload, not a sync.
+
+Plane encodings (one row per round, applied BEFORE that round runs):
+
+- ``kill`` / ``revive`` ``[R, B, n]`` bool — alive-mask deltas
+  (``alive = (alive & ~kill) | revive``; validation rejects a same-round
+  kill+revive of one general, so the order cannot silently matter);
+- ``set_faulty`` ``[R, B, n]`` int8 — ``-1`` keep, ``0`` clear, ``1``
+  set (the ``g-state`` tri-state: most cells are "keep");
+- ``set_strategy`` ``[R, B, n]`` int8 — ``-1`` keep, else a strategy id
+  (``spec.STRATEGY_NAMES`` position).
+
+Like ``spec.py`` this module is numpy-only (no jax): CI round-trips the
+committed spec files through the compiler without touching an
+accelerator stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ba_tpu.scenario.spec import Scenario, ScenarioError, strategy_id, validate
+
+KEEP = -1  # "no change" cell in the set_faulty / set_strategy planes
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioBlock:
+    """Compiled campaign: four dense ``[R, B, n]`` planes (see module
+    docstring for the cell encodings).  Plain data — numpy out of the
+    compiler, device arrays once the engine has staged chunks."""
+
+    kill: np.ndarray
+    revive: np.ndarray
+    set_faulty: np.ndarray
+    set_strategy: np.ndarray
+
+    def __post_init__(self):
+        shape = np.shape(self.kill)
+        if len(shape) != 3:
+            raise ScenarioError(
+                f"scenario planes must be [R, B, n], got {shape}"
+            )
+        for name in ("revive", "set_faulty", "set_strategy"):
+            got = np.shape(getattr(self, name))
+            if got != shape:
+                raise ScenarioError(
+                    f"plane shape mismatch: kill {shape} vs {name} {got}"
+                )
+
+    @property
+    def rounds(self) -> int:
+        return int(np.shape(self.kill)[0])
+
+    @property
+    def batch(self) -> int:
+        return int(np.shape(self.kill)[1])
+
+    @property
+    def n(self) -> int:
+        return int(np.shape(self.kill)[2])
+
+    def chunk(self, lo: int, hi: int) -> dict:
+        """Rounds ``[lo, hi)`` as a dict of planes — what one pipelined
+        dispatch consumes (the engine donates these to the megastep)."""
+        return {
+            "kill": self.kill[lo:hi],
+            "revive": self.revive[lo:hi],
+            "set_faulty": self.set_faulty[lo:hi],
+            "set_strategy": self.set_strategy[lo:hi],
+        }
+
+
+def empty_block(rounds: int, batch: int, capacity: int) -> ScenarioBlock:
+    """The no-op campaign: ``rounds`` rounds, nothing mutates.
+
+    ``pipeline_sweep`` without a scenario IS this block (the parity test
+    pins bit-exactness), so the empty block exists mostly for tests and
+    as the base the compiler writes events into.
+    """
+    if rounds < 1:
+        raise ScenarioError(f"rounds={rounds} must be >= 1")
+    if batch < 1 or capacity < 1:
+        raise ScenarioError(
+            f"batch={batch} / capacity={capacity} must be >= 1"
+        )
+    shape = (rounds, batch, capacity)
+    return ScenarioBlock(
+        kill=np.zeros(shape, bool),
+        revive=np.zeros(shape, bool),
+        set_faulty=np.full(shape, KEEP, np.int8),
+        set_strategy=np.full(shape, KEEP, np.int8),
+    )
+
+
+def block_from_kills(kill_schedule) -> ScenarioBlock:
+    """A kill-only block from a dense ``[R, B, n]`` bool schedule — the
+    exact input ``failover_sweep`` has always taken, so the old engine's
+    call sites lower onto the scenario engine unchanged."""
+    kills = np.asarray(kill_schedule, bool)
+    if kills.ndim != 3:
+        raise ScenarioError(
+            f"kill schedule must be [R, B, n], got shape {kills.shape}"
+        )
+    block = empty_block(*kills.shape)
+    return dataclasses.replace(block, kill=kills)
+
+
+def compile_scenario(
+    spec: Scenario,
+    batch: int,
+    capacity: int,
+    ids=None,
+) -> ScenarioBlock:
+    """Lower a validated spec to dense planes for a ``[batch, capacity]``
+    state.
+
+    ``ids`` maps slots to general ids (default ``1..capacity``, the
+    ascending spawn order of ba.py:344-351 that ``make_state`` /
+    ``make_sweep_state`` use); the interactive backend passes its roster
+    ids so REPL scenarios address the same generals ``g-kill`` would.
+    Unknown ids and out-of-range instances raise here — eagerly, on
+    host — rather than silently masking to nothing on device.
+    """
+    validate(spec)
+    block = empty_block(spec.rounds, batch, capacity)
+    if ids is None:
+        ids = np.arange(1, capacity + 1)
+    else:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if ids.shape[0] != capacity:
+            raise ScenarioError(
+                f"ids has {ids.shape[0]} entries for capacity {capacity}"
+            )
+    slot_of = {}
+    for slot, gid in enumerate(ids.tolist()):
+        if gid > 0 and gid not in slot_of:  # 0 = unoccupied padding slot
+            slot_of[gid] = slot
+
+    for ev in spec.events:
+        try:
+            slots = [slot_of[gid] for gid in ev.ids]
+        except KeyError as e:
+            raise ScenarioError(
+                f"{ev.kind} event names general id {e.args[0]} which is "
+                f"not in the roster (ids {sorted(slot_of)})"
+            ) from None
+        if ev.instances is None:
+            rows = np.arange(batch)
+        else:
+            rows = np.asarray(ev.instances, np.int64)
+            if (rows >= batch).any():
+                raise ScenarioError(
+                    f"{ev.kind} event instance {int(rows.max())} outside "
+                    f"batch {batch}"
+                )
+        cells = np.ix_(rows, np.asarray(slots, np.int64))
+        if ev.kind == "kill":
+            block.kill[ev.round][cells] = True
+        elif ev.kind == "revive":
+            block.revive[ev.round][cells] = True
+        elif ev.kind == "set_faulty":
+            block.set_faulty[ev.round][cells] = 1 if ev.value else 0
+        else:  # set_strategy (validate() rejected everything else)
+            block.set_strategy[ev.round][cells] = strategy_id(ev.value)
+    return block

@@ -1,0 +1,260 @@
+"""Declarative scenario specs: adversary & membership campaigns as data.
+
+The reference drives every fault interactively — ``g-kill``, ``g-add``,
+``g-state <id> faulty`` one REPL line at a time (ba.py:401-437) — and
+its only adversary is the per-call fair coin (ba.py:44-49).  A
+:class:`Scenario` captures a whole campaign declaratively: R rounds and
+a list of :class:`Event`\\ s that fire BEFORE a given round, each naming
+general ids (1-based, the reference's numbering) and optionally a
+subset of batch instances.  Scenarios are plain data — JSON in, JSON
+out, validated eagerly on host — and are lowered by
+``ba_tpu.scenario.compile`` to dense per-round device planes, so no
+Python ever runs inside the compiled round loop.
+
+Event kinds (the REPL commands generalized, docs/COVERAGE.md maps them
+row by row):
+
+- ``kill``         — crash fault (``g-kill``): the named generals leave
+  the alive mask before the round.
+- ``revive``       — the capacity-preserving ``g-add`` analogue: a slot
+  re-enters the alive mask (shapes stay static under jit, so
+  membership growth is modelled inside the fixed capacity).  A living
+  leader is never displaced by a revived lower id ("election is for
+  life", ba.py:124-125).
+- ``set_faulty``   — ``g-state <id> faulty|non-faulty`` (``value``:
+  true/false).
+- ``set_strategy`` — the adversary upgrade the reference never had:
+  assign one of the vectorized strategies
+  (``ba_tpu.scenario.strategies``) to the named generals (``value``:
+  a :data:`STRATEGY_NAMES` entry).  Strategy only matters while the
+  general is faulty — honest generals never lie regardless of id.
+
+This module imports nothing heavier than the stdlib: spec validation
+and (de)serialization run jax-free, which is what lets ``python -m
+ba_tpu.scenario`` round-trip the committed spec files in CI for free.
+
+JSON grammar (one object per event; exactly one kind key)::
+
+    {"name": "cascading-failover", "rounds": 6, "order": "attack",
+     "events": [
+       {"round": 1, "kill": [1]},
+       {"round": 2, "set_faulty": [4], "value": true},
+       {"round": 3, "set_strategy": [4], "value": "collude_retreat",
+        "instances": [0, 1]}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+EVENT_KINDS = ("kill", "revive", "set_faulty", "set_strategy")
+
+# Strategy id table (single source of truth; ``strategies.py`` pins its
+# jnp-side constants to these positions and a test asserts the match).
+STRATEGY_NAMES = (
+    "random",
+    "collude_attack",
+    "collude_retreat",
+    "silent",
+    "adaptive_split",
+)
+
+ORDERS = ("attack", "retreat")
+
+
+class ScenarioError(ValueError):
+    """Raised by eager host-side validation — never from device code."""
+
+
+def strategy_id(name: str) -> int:
+    """Strategy name -> int8 id (the value ``set_strategy`` lowers to)."""
+    try:
+        return STRATEGY_NAMES.index(name)
+    except ValueError:
+        raise ScenarioError(
+            f"unknown strategy {name!r}; one of {STRATEGY_NAMES}"
+        ) from None
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One membership/fault/strategy mutation, applied BEFORE ``round``.
+
+    ``ids`` are general ids (1-based); ``instances`` limits the event to
+    a subset of batch instances (None = every instance).  ``value`` is
+    kind-specific: kill/revive take none, ``set_faulty`` a bool,
+    ``set_strategy`` a :data:`STRATEGY_NAMES` entry.
+    """
+
+    round: int
+    kind: str
+    ids: tuple
+    value: object = None
+    instances: tuple | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """A validated campaign: R rounds of ``order`` under ``events``."""
+
+    name: str
+    rounds: int
+    events: tuple
+    order: str = "attack"
+
+
+def validate(spec: Scenario) -> Scenario:
+    """Eager host-side validation; returns ``spec`` for chaining.
+
+    Everything that could silently mis-lower raises here, before any
+    array is built: unknown kinds/strategies/orders, out-of-range
+    rounds, malformed id/instance lists, kind/value mismatches, and a
+    kill+revive of the same general in the same round (ambiguous — the
+    compiler applies kills before revives, which would silently resolve
+    the conflict toward revive).
+    """
+    if not isinstance(spec.name, str) or not spec.name:
+        raise ScenarioError("scenario name must be a non-empty string")
+    if not isinstance(spec.rounds, int) or spec.rounds < 1:
+        raise ScenarioError(f"rounds={spec.rounds!r} must be an int >= 1")
+    if spec.order not in ORDERS:
+        raise ScenarioError(
+            f"order={spec.order!r} must be one of {ORDERS} "
+            "(non-canonical orders are a leader raw-string REPL quirk, "
+            "not a campaign input)"
+        )
+    killed_revived = {}
+    for ev in spec.events:
+        if ev.kind not in EVENT_KINDS:
+            raise ScenarioError(
+                f"unknown event kind {ev.kind!r}; one of {EVENT_KINDS}"
+            )
+        if not isinstance(ev.round, int) or not 0 <= ev.round < spec.rounds:
+            raise ScenarioError(
+                f"event round {ev.round!r} outside [0, {spec.rounds})"
+            )
+        if not ev.ids or not all(
+            isinstance(i, int) and i >= 1 for i in ev.ids
+        ):
+            raise ScenarioError(
+                f"{ev.kind} event needs a non-empty list of 1-based "
+                f"general ids, got {ev.ids!r}"
+            )
+        if len(set(ev.ids)) != len(ev.ids):
+            raise ScenarioError(f"duplicate ids in {ev.kind} event: {ev.ids}")
+        if ev.instances is not None:
+            if not ev.instances or not all(
+                isinstance(i, int) and i >= 0 for i in ev.instances
+            ):
+                raise ScenarioError(
+                    f"instances must be a non-empty list of batch indices, "
+                    f"got {ev.instances!r}"
+                )
+            if len(set(ev.instances)) != len(ev.instances):
+                raise ScenarioError(
+                    f"duplicate instances in {ev.kind} event: {ev.instances}"
+                )
+        if ev.kind in ("kill", "revive"):
+            if ev.value is not None:
+                raise ScenarioError(f"{ev.kind} events take no value")
+            for gid in ev.ids:
+                other = killed_revived.setdefault((ev.round, gid), ev.kind)
+                if other != ev.kind:
+                    raise ScenarioError(
+                        f"general {gid} both killed and revived before "
+                        f"round {ev.round}"
+                    )
+        elif ev.kind == "set_faulty":
+            if not isinstance(ev.value, bool):
+                raise ScenarioError(
+                    f"set_faulty value must be true/false, got {ev.value!r}"
+                )
+        elif ev.kind == "set_strategy":
+            if not isinstance(ev.value, str):
+                raise ScenarioError(
+                    f"set_strategy value must be a strategy name, "
+                    f"got {ev.value!r}"
+                )
+            strategy_id(ev.value)  # raises on unknown names
+    return spec
+
+
+# -- (de)serialization --------------------------------------------------------
+
+
+def to_dict(spec: Scenario) -> dict:
+    """The JSON-grammar form (stable key order, round-trips exactly)."""
+    events = []
+    for ev in spec.events:
+        d = {"round": ev.round, ev.kind: list(ev.ids)}
+        if ev.value is not None:
+            d["value"] = ev.value
+        if ev.instances is not None:
+            d["instances"] = list(ev.instances)
+        events.append(d)
+    return {
+        "name": spec.name,
+        "rounds": spec.rounds,
+        "order": spec.order,
+        "events": events,
+    }
+
+
+def from_dict(doc: dict) -> Scenario:
+    """Parse + validate the JSON-grammar form; strict about keys."""
+    if not isinstance(doc, dict):
+        raise ScenarioError(f"scenario document must be an object, got {doc!r}")
+    unknown = set(doc) - {"name", "rounds", "order", "events"}
+    if unknown:
+        raise ScenarioError(f"unknown scenario keys: {sorted(unknown)}")
+    events = []
+    for i, d in enumerate(doc.get("events", [])):
+        if not isinstance(d, dict):
+            raise ScenarioError(f"event #{i} must be an object, got {d!r}")
+        kinds = [k for k in EVENT_KINDS if k in d]
+        if len(kinds) != 1:
+            raise ScenarioError(
+                f"event #{i} must carry exactly one of {EVENT_KINDS}, "
+                f"got {sorted(d)}"
+            )
+        extra = set(d) - {"round", "value", "instances", kinds[0]}
+        if extra:
+            raise ScenarioError(f"event #{i} unknown keys: {sorted(extra)}")
+        ids = d[kinds[0]]
+        if not isinstance(ids, list):
+            raise ScenarioError(f"event #{i} ids must be a list, got {ids!r}")
+        inst = d.get("instances")
+        events.append(
+            Event(
+                round=d.get("round", 0),
+                kind=kinds[0],
+                ids=tuple(ids),
+                value=d.get("value"),
+                instances=None if inst is None else tuple(inst),
+            )
+        )
+    return validate(
+        Scenario(
+            name=doc.get("name", ""),
+            rounds=doc.get("rounds", 0),
+            events=tuple(events),
+            order=doc.get("order", "attack"),
+        )
+    )
+
+
+def load(path: str) -> Scenario:
+    """Load + validate a JSON spec file."""
+    with open(path) as fh:
+        try:
+            doc = json.load(fh)
+        except json.JSONDecodeError as e:
+            raise ScenarioError(f"{path}: not valid JSON ({e})") from None
+    return from_dict(doc)
+
+
+def save(path: str, spec: Scenario) -> None:
+    with open(path, "w") as fh:
+        json.dump(to_dict(validate(spec)), fh, indent=1)
+        fh.write("\n")

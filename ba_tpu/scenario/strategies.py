@@ -1,0 +1,114 @@
+"""Vectorized adversary strategies: what a faulty general's lies ARE.
+
+The reference's only Byzantine behaviour is the per-call fair coin
+(``random.randint(0, 1)``, ba.py:44-49) — the WEAKEST adversary in the
+Lamport/Shostak/Pease model, whose impossibility arguments (and every
+BFT evaluation since, PBFT-style colluding traitors included) are
+driven by *coordinated* strategies.  This module upgrades the fault
+model: each general carries an int8 strategy id, and the send paths of
+``core/om.py`` / ``core/eig.py`` / ``core/sm.py`` transform their
+existing coin tensors through one branch-free select — vmap/scan stay
+fused, and the RANDOM row is the identity on the coins, which is what
+keeps the legacy paths bit-exact (tests/test_scenario.py pins it).
+
+Strategy table (ids are positions in ``spec.STRATEGY_NAMES`` — one
+source of truth, asserted in tests):
+
+- ``RANDOM``          — the reference adversary: an independent fair
+  coin per message.  Bit-exact with the pre-strategy code under the
+  same keys (the coins are drawn identically and selected unchanged).
+- ``COLLUDE_ATTACK`` / ``COLLUDE_RETREAT`` — the coalition pushes one
+  value to everyone (oral paths lie with that value; signed paths
+  forward only that value and withhold the other).
+- ``SILENT``          — withholding: oral paths answer ``UNDEFINED``
+  (counted by no tally, exactly like the reference's dead-peer
+  ``try/except`` vanishing, ba.py:185-186 — the on-the-wire UNDEFINED
+  is a framework extension modelling a dropped reply); signed paths
+  never forward (the ``sm.py`` withhold schedule generalized).
+- ``ADAPTIVE_SPLIT``  — maximize disagreement: send ATTACK to
+  even-indexed receivers and RETREAT to odd (the classic
+  split-the-vote adversary; deterministic, coin-free).
+
+Strategy only matters where the sender is already faulty: every caller
+applies these values under its existing ``faulty`` masks, so honest
+generals never lie regardless of their strategy id — and a faulty
+general still *tallies* honestly (SURVEY.md Q3 is untouched).
+
+Import discipline: this module imports ONLY jax — never ``ba_tpu.core``
+(the core send paths import it, and a back-edge would cycle through the
+package inits).  The command codes are therefore pinned locally;
+tests assert they match ``core.types``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Mirrors core.types (RETREAT/ATTACK/UNDEFINED) — pinned by
+# tests/test_scenario.py; see the import-discipline note above.
+_RETREAT = 0
+_ATTACK = 1
+_UNDEFINED = 2
+
+# Ids are positions in ba_tpu.scenario.spec.STRATEGY_NAMES.
+RANDOM = 0
+COLLUDE_ATTACK = 1
+COLLUDE_RETREAT = 2
+SILENT = 3
+ADAPTIVE_SPLIT = 4
+
+STRATEGY_DTYPE = jnp.int8
+
+
+def lie_values(strategy, coins, receiver_index) -> jnp.ndarray:
+    """Per-message lie values for ORAL sends (OM answer cubes, EIG relay
+    levels, round-1 equivocation).
+
+    ``strategy`` int8 (the SENDER's id) and ``receiver_index`` int32
+    broadcast against ``coins`` — int8 fair coins in {RETREAT, ATTACK},
+    the RANDOM stream the caller already draws.  Returns values in
+    {RETREAT, ATTACK, UNDEFINED}; the caller applies them under its
+    ``faulty`` masks exactly where the raw coins used to go.  All-RANDOM
+    strategies return ``coins`` unchanged (bit-exact legacy parity).
+
+    Every constant is staged in ``coins.dtype`` up front: a python-int
+    constant in a ``where`` silently promotes the whole select chain to
+    int32, and the resulting per-element int8<->int32 converts in the
+    send-cube's innermost loop cost ~3x wall clock on the CPU backend
+    (measured while landing ISSUE 5) against +40% nominal flops.
+    """
+    attack = jnp.asarray(_ATTACK, coins.dtype)
+    retreat = jnp.asarray(_RETREAT, coins.dtype)
+    undefined = jnp.asarray(_UNDEFINED, coins.dtype)
+    split = jnp.where((receiver_index & 1) == 0, attack, retreat)
+    v = coins
+    v = jnp.where(strategy == COLLUDE_ATTACK, attack, v)
+    v = jnp.where(strategy == COLLUDE_RETREAT, retreat, v)
+    v = jnp.where(strategy == SILENT, undefined, v)
+    v = jnp.where(strategy == ADAPTIVE_SPLIT, split, v)
+    return v
+
+
+def send_gate(strategy, coins, receiver_index, value_index) -> jnp.ndarray:
+    """Per-message forward gates for SIGNED sends (the SM relay cube).
+
+    In SM(m) a faulty general cannot forge — signatures reduce its
+    powers to selective withholding (core/sm.py's adversary), so a
+    strategy lowers to a bool gate over the ``[.., receiver, sender,
+    value]`` send cube: ``coins`` is the RANDOM gate stream (fair bool
+    coins, drawn by the caller as today), ``value_index`` indexes the
+    2-wide V-set axis (0=RETREAT, 1=ATTACK).  Colluders forward only
+    the coalition value, SILENT never forwards, ADAPTIVE_SPLIT routes
+    ATTACK to even receivers and RETREAT to odd.  All-RANDOM returns
+    ``coins`` unchanged.  The chain-length soundness bound and the
+    "sender must hold the value" mask stay with the caller — a gate can
+    only restrict what the exact model already allowed.
+    """
+    is_attack = value_index == 1
+    split = (receiver_index % 2 == 0) == is_attack
+    g = coins
+    g = jnp.where(strategy == COLLUDE_ATTACK, is_attack, g)
+    g = jnp.where(strategy == COLLUDE_RETREAT, ~is_attack, g)
+    g = jnp.where(strategy == SILENT, False, g)
+    g = jnp.where(strategy == ADAPTIVE_SPLIT, split, g)
+    return g

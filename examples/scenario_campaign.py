@@ -1,0 +1,111 @@
+"""Declarative adversary campaigns at sweep scale: the scenario engine.
+
+Runs BOTH committed scenario specs (examples/scenarios/) over thousands
+of independent clusters through the pipelined mutating megastep — the
+whole ``g-kill``/``g-add``/``g-state`` REPL session each spec encodes,
+plus coordinated adversary strategies the reference's coin-flipping
+traitors could never express, as ceil(R/K) donated device dispatches:
+
+- ``cascading_failover.json``: leaders die round after round
+  (``g-kill`` at batch scale), a successor revives — every cluster
+  re-elects on device by lowest alive id, election-for-life semantics.
+- ``colluding_coalition.json``: the COMMANDER and two lieutenants turn
+  traitor, then walk the strategy table — collusion deterministically
+  FLIPS every cluster's decision to the coalition value, vote-splitting
+  breaks Interactive Consistency (the on-device IC1/IC2 verdict
+  counters record exactly when), and a silent commander deterministically
+  destroys the quorum.  (A lieutenant-only coalition cannot flip the
+  quorum no matter its size: traitors tally honestly — SURVEY Q3 — so
+  they out-vote their own lies at the quorum layer.  Decision capture
+  requires the commander; this spec is that attack.)
+
+    python examples/scenario_campaign.py
+
+Env: SCENARIO_BATCH (default 2048) scales the per-spec cluster count.
+"""
+
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+SCENARIO_DIR = pathlib.Path(__file__).resolve().parent / "scenarios"
+
+
+def main() -> None:
+    from ba_tpu.utils.platform import select_example_platform
+
+    select_example_platform(8)
+    import jax.random as jr
+
+    from ba_tpu.core import ATTACK, command_from_name, make_state
+    from ba_tpu.parallel import SCENARIO_COUNTER_NAMES, scenario_sweep
+    from ba_tpu.scenario import compile_scenario, load
+
+    # SCENARIO_BATCH overrides; falls back to the smoke harness's
+    # SWEEP_BATCH so the examples smoke test stays fast.
+    batch = int(
+        os.environ.get("SCENARIO_BATCH")
+        or os.environ.get("SWEEP_BATCH")
+        or 2048
+    )
+    n = 8
+
+    # -- cascading failover ---------------------------------------------------
+    spec = load(str(SCENARIO_DIR / "cascading_failover.json"))
+    block = compile_scenario(spec, batch, n)
+    state = make_state(batch, n, order=ATTACK)
+    out = scenario_sweep(jr.key(0), state, block, rounds_per_dispatch=2)
+    leaders = out["leaders"]
+    print(f"{spec.name}: {batch} clusters x {spec.rounds} rounds")
+    for r in range(spec.rounds):
+        lead = int(leaders[r, 0]) + 1  # ids are 1-based in the REPL
+        agree = int(out["histograms"][r, 1])
+        print(f"  round {r}: leader G{lead}, attack-decisions {agree}/{batch}")
+    # Kills at rounds 1/2/4 cascade the leadership 1 -> 2 -> 3 -> 4; the
+    # round-5 revival of G2 does NOT displace G4 (election is for life).
+    assert [int(v) + 1 for v in leaders[:, 0]] == [1, 2, 3, 3, 4, 4]
+    assert (leaders == leaders[:, :1]).all()  # every cluster agrees
+    assert (out["histograms"][:, 1] == batch).all(), "honest clusters decide"
+    assert out["counters"]["ic1_violations"] == 0
+
+    # -- colluding coalition --------------------------------------------------
+    spec = load(str(SCENARIO_DIR / "colluding_coalition.json"))
+    block = compile_scenario(spec, batch, n)
+    state = make_state(batch, n, order=command_from_name(spec.order))
+    out = scenario_sweep(jr.key(1), state, block, rounds_per_dispatch=2)
+    print(f"{spec.name}: {batch} clusters x {spec.rounds} rounds")
+    names = ["retreat", "attack", "undefined"]
+    for r in range(spec.rounds):
+        counts = " ".join(
+            f"{nm}={int(c)}" for nm, c in zip(names, out["histograms"][r])
+        )
+        print(f"  round {r}: {counts}")
+    print(
+        "  counters: "
+        + ", ".join(
+            f"{k}={out['counters'][k]}" for k in SCENARIO_COUNTER_NAMES
+        )
+    )
+    # Deterministic phase outcomes (no coin survives a coordinated
+    # coalition): rounds 0 and 7 are fault-free -> unanimous retreat;
+    # the colluding rounds (2-3) flip EVERY cluster to the coalition's
+    # attack (the commander pushes it consistently, the colluders
+    # reinforce it); the split rounds (4-5) keep the retreat quorum but
+    # break IC1 (honest lieutenants disagree by asker parity); the
+    # silent-commander rounds (6) destroy the quorum outright.
+    assert int(out["histograms"][0, 0]) == batch
+    assert int(out["histograms"][2, 1]) == batch  # collusion captures
+    assert int(out["histograms"][3, 1]) == batch
+    assert int(out["histograms"][4, 0]) == batch  # split: quorum holds...
+    assert out["counters"]["ic1_violations"] >= 2 * batch  # ...IC1 doesn't
+    assert int(out["histograms"][6, 2]) == batch  # silent commander
+    assert out["counters"]["quorum_failures"] >= batch
+    assert int(out["histograms"][-1, 0]) == batch
+    assert out["counters"]["equivocation_observed"] > 0
+    print("scenario campaigns: OK")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

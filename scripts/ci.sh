@@ -134,6 +134,17 @@ def _mut102(key):
 mutate_and_expect BA301 core/om.py \
     'from ba_tpu import obs as _mut_obs' || exit 1
 
+echo "== scenario spec round-trip =="
+# ISSUE 5: the committed campaign specs must load, validate, round-trip
+# through to_dict/from_dict, and lower through the compiler.  The
+# validator is jax-free by construction (spec + compiler are
+# numpy/stdlib only — tests/test_scenario.py pins the no-jax property),
+# so like ba-lint this stage costs well under a second.
+if ! python -m ba_tpu.scenario examples/scenarios/*.json; then
+    echo "scenario spec validation failed" >&2
+    exit 1
+fi
+
 echo "== metrics JSONL schema check =="
 # Every record the layer emits must parse and carry event + v (schema
 # version 1) — exercised end-to-end through the real emitters.

@@ -1,0 +1,38 @@
+"""BA201 via the `# ba-lint: donates(...)` ANNOTATION (ISSUE 5): a
+wrapper with no visible donate_argnums declares its consuming contract
+on its own def line, and use-after-donate at its call sites flags
+exactly like the jit-decorated registry entries.  Also pins that a
+mis-declared annotation (a name that is not a parameter) is itself a
+finding rather than silent dead protection.
+"""
+
+
+def consume_state(key, state):  # ba-lint: donates(state)
+    # Stand-in for a pipeline_sweep-style wrapper: `state` is consumed
+    # by a donating dispatch inside; `key` survives.
+    return state
+
+
+def positional_call_site(key, state):
+    out = consume_state(key, state)
+    bad = state  # expect: BA201
+    return out, bad
+
+
+def keyword_call_site(key, state):
+    out = consume_state(key, state=state)
+    return out, state  # expect: BA201
+
+
+def key_survives(key, state):
+    out = consume_state(key, state)
+    return out, key  # the annotation names only `state`
+
+
+def rebinding_is_clean(key, state):
+    state = consume_state(key, state)
+    return state
+
+
+def annotated_with_typo(key, state):  # ba-lint: donates(sate)  # expect: BA201
+    return state

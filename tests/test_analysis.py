@@ -361,6 +361,56 @@ def test_docstring_directives_and_trailing_disable_file_inert(tmp_path):
     assert [f.code for f in active] == ["BA202"] and not suppressed
 
 
+def test_donates_annotation_cross_module(tmp_path):
+    # ISSUE 5 satellite (ROADMAP PR 3 item): a donates annotation on
+    # a def line registers the wrapper project-wide — a use-after-donate
+    # at an ALIASED call site in another module flags, docstring
+    # mentions of the syntax stay inert, and the hand table
+    # (KNOWN_DONATING) still backs the un-annotated legacy names.
+    pkg = tmp_path / "ba_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ba_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "engine.py").write_text(textwrap.dedent(
+        '''
+        """Docs may say `# ba-lint: donates(state)` without registering."""
+
+        def run(  # ba-lint: donates(state)
+            key, state, rounds,
+        ):
+            return state
+        '''
+    ))
+    (pkg / "caller.py").write_text(textwrap.dedent(
+        """
+        from ba_tpu.parallel.engine import run as launch
+
+        def bad(key, state):
+            out = launch(key, state, 4)
+            return out, state
+
+        def key_is_fine(key, state):
+            out = launch(key, state, 4)
+            return out, key
+        """
+    ))
+    active, _, _ = run_paths([str(tmp_path)], rule_codes={"BA201"})
+    assert [(pathlib.Path(f.path).name, f.code, f.line) for f in active] == [
+        ("caller.py", "BA201", 6)
+    ], active
+
+
+def test_donates_annotation_typo_is_a_finding(tmp_path):
+    # A donated-name typo must surface, not silently protect nothing.
+    (tmp_path / "m.py").write_text(
+        "def run(key, state):  # ba-lint: donates(stat)\n"
+        "    return state\n"
+    )
+    active, _, _ = run_paths([str(tmp_path)], rule_codes={"BA201"})
+    assert [(f.code, f.line) for f in active] == [("BA201", 1)]
+    assert "not positional parameters" in active[0].message
+
+
 @pytest.mark.parametrize("seed,code", [
     ("def _m(x):\n    return x.block_until_ready()\n", "BA101"),
     ("import jax.random as _j\n\ndef _m(k):\n    return _j.split(k)\n",

@@ -1,0 +1,804 @@
+"""Scenario engine tests (ISSUE 5 tentpole, ba_tpu/scenario + the
+mutating megastep in parallel/pipeline.py).
+
+The load-bearing contracts, each pinned independently:
+
+1. **Spec/compiler hygiene** — eager host validation, JSON round-trip,
+   dense-plane lowering (the CI CLI exercises the same path jax-free).
+2. **Parity, bit-exact** (the ISSUE's three): the EMPTY scenario vs
+   ``pipeline_sweep``, the KILL-ONLY scenario vs ``failover_sweep``
+   (decisions, leaders, histograms), and the RANDOM strategy vs the
+   historical coin paths under the same keys.
+3. **Strategy semantics** — coordinated adversaries behave as specified
+   (deterministic mini-cases: collusion forces quorum loss, silence is
+   harmless withholding, ADAPTIVE_SPLIT responders break IC1/IC2) in
+   both the oral and signed protocols.
+4. **Counters** — the scenario counter block (PR 4 names + IC1/IC2
+   verdicts) folded on device bit-matches a host derivation from the
+   blocking reference driver across a kill-mid-campaign.
+5. **Engine invariants** — donation consumes exactly (state, sched,
+   strategy); the depth-k no-blocking schedule holds with a LIVE
+   scenario block (dispatch-count proof, no new host sync).
+6. **Runtime wiring** — backend/cluster/REPL scenario runs mutate the
+   roster like the equivalent ``g-kill``/``g-state`` session would.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from ba_tpu.core import ATTACK, RETREAT, UNDEFINED, make_state
+from ba_tpu.core.om import om1_round
+from ba_tpu.core.eig import eig_round
+from ba_tpu.core.sm import sm_round
+from ba_tpu.parallel import (
+    SCENARIO_COUNTER_NAMES,
+    failover_sweep,
+    fresh_copy as _fresh,
+    make_mesh,
+    make_sweep_state,
+    pipeline_sweep,
+    scenario_megastep,
+    scenario_counters_init,
+    scenario_sweep,
+)
+from ba_tpu.parallel.pipeline import make_key_schedule, round_keys
+from ba_tpu.parallel.sweep import agreement_step
+from ba_tpu.scenario import (
+    ScenarioError,
+    block_from_kills,
+    compile_scenario,
+    empty_block,
+    from_dict,
+    to_dict,
+)
+from ba_tpu.scenario import spec as spec_mod
+from ba_tpu.scenario import strategies as strat_mod
+
+
+# -- spec + compiler ----------------------------------------------------------
+
+
+def test_strategy_ids_and_command_codes_pinned():
+    # strategies.py keeps its constants local (import-cycle discipline);
+    # they MUST track spec.STRATEGY_NAMES positions and core.types codes.
+    for i, name in enumerate(spec_mod.STRATEGY_NAMES):
+        assert getattr(strat_mod, name.upper()) == i == spec_mod.strategy_id(name)
+    assert (strat_mod._RETREAT, strat_mod._ATTACK, strat_mod._UNDEFINED) == (
+        RETREAT,
+        ATTACK,
+        UNDEFINED,
+    )
+
+
+def test_spec_round_trip_and_validation():
+    doc = {
+        "name": "demo",
+        "rounds": 4,
+        "order": "retreat",
+        "events": [
+            {"round": 1, "kill": [1, 2]},
+            {"round": 2, "set_faulty": [3], "value": True},
+            {"round": 3, "set_strategy": [3], "value": "silent",
+             "instances": [0]},
+            {"round": 3, "revive": [2]},
+        ],
+    }
+    spec = from_dict(doc)
+    assert to_dict(spec) == doc
+    assert to_dict(from_dict(to_dict(spec))) == doc  # fixed point
+
+    bad = [
+        dict(doc, rounds=0),
+        dict(doc, order="charge"),
+        dict(doc, extra_key=1),
+        dict(doc, events=[{"round": 9, "kill": [1]}]),       # round range
+        dict(doc, events=[{"round": 0, "kill": []}]),        # empty ids
+        dict(doc, events=[{"round": 0, "kill": [1, 1]}]),    # dup ids
+        dict(doc, events=[{"round": 0, "kill": [0]}]),       # 1-based ids
+        dict(doc, events=[{"round": 0, "kill": [1], "value": True}]),
+        dict(doc, events=[{"round": 0, "set_faulty": [1]}]),  # no value
+        dict(doc, events=[{"round": 0, "set_strategy": [1],
+                           "value": "nope"}]),
+        dict(doc, events=[{"round": 0, "boom": [1]}]),       # unknown kind
+        dict(doc, events=[{"round": 0, "kill": [1], "revive": [2]}]),
+        dict(doc, events=[{"round": 0, "kill": [1]},
+                          {"round": 0, "revive": [1]}]),     # kill+revive
+        dict(doc, events=[{"round": 0, "kill": [1],
+                           "instances": []}]),
+    ]
+    for b in bad:
+        with pytest.raises(ScenarioError):
+            from_dict(b)
+
+
+def test_spec_file_round_trip(tmp_path):
+    spec = from_dict(
+        {"name": "f", "rounds": 2,
+         "events": [{"round": 1, "kill": [2]}]}
+    )
+    path = tmp_path / "s.json"
+    spec_mod.save(str(path), spec)
+    again = spec_mod.load(str(path))
+    assert to_dict(again) == to_dict(spec)
+    (tmp_path / "broken.json").write_text("{nope")
+    with pytest.raises(ScenarioError, match="not valid JSON"):
+        spec_mod.load(str(tmp_path / "broken.json"))
+
+
+def test_compile_lowers_events_to_planes():
+    spec = from_dict(
+        {
+            "name": "lower",
+            "rounds": 3,
+            "events": [
+                {"round": 0, "kill": [2]},
+                {"round": 1, "set_faulty": [1, 3], "value": True,
+                 "instances": [1]},
+                {"round": 2, "set_strategy": [3], "value": "collude_attack"},
+                {"round": 2, "revive": [2]},
+            ],
+        }
+    )
+    block = compile_scenario(spec, batch=2, capacity=4)
+    assert (block.rounds, block.batch, block.n) == (3, 2, 4)
+    kill = np.zeros((3, 2, 4), bool)
+    kill[0, :, 1] = True  # id 2 -> slot 1
+    np.testing.assert_array_equal(block.kill, kill)
+    revive = np.zeros((3, 2, 4), bool)
+    revive[2, :, 1] = True
+    np.testing.assert_array_equal(block.revive, revive)
+    fset = np.full((3, 2, 4), -1, np.int8)
+    fset[1, 1, 0] = 1
+    fset[1, 1, 2] = 1  # instance-masked: only batch row 1
+    np.testing.assert_array_equal(block.set_faulty, fset)
+    sset = np.full((3, 2, 4), -1, np.int8)
+    sset[2, :, 2] = spec_mod.strategy_id("collude_attack")
+    np.testing.assert_array_equal(block.set_strategy, sset)
+    # chunk() slices rounds for one dispatch.
+    ck = block.chunk(1, 3)
+    assert ck["kill"].shape == (2, 2, 4)
+    np.testing.assert_array_equal(ck["set_faulty"], fset[1:])
+
+
+def test_compile_rejects_unknown_ids_and_instances():
+    spec = from_dict(
+        {"name": "x", "rounds": 1, "events": [{"round": 0, "kill": [9]}]}
+    )
+    with pytest.raises(ScenarioError, match="not in the roster"):
+        compile_scenario(spec, batch=2, capacity=4)
+    spec2 = from_dict(
+        {"name": "x", "rounds": 1,
+         "events": [{"round": 0, "kill": [1], "instances": [5]}]}
+    )
+    with pytest.raises(ScenarioError, match="outside batch"):
+        compile_scenario(spec2, batch=2, capacity=4)
+    # Roster-id mapping: the backend's padded roster addresses by id.
+    spec3 = from_dict(
+        {"name": "x", "rounds": 1, "events": [{"round": 0, "kill": [7]}]}
+    )
+    block = compile_scenario(spec3, batch=1, capacity=4, ids=[3, 7, 9, 0])
+    assert block.kill[0, 0].tolist() == [False, True, False, False]
+
+
+def test_scenario_cli_round_trips_committed_specs(tmp_path):
+    # The exact stage scripts/ci.sh gates on — and it must stay jax-free
+    # (spec+compile are the analyzer-grade import-light path).
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    specs = sorted((repo / "examples" / "scenarios").glob("*.json"))
+    assert len(specs) >= 2, "committed scenario specs missing"
+    code = (
+        "import sys\n"
+        "from ba_tpu.scenario.__main__ import main\n"
+        "rc = main(sys.argv[1:])\n"
+        "banned = {m for m in sys.modules if m.split('.')[0] in"
+        " ('jax', 'jaxlib')}\n"
+        "assert not banned, banned\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, *map(str, specs)],
+        capture_output=True, text=True, cwd=str(repo), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count(": OK") == len(specs)
+    # And a malformed file fails loudly.
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"name": "b", "rounds": 0, "events": []}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "ba_tpu.scenario", str(bad)],
+        capture_output=True, text=True, cwd=str(repo), timeout=120,
+    )
+    assert proc.returncode == 1 and "FAIL" in proc.stderr
+
+
+# -- parity (the ISSUE's three, all bit-exact) --------------------------------
+
+
+def test_empty_scenario_bit_exact_vs_pipeline_sweep():
+    B, cap, R = 32, 8, 6
+    key = jr.key(11)
+    state = make_sweep_state(jr.key(1), B, cap, order=ATTACK)
+    plain = pipeline_sweep(
+        key, _fresh(state), R, depth=2, rounds_per_dispatch=2,
+        collect_decisions=True,
+    )
+    scen = scenario_sweep(
+        key, state, empty_block(R, B, cap),
+        depth=2, rounds_per_dispatch=2, collect_decisions=True,
+    )
+    np.testing.assert_array_equal(scen["decisions"], plain["decisions"])
+    np.testing.assert_array_equal(scen["histograms"], plain["histograms"])
+    # Nothing mutated: leaders stay slot 0, strategies stay RANDOM.
+    assert (scen["leaders"] == 0).all()
+    assert (np.asarray(scen["final_strategy"]) == 0).all()
+
+
+def test_kill_only_scenario_bit_exact_vs_failover_sweep():
+    B, n, R = 24, 8, 7
+    key = jr.key(13)
+    faulty = jnp.zeros((B, n), bool).at[:, 4].set(True)
+    state = make_state(B, n, order=ATTACK, faulty=faulty)
+    rng = np.random.default_rng(3)
+    kills = rng.random((R, B, n)) < 0.05
+    kills[1, :, 0] = True  # every leader dies before round 1
+    want = jax.jit(lambda k, s, ks: failover_sweep(k, s, ks))(
+        key, _fresh(state), jnp.asarray(kills)
+    )
+    got = scenario_sweep(
+        key, state, block_from_kills(kills),
+        depth=2, rounds_per_dispatch=3, collect_decisions=True,
+    )
+    np.testing.assert_array_equal(got["decisions"], np.asarray(want["decisions"]))
+    np.testing.assert_array_equal(got["leaders"], np.asarray(want["leaders"]))
+    np.testing.assert_array_equal(
+        got["histograms"], np.asarray(want["histograms"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got["final_state"].alive),
+        np.asarray(want["final_state"].alive),
+    )
+
+
+def test_random_strategy_bit_exact_vs_coin_paths(monkeypatch):
+    # The all-RANDOM strategy plane must reproduce the historical coin
+    # streams bit-for-bit under the same keys: OM(1), the dense EIG
+    # path, SM's exact relay, and the whole vmapped agreement_step.
+    B, n = 16, 8
+    faulty = jnp.zeros((B, n), bool).at[:, [0, 3]].set(True)
+    state = make_state(B, n, order=ATTACK, faulty=faulty)
+    zeros = jnp.zeros((B, n), jnp.int8)
+    k = jr.key(17)
+    np.testing.assert_array_equal(
+        np.asarray(om1_round(k, state)),
+        np.asarray(om1_round(k, state, zeros)),
+    )
+    monkeypatch.setenv("BA_TPU_EIG_FUSED", "0")  # strategies force dense
+    np.testing.assert_array_equal(
+        np.asarray(eig_round(k, state, 2)),
+        np.asarray(eig_round(k, state, 2, None, zeros)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sm_round(k, state, 2)),
+        np.asarray(sm_round(k, state, 2, strategies=zeros)),
+    )
+    keys = jr.split(jr.key(19), B)
+    a = agreement_step(keys, state, m=1)
+    b = agreement_step(keys, state, m=1, strategies=zeros)
+    for field in ("majorities", "decision", "histogram"):
+        np.testing.assert_array_equal(np.asarray(a[field]), np.asarray(b[field]))
+
+
+# -- strategy semantics (deterministic mini-cases) ----------------------------
+
+
+def _one_round(state, strategies, key=None):
+    out = agreement_step(
+        jr.split(key if key is not None else jr.key(0), state.batch),
+        state,
+        strategies=strategies,
+    )
+    return (
+        np.asarray(out["majorities"]),
+        np.asarray(out["decision"]),
+    )
+
+
+def test_colluding_coalition_forces_quorum_loss():
+    # n=7, honest leader orders RETREAT, traitors {slots 4,5,6} collude
+    # on ATTACK: each HONEST lieutenant tallies 3 retreat (self + two
+    # honest peers) vs 3 attack (the coalition) -> tie -> UNDEFINED; the
+    # traitors themselves still tally honestly (SURVEY Q3) and each
+    # hears only the OTHER two traitors' lies (4R-2A -> RETREAT).  That
+    # leaves 4 retreat votes against needed=5 (3f+1 at total 7): quorum
+    # lost.  Fully deterministic (no coins survive the collusion) —
+    # exactly the coordinated adversary the random-coin fault model
+    # could never express.
+    n = 7
+    faulty = jnp.zeros((1, n), bool).at[:, [4, 5, 6]].set(True)
+    state = make_state(1, n, order=RETREAT, faulty=faulty)
+    strategies = jnp.zeros((1, n), jnp.int8).at[:, [4, 5, 6]].set(
+        strat_mod.COLLUDE_ATTACK
+    )
+    maj, dec = _one_round(state, strategies)
+    assert maj[0, 0] == RETREAT  # the commander keeps its order
+    assert (maj[0, [1, 2, 3]] == UNDEFINED).all()  # honest: split 3-3
+    assert (maj[0, [4, 5, 6]] == RETREAT).all()  # Q3: traitors tally honestly
+    assert dec[0] == UNDEFINED
+
+
+def test_silent_traitors_are_harmless_withholders():
+    # The same coalition gone SILENT contributes nothing: every
+    # lieutenant sees 2 retreat vs 0 -> the order stands.  Deterministic.
+    n = 5
+    faulty = jnp.zeros((1, n), bool).at[:, [3, 4]].set(True)
+    state = make_state(1, n, order=RETREAT, faulty=faulty)
+    strategies = jnp.zeros((1, n), jnp.int8).at[:, [3, 4]].set(
+        strat_mod.SILENT
+    )
+    maj, dec = _one_round(state, strategies)
+    assert (maj[0] == RETREAT).all()
+    assert dec[0] == RETREAT
+
+
+def test_adaptive_split_responders_break_ic1_and_ic2():
+    # ADAPTIVE_SPLIT traitors answer by ASKER parity: with n=5, honest
+    # leader ordering ATTACK and traitors {3,4}, odd asker 1 tallies
+    # 2A/2R -> UNDEFINED while even asker 2 tallies 4A -> ATTACK: the
+    # honest lieutenants disagree (IC1 broken) and one of them disobeys
+    # an honest commander (IC2 broken).  Deterministic.
+    n = 5
+    faulty = jnp.zeros((1, n), bool).at[:, [3, 4]].set(True)
+    state = make_state(1, n, order=ATTACK, faulty=faulty)
+    strategies = jnp.zeros((1, n), jnp.int8).at[:, [3, 4]].set(
+        strat_mod.ADAPTIVE_SPLIT
+    )
+    maj, _dec = _one_round(state, strategies)
+    assert maj[0, 1] == UNDEFINED and maj[0, 2] == ATTACK
+    # The scenario counters see exactly this as IC1+IC2 violations.
+    spec = from_dict(
+        {
+            "name": "split",
+            "rounds": 2,
+            "order": "attack",
+            "events": [
+                {"round": 0, "set_faulty": [4, 5], "value": True},
+                {"round": 0, "set_strategy": [4, 5],
+                 "value": "adaptive_split"},
+            ],
+        }
+    )
+    out = scenario_sweep(
+        jr.key(23), make_state(1, n, order=ATTACK),
+        compile_scenario(spec, 1, n),
+    )
+    assert out["counters"]["ic1_violations"] == 2  # every round, B=1
+    assert out["counters"]["ic2_violations"] == 2
+    assert out["counters"]["equivocation_observed"] == 2
+
+
+def test_sm_strategies_withhold_and_collude():
+    n = 6
+    # SILENT lieutenants with an honest commander: withholding cannot
+    # stop the honest relay -> everyone decides the order.
+    faulty = jnp.zeros((1, n), bool).at[:, [3, 4]].set(True)
+    state = make_state(1, n, order=ATTACK, faulty=faulty)
+    strategies = jnp.zeros((1, n), jnp.int8).at[:, [3, 4]].set(
+        strat_mod.SILENT
+    )
+    choices = np.asarray(sm_round(jr.key(29), state, 2, strategies=strategies))
+    assert (choices == ATTACK).all()
+    # A COLLUDE_ATTACK commander stops equivocating: everyone receives
+    # (and therefore sees exactly) {ATTACK} -> unanimous agreement even
+    # under a faulty commander.  Deterministic.
+    faulty_c = jnp.zeros((1, n), bool).at[:, 0].set(True)
+    state_c = make_state(1, n, order=RETREAT, faulty=faulty_c)
+    strategies_c = jnp.zeros((1, n), jnp.int8).at[:, 0].set(
+        strat_mod.COLLUDE_ATTACK
+    )
+    choices_c = np.asarray(
+        sm_round(jr.key(31), state_c, 2, strategies=strategies_c)
+    )
+    assert (choices_c[0, 1:] == ATTACK).all()
+
+
+def test_sm_strategies_incompatible_modes_raise():
+    state = make_state(1, 4, order=ATTACK)
+    strategies = jnp.zeros((1, 4), jnp.int8)
+    with pytest.raises(ValueError, match="collapsed"):
+        sm_round(jr.key(0), state, 1, collapsed=True, strategies=strategies)
+    withhold = jnp.zeros((1, 1, 4, 4, 2), bool)
+    with pytest.raises(ValueError, match="withhold"):
+        sm_round(jr.key(0), state, 1, withhold=withhold,
+                 strategies=strategies)
+
+
+# -- counters: device fold bit-matches host derivation ------------------------
+
+
+def test_scenario_counters_bit_match_host_derivation_kill_mid_campaign():
+    # ISSUE 5 satellite (extends PR 4's bit-match): the 5-entry scenario
+    # block folded in-scan — agreement counters AND IC1/IC2 verdicts —
+    # must bit-match the same counts derived on host from the blocking
+    # reference driver, across a campaign that kills a leader and flips
+    # strategies mid-flight.  The first three entries ARE the PR 4
+    # block (protocol-agnostic: everything reads step outputs + state).
+    B, cap, R = 16, 8, 6
+    key = jr.key(37)
+    state = make_sweep_state(jr.key(36), B, cap, order=ATTACK)
+    state = dataclasses.replace(
+        state, faulty=state.faulty.at[: B // 2, 0].set(True)
+    )
+    spec = from_dict(
+        {
+            "name": "mid-campaign",
+            "rounds": R,
+            "order": "attack",
+            "events": [
+                {"round": 2, "kill": [1]},               # leaders die
+                {"round": 3, "set_faulty": [3], "value": True},
+                {"round": 3, "set_strategy": [3],
+                 "value": "adaptive_split"},
+                {"round": 4, "set_strategy": [3], "value": "silent",
+                 "instances": list(range(B // 2))},
+            ],
+        }
+    )
+    block = compile_scenario(spec, B, cap)
+
+    # Host derivation: replay the campaign with the blocking driver —
+    # numpy membership bookkeeping + one jitted agreement_step per
+    # round under the SAME key schedule and strategy planes.
+    step = jax.jit(agreement_step, static_argnames=("m", "max_liars"))
+    keys_fn = jax.jit(round_keys, static_argnums=1)
+    alive = np.asarray(state.alive).copy()
+    faulty = np.asarray(state.faulty).copy()
+    leader = np.asarray(state.leader).copy()
+    ids = np.asarray(state.ids)
+    strat = np.zeros((B, cap), np.int8)
+    want = np.zeros(len(SCENARIO_COUNTER_NAMES), np.int64)
+    ref_decisions, ref_leaders = [], []
+    for r in range(R):
+        alive = (alive & ~block.kill[r]) | block.revive[r]
+        faulty = np.where(block.set_faulty[r] >= 0,
+                          block.set_faulty[r] > 0, faulty)
+        strat = np.where(block.set_strategy[r] >= 0,
+                         block.set_strategy[r], strat).astype(np.int8)
+        dead = ~alive[np.arange(B), leader]
+        lowest = np.where(alive, ids, np.iinfo(np.int32).max).argmin(1)
+        leader = np.where(dead, lowest, leader).astype(np.int32)
+        st = dataclasses.replace(
+            state,
+            leader=jnp.asarray(leader),
+            faulty=jnp.asarray(faulty),
+            alive=jnp.asarray(alive),
+        )
+        out = step(
+            keys_fn(make_key_schedule(key, r), B), st,
+            strategies=jnp.asarray(strat),
+        )
+        dec = np.asarray(out["decision"])
+        maj = np.asarray(out["majorities"])
+        ref_decisions.append(dec)
+        ref_leaders.append(leader.copy())
+        idx = np.arange(cap)[None, :]
+        lieutenants = alive & (idx != leader[:, None])
+        want[0] += (dec == UNDEFINED).sum()
+        want[1] += int((dec == dec[0]).all())
+        mmax = np.where(lieutenants, maj, -127).max(1)
+        mmin = np.where(lieutenants, maj, 127).min(1)
+        traitor_present = (faulty & alive).any(1)
+        want[2] += (((mmax != mmin) & lieutenants.any(1))
+                    & traitor_present).sum()
+        honest_lt = lieutenants & ~faulty
+        hmax = np.where(honest_lt, maj, -127).max(1)
+        hmin = np.where(honest_lt, maj, 127).min(1)
+        want[3] += ((hmax != hmin) & honest_lt.any(1)).sum()
+        leader_faulty = faulty[np.arange(B), leader]
+        disobey = (honest_lt & (maj != np.asarray(state.order)[:, None])).any(1)
+        want[4] += (~leader_faulty & disobey).sum()
+
+    got = scenario_sweep(
+        key, _fresh(state), block,
+        depth=2, rounds_per_dispatch=2, collect_decisions=True,
+    )
+    np.testing.assert_array_equal(got["decisions"], np.stack(ref_decisions))
+    np.testing.assert_array_equal(got["leaders"], np.stack(ref_leaders))
+    got_ctr = np.array(
+        [got["counters"][name] for name in SCENARIO_COUNTER_NAMES]
+    )
+    np.testing.assert_array_equal(got_ctr, want)
+    rows = got["counters_per_round"]
+    assert rows.shape == (R, len(SCENARIO_COUNTER_NAMES))
+    assert (np.diff(rows, axis=0) >= 0).all()
+    np.testing.assert_array_equal(rows[-1], want)
+    # The campaign actually exercised the verdicts.
+    assert want[3] > 0 and want[4] > 0, want
+
+
+def test_scenario_counters_continue_across_engine_runs():
+    B, cap, R = 8, 8, 6
+    key = jr.key(41)
+    state = make_sweep_state(jr.key(40), B, cap, order=ATTACK)
+    state = dataclasses.replace(
+        state, faulty=state.faulty.at[: B // 2, 0].set(True)
+    )
+    block = empty_block(R, B, cap)
+    full = scenario_sweep(key, _fresh(state), block)
+    head_block = block_from_kills(np.zeros((R // 2, B, cap), bool))
+    head = scenario_sweep(key, _fresh(state), head_block)
+    tail = scenario_megastep(
+        head["final_state"],
+        head["final_schedule"],
+        head["final_strategy"],
+        head["final_counters"],
+        {k: jnp.asarray(v) for k, v in block.chunk(R // 2, R).items()},
+        rounds=R // 2,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tail[5])[-1],
+        np.array([full["counters"][n] for n in SCENARIO_COUNTER_NAMES]),
+    )
+
+
+# -- engine invariants --------------------------------------------------------
+
+
+def test_scenario_megastep_donation_contract():
+    B, cap, R = 8, 8, 3
+    state = make_sweep_state(jr.key(50), B, cap)
+    sched = make_key_schedule(jr.key(51))
+    strategy = jnp.zeros((B, cap), jnp.int8)
+    counters = scenario_counters_init()
+    ev = {k: jnp.asarray(v) for k, v in empty_block(R, B, cap).chunk(0, R).items()}
+    out = scenario_megastep(state, sched, strategy, counters, ev, rounds=R)
+    # The mutating carry (state, sched, strategy) is consumed...
+    assert state.faulty.is_deleted()  # ba-lint: disable=BA201
+    assert sched.key_data.is_deleted()  # ba-lint: disable=BA201
+    assert strategy.is_deleted()  # ba-lint: disable=BA201
+    # ...while the counter block and event planes are plain inputs (no
+    # output aliases their shapes — the thread continues via the rows).
+    assert not counters.is_deleted()
+    assert not ev["kill"].is_deleted()
+    # The returned carry is live and continues the campaign.
+    assert int(jax.device_get(out[1].counter)) == R
+    out2 = scenario_megastep(
+        out[0], out[1], out[2], out[5][-1], ev, rounds=R
+    )
+    assert int(jax.device_get(out2[1].counter)) == 2 * R
+
+
+def test_scenario_depth_k_no_blocking_with_live_block(monkeypatch):
+    # ISSUE 5 acceptance: the dispatch-count proof holds with a LIVE
+    # scenario block — kills mid-campaign, counters folding, event-chunk
+    # staging — and the engine still never calls block_until_ready.
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    B, cap, R, depth = 8, 8, 7, 3
+    state = make_sweep_state(jr.key(55), B, cap)
+    kills = np.zeros((R, B, cap), bool)
+    kills[2, :, 0] = True
+    kills[4, :, 1] = True
+    events = []
+    out = scenario_sweep(
+        jr.key(56), state, block_from_kills(kills),
+        depth=depth, rounds_per_dispatch=1,
+        on_event=lambda kind, i: events.append((kind, i)),
+    )
+    assert [i for kind, i in events if kind == "dispatch"] == list(range(R))
+    assert [i for kind, i in events if kind == "retire"] == list(range(R))
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [("dispatch", i) for i in range(depth + 1)]
+    for r in range(R - depth):
+        assert events.index(("retire", r)) > events.index(("dispatch", r + depth))
+    assert out["stats"]["max_in_flight"] == depth + 1
+    assert out["stats"]["retires_before_drain"] == R - depth
+    # And the campaign genuinely mutated: leaders moved 0 -> 1 -> 2.
+    assert out["leaders"][0, 0] == 0
+    assert out["leaders"][2, 0] == 1
+    assert out["leaders"][4, 0] == 2
+
+
+def test_scenario_mesh_composes_bit_exact(eight_devices):
+    mesh = make_mesh((8, 1), ("data", "node"))
+    key = jr.key(61)
+    state = make_sweep_state(jr.key(60), 32, 8, order=ATTACK)
+    kills = np.zeros((4, 32, 8), bool)
+    kills[1, :, 0] = True
+    block = block_from_kills(kills)
+    plain = scenario_sweep(
+        key, _fresh(state), block, rounds_per_dispatch=2,
+        collect_decisions=True,
+    )
+    sharded = scenario_sweep(
+        key, state, block, rounds_per_dispatch=2, collect_decisions=True,
+        mesh=mesh,
+    )
+    np.testing.assert_array_equal(plain["decisions"], sharded["decisions"])
+    np.testing.assert_array_equal(plain["leaders"], sharded["leaders"])
+    assert plain["counters"] == sharded["counters"]
+
+
+def test_scenario_argument_validation():
+    state = make_sweep_state(jr.key(70), 8, 8)
+    with pytest.raises(ValueError, match="covers 3"):
+        pipeline_sweep(jr.key(0), state, 4, scenario=empty_block(3, 8, 8))
+    with pytest.raises(ValueError, match=r"\[8, 4\]"):
+        pipeline_sweep(jr.key(0), state, 2, scenario=empty_block(2, 8, 4))
+    with pytest.raises(ValueError, match="initial_strategy"):
+        pipeline_sweep(
+            jr.key(0), state, 2,
+            initial_strategy=jnp.zeros((8, 8), jnp.int8),
+        )
+    with pytest.raises(ValueError, match="initial_strategy shape"):
+        pipeline_sweep(
+            jr.key(0), state, 2, scenario=empty_block(2, 8, 8),
+            initial_strategy=jnp.zeros((4, 8), jnp.int8),
+        )
+
+
+def test_initial_strategy_is_not_consumed():
+    # Only `state` is in scenario_sweep's donation contract: a caller's
+    # strategy plane must survive the run (the engine copies it before
+    # it joins the donated carry — jnp.asarray would otherwise zero-copy
+    # a device array straight into the donation thread).
+    B, cap = 8, 8
+    plane = jnp.zeros((B, cap), jnp.int8).at[:, 3].set(
+        strat_mod.COLLUDE_ATTACK
+    )
+    out1 = scenario_sweep(
+        jr.key(90), make_sweep_state(jr.key(91), B, cap),
+        empty_block(2, B, cap), initial_strategy=plane,
+    )
+    # Same plane reused for a second campaign: must not raise.
+    out2 = scenario_sweep(
+        jr.key(90), make_sweep_state(jr.key(91), B, cap),
+        empty_block(2, B, cap), initial_strategy=plane,
+    )
+    assert not plane.is_deleted()
+    np.testing.assert_array_equal(
+        np.asarray(out1["final_strategy"]), np.asarray(plane)
+    )
+    np.testing.assert_array_equal(out1["histograms"], out2["histograms"])
+
+
+def test_scenario_registry_counters_and_gauges():
+    from ba_tpu import obs
+    from ba_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    old = obs.registry._default
+    obs.registry._default = reg
+    try:
+        state = make_sweep_state(jr.key(80), 8, 8)
+        out = scenario_sweep(jr.key(81), state, empty_block(3, 8, 8))
+    finally:
+        obs.registry._default = old
+    snap = reg.snapshot()
+    assert snap["scenario_campaigns_total"]["value"] == 1
+    assert snap["scenario_rounds_total"]["value"] == 3
+    for name in SCENARIO_COUNTER_NAMES:
+        assert snap[f"scenario_{name}"]["value"] == out["counters"][name]
+
+
+# -- runtime wiring -----------------------------------------------------------
+
+
+def _write_spec(tmp_path, doc):
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_repl_scenario_command_mutates_roster(tmp_path):
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    path = _write_spec(
+        tmp_path,
+        {
+            "name": "repl",
+            "rounds": 4,
+            "order": "attack",
+            "events": [
+                {"round": 1, "kill": [1]},
+                {"round": 2, "set_faulty": [3], "value": True},
+                {"round": 3, "kill": [2]},
+            ],
+        },
+    )
+    cluster = Cluster(5, JaxBackend(platform="cpu"), seed=0)
+    out = []
+    assert handle_command(cluster, f"scenario {path}", out.append)
+    assert out[0].startswith("Scenario repl: 4 rounds - ")
+    assert out[1].startswith("Scenario counters: quorum_failures=")
+    assert "ic1_violations=" in out[1]
+    # The roster adopted the campaign's final state: G1/G2 dead, G3
+    # faulty and (lowest alive id) the leader — election for life.
+    assert [g.id for g in cluster.generals] == [3, 4, 5]
+    assert cluster.leader_id == 3
+    assert cluster.find(3).faulty
+    assert cluster._round == 4  # future seeds advance past the campaign
+    # The same session's g-state output reflects it (byte format).
+    out2 = []
+    handle_command(cluster, "g-state", out2.append)
+    assert out2[0] == "G3, primary, state=F"
+
+
+def test_repl_scenario_command_guards(tmp_path):
+    from ba_tpu.runtime.backends import JaxBackend, PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    # PyBackend has no scenario support: silently ignored (guarded
+    # divergence convention, like unknown ids).
+    path = _write_spec(
+        tmp_path, {"name": "s", "rounds": 1, "events": []}
+    )
+    py = Cluster(4, PyBackend(), seed=0)
+    out = []
+    assert handle_command(py, f"scenario {path}", out.append)
+    assert out == []
+    # Bad files and specs naming unknown generals print one error line.
+    jx = Cluster(4, JaxBackend(platform="cpu"), seed=0)
+    out = []
+    handle_command(jx, "scenario /definitely/not/there.json", out.append)
+    assert len(out) == 1 and out[0].startswith("scenario error:")
+    bad = _write_spec(
+        tmp_path,
+        {"name": "s", "rounds": 1,
+         "events": [{"round": 0, "kill": [99]}]},
+    )
+    out = []
+    handle_command(jx, f"scenario {bad}", out.append)
+    assert len(out) == 1 and "not in the roster" in out[0]
+    assert len(jx.generals) == 4  # roster untouched on error
+
+
+def test_cluster_scenario_emits_campaign_record(tmp_path):
+    from ba_tpu.utils import metrics
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    sink = tmp_path / "metrics.jsonl"
+    old = metrics._default
+    metrics._default = metrics.MetricsSink(str(sink))
+    try:
+        cluster = Cluster(4, JaxBackend(platform="cpu"), seed=0)
+        spec = from_dict(
+            {"name": "obs", "rounds": 3, "order": "attack",
+             "events": [{"round": 1, "kill": [1]}]}
+        )
+        counts, res = cluster.run_scenario(spec)
+    finally:
+        metrics._default = old
+    assert sum(counts.values()) == 3
+    records = [json.loads(l) for l in sink.read_text().splitlines()]
+    camp = [r for r in records if r["event"] == "scenario_campaign"]
+    assert len(camp) == 1
+    assert camp[0]["killed"] == [1]
+    assert camp[0]["decision_counts"] == counts
+    assert camp[0]["counters"] == res["counters"]
+    assert camp[0]["leader_id"] == 2
+    assert camp[0]["v"] == 1
+
+
+def test_backend_scenario_unsupported_paths_return_none():
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    spec = from_dict({"name": "s", "rounds": 1, "events": []})
+    sm = Cluster(4, JaxBackend(platform="cpu", protocol="sm"), seed=0)
+    assert sm.run_scenario(spec) is None
