@@ -30,6 +30,7 @@ from ba_tpu.obs import instrument, registry, trace, xla
 from ba_tpu.obs.instrument import (
     classify_compile,
     compile_or_dispatch_span,
+    configure_compile_ledger,
     first_call,
     reset_first_calls,
     timed_span,
@@ -42,6 +43,7 @@ __all__ = [
     "Tracer",
     "classify_compile",
     "compile_or_dispatch_span",
+    "configure_compile_ledger",
     "default_registry",
     "default_tracer",
     "first_call",

@@ -43,17 +43,47 @@ instant plus a versioned ``{"event": "recompile", "v": 1, "fn": ...,
 that forced the re-specialization.  ``runtime/backends.py``'s
 per-capacity re-specialization becomes attributable ("capacity: 4 ->
 8") instead of a mysterious second ``compile`` span.
+
+The **cross-run ledger** (ISSUE 6) closes the explainer's blind spot:
+the first compile of a session had nothing to diff against, so "why did
+a warm persistent cache still compile?" went unexplained.  When the
+persistent XLA cache is on, ``utils/platform.enable_compilation_cache``
+calls :func:`configure_compile_ledger` with a JSON file NEXT TO the
+cache (``<cache_dir>/ba_tpu_axes_ledger.json``) plus process-constant
+environment axes (jax/jaxlib versions).  Each fn's most recent compile
+signature (axes ∪ env) is written through to the ledger, and a
+first-compile-of-the-session whose signature differs from the PREVIOUS
+process's emits a ``recompile`` record with ``"cross_process": true``
+— "recompiled because jaxlib_version changed" is now a row, not a
+mystery.  No cache, no ledger (``BA_TPU_COMPILE_LEDGER=0`` also
+disables it; the test suite does, so ledger state never leaks between
+test processes).
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
+import os
 import threading
 import time
 
 _seen: set = set()
 _seen_lock = threading.Lock()
 _last_axes: dict = {}  # fn name -> axes dict of its most recent compile
+
+# Cross-run ledger state (configure_compile_ledger).  _ledger_prev holds
+# the PREVIOUS process's per-fn signature LISTS — every specialization
+# that process compiled, not just the last one, so a fn that legitimately
+# compiles at capacity 4 then 8 every session does not read as a
+# cross-process change each time (read once at configure); _ledger_cur
+# accumulates this process's, and the file always holds the merge — fns
+# this process never compiled keep their old rows.
+_ledger_lock = threading.Lock()
+_ledger_path: str | None = None
+_ledger_env: dict = {}
+_ledger_prev: dict = {}
+_ledger_cur: dict = {}
 
 
 def first_call(key) -> bool:
@@ -79,31 +109,158 @@ def _freeze(value):
     return value
 
 
+def configure_compile_ledger(path: str | None, env_axes: dict | None = None):
+    """Point the cross-run ledger at ``path`` (None disables).
+
+    Loads the previous process's per-fn signatures from ``path`` when it
+    exists (unreadable/corrupt files start fresh — the ledger is
+    forensics, never a correctness dependency).  ``env_axes`` are
+    process-constant axes (jax/jaxlib versions) merged into every
+    stored signature, so a toolchain bump shows up as the changed axis.
+    """
+    global _ledger_path, _ledger_env, _ledger_prev, _ledger_cur
+    with _ledger_lock:
+        _ledger_path = path or None
+        _ledger_env = dict(env_axes or {})
+        _ledger_prev, _ledger_cur = {}, {}
+        if path:
+            _ledger_prev = _read_ledger_file(path)
+
+
+def _read_ledger_file(path: str) -> dict:
+    """Parse a ledger file into ``{fn: [signature, ...]}`` — unreadable
+    / corrupt / wrong-version files read as empty (the ledger is
+    forensics, never a correctness dependency)."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+        if doc.get("v") == 1 and isinstance(doc.get("fns"), dict):
+            return {
+                fn: sigs
+                for fn, sigs in doc["fns"].items()
+                if isinstance(sigs, list)
+                and all(isinstance(s, dict) for s in sigs)
+            }
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
+def _ledger_store_locked(fn: str, signature: dict) -> None:
+    """Append ``signature`` to the fn's session list and write through
+    (atomic rewrite; one small JSON per compile — compiles are rare and
+    already slow).  CALLER HOLDS ``_ledger_lock`` — signature
+    construction and the store must share one acquisition, or a
+    concurrent ``configure_compile_ledger`` (REPL re-init) between them
+    would write an old generation's env axes into the new ledger file.
+
+    The file holds the UNION of the previous process's
+    list and this session's, in first-compile order: a session that dies
+    before replaying every specialization must not shrink the ledger, or
+    the next full session would read the missing tail as a cross-process
+    change.  Signatures a toolchain bump obsoletes linger, harmlessly —
+    their env axes can never match again.
+
+    CONCURRENT processes sharing one cache dir (the default outside the
+    test suite) each rewrite the whole file, so the on-disk rows are
+    re-read and merged under the lock right before the replace: a
+    configure-time snapshot alone would let process B's first write
+    erase every row A stored since B started — and the next session
+    would then mis-report A's specializations as cross-process
+    recompiles.  The read→replace window is still racy, but it is
+    microseconds per rare compile, not the life of the session."""
+    global _ledger_path
+    sigs = _ledger_cur.setdefault(fn, [])
+    if signature not in sigs:
+        sigs.append(signature)
+    fns = {f: list(s) for f, s in _ledger_prev.items()}
+    for f, disk in _read_ledger_file(_ledger_path).items():
+        row = fns.setdefault(f, [])
+        row.extend(s for s in disk if s not in row)
+    for f, cur in _ledger_cur.items():
+        row = fns.setdefault(f, [])
+        row.extend(s for s in cur if s not in row)
+    doc = {"v": 1, "fns": fns}
+    tmp = f"{_ledger_path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, _ledger_path)
+    except OSError:
+        # Forensics only: an unwritable ledger dir silently turns
+        # the feature off rather than failing a compile.
+        _ledger_path = None
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
 def classify_compile(fn: str, axes: dict):
-    """``(first_call, changed)`` for one named compile signature.
+    """``(first_call, changed, cross_process)`` for one named compile
+    signature.
 
     ``first_call`` is True exactly once per (fn, axes) — the same
     classification :func:`first_call` gives, keyed on the caller's named
     signature instead of an opaque tuple.  ``changed`` is non-None only
-    on a RE-compile (fn seen before under a different signature): a
-    ``{axis: [previous, new]}`` diff against the function's most recent
-    compile, the explainer's payload.
+    on an EXPLAINED compile: an in-process re-specialization (fn seen
+    before under a different signature) or — with the cross-run ledger
+    configured — a first-compile-of-the-session whose signature matches
+    NONE of the previous process's specializations of the fn, in which
+    case ``cross_process`` is True (a fn that recompiles at the same
+    several capacities every session stays silent).  Either way it is a
+    ``{axis: [previous, new]}`` diff — against the fn's most recent
+    compile in-process, against the previous process's last-compiled
+    signature cross-process — the explainer's payload.
     """
     key = (fn, _freeze(axes))
     with _seen_lock:
         if key in _seen:
-            return False, None
+            return False, None, False
         _seen.add(key)
         prev = _last_axes.get(fn)
         _last_axes[fn] = dict(axes)
+    # Signature construction, the prior snapshot, AND the store share
+    # one lock acquisition: a concurrent configure_compile_ledger (REPL
+    # re-init) swaps path/env/prev together under the lock, and mixing
+    # generations — or storing a signature built from the old env into
+    # the newly configured file — would emit a spurious cross-process
+    # diff (or drop a real one).
+    with _ledger_lock:
+        ledgered = _ledger_path is not None
+        prior = _ledger_prev.get(fn) if ledgered else None
+        if ledgered:
+            signature = {**axes, **_ledger_env}
+            _ledger_store_locked(fn, signature)
     if prev is None:
-        return True, None
+        if ledgered and prior and signature not in prior:
+            # Diff against the CLOSEST prior signature (fewest differing
+            # axes; most recent wins ties), not blindly prior[-1]: a fn
+            # the previous process compiled at capacities 4 and 8 that
+            # recompiles at capacity 4 after a toolchain bump should
+            # read "jaxlib changed", not "capacity 8 -> 4 and jaxlib
+            # changed" — naming an axis that did not force anything
+            # defeats the explainer.
+            def diff_against(baseline):
+                return {
+                    k: [baseline.get(k), signature.get(k)]
+                    for k in {*baseline, *signature}
+                    if baseline.get(k) != signature.get(k)
+                }
+
+            changed = min(  # reversed: min keeps the first, i.e. newest
+                (diff_against(b) for b in reversed(prior)),
+                key=len,
+            )
+            if changed:
+                return True, changed, True
+        return True, None, False
     changed = {
         k: [prev.get(k), axes[k]]
         for k in axes
         if prev.get(k) != axes.get(k)
     }
-    return True, changed or None
+    return True, changed or None, False
 
 
 def reset_first_calls() -> None:
@@ -172,9 +329,10 @@ def compile_or_dispatch_span(key, axes=None, **attrs):
         phase = "compile" if first_call(key) else "dispatch"
         changed = None
         fn = None
+        cross = False
     else:
         fn = key[0] if isinstance(key, tuple) and key else str(key)
-        first, changed = classify_compile(fn, axes)
+        first, changed, cross = classify_compile(fn, axes)
         phase = "compile" if first else "dispatch"
     t0 = time.perf_counter()
     with trace.default_tracer().span(phase, **attrs):
@@ -184,18 +342,25 @@ def compile_or_dispatch_span(key, axes=None, **attrs):
             time.perf_counter() - t0
         )
         if changed:
-            _emit_recompile(fn, axes, changed)
+            _emit_recompile(fn, axes, changed, cross)
 
 
-def _emit_recompile(fn: str, axes: dict, changed: dict) -> None:
+def _emit_recompile(
+    fn: str, axes: dict, changed: dict, cross_process: bool = False
+) -> None:
     """One ``recompile`` instant + versioned JSONL record naming the
-    axis/axes whose change forced the re-specialization."""
+    axis/axes whose change forced the re-specialization.
+    ``cross_process`` marks ledger-explained first-compiles of the
+    session (diffed against the previous process, ISSUE 6)."""
     from ba_tpu.obs import registry, trace
     from ba_tpu.utils import metrics
 
     registry.default_registry().counter("recompiles_total").inc()
     trace.default_tracer().instant(
-        "recompile", fn=fn, changed=",".join(sorted(changed))
+        "recompile",
+        fn=fn,
+        changed=",".join(sorted(changed)),
+        cross_process=cross_process,
     )
     metrics.emit(
         {
@@ -204,6 +369,7 @@ def _emit_recompile(fn: str, axes: dict, changed: dict) -> None:
             "fn": fn,
             "changed": changed,
             "axes": dict(axes),
+            "cross_process": cross_process,
         }
     )
 

@@ -27,13 +27,16 @@ from ba_tpu.parallel.multihost import init_distributed, make_global_mesh, put_gl
 from ba_tpu.parallel.pipeline import (
     COUNTER_NAMES,
     SCENARIO_COUNTER_NAMES,
+    CarryCheckpoint,
     KeySchedule,
     agreement_counters_init,
     fresh_copy,
+    load_carry_checkpoint,
     make_key_schedule,
     pipeline_megastep,
     pipeline_sweep,
     round_keys,
+    save_carry_checkpoint,
     scenario_counters_init,
     scenario_megastep,
     scenario_sweep,
@@ -55,10 +58,13 @@ __all__ = [
     "put_global",
     "COUNTER_NAMES",
     "SCENARIO_COUNTER_NAMES",
+    "CarryCheckpoint",
     "KeySchedule",
     "agreement_counters_init",
     "fresh_copy",
+    "load_carry_checkpoint",
     "make_key_schedule",
+    "save_carry_checkpoint",
     "pipeline_megastep",
     "pipeline_sweep",
     "round_keys",

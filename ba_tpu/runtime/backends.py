@@ -359,7 +359,16 @@ class JaxBackend:
         stats = dict(out["stats"], counters=out["counters"])
         return majorities, decisions, stats
 
-    def run_scenario(self, generals, leader_idx, order_code, seed, spec):
+    def run_scenario(
+        self,
+        generals,
+        leader_idx,
+        order_code,
+        seed,
+        spec,
+        checkpoint_every=None,
+        checkpoint_path=None,
+    ):
         """A declarative scenario campaign on the B=1 interactive cluster.
 
         Compiles the spec against the ROSTER's ids at the padded roster
@@ -368,8 +377,15 @@ class JaxBackend:
         then drives the pipelined mutating engine
         (``pipeline_sweep(scenario=...)``): kills, revives, fault flips,
         strategy assignment and lowest-alive-id re-election all run on
-        device, depth-k dispatches in flight.  Oral-message protocols
-        only, exactly like ``run_rounds`` — returns None for sm/signed.
+        device, depth-k dispatches in flight.  The lowering is SPARSE
+        (ISSUE 6): host plane memory stays O(chunk) however long the
+        campaign runs, so an interactive ``scenario`` command can replay
+        a million-round churn soak without the roster process caring.
+        ``checkpoint_every``/``checkpoint_path`` thread straight into
+        the engine's carry checkpoints (resume via
+        ``pipeline_sweep(resume=...)`` against the same roster).
+        Oral-message protocols only, exactly like ``run_rounds`` —
+        returns None for sm/signed.
 
         Returns a dict: ``decisions`` (per-round quorum codes),
         ``leaders`` (per-round roster indices), ``counters``
@@ -392,7 +408,9 @@ class JaxBackend:
         ids = np.zeros(cap, np.int64)
         for i, g in enumerate(generals):
             ids[i] = g.id
-        block = compile_scenario(spec, batch=1, capacity=cap, ids=ids)
+        block = compile_scenario(
+            spec, batch=1, capacity=cap, ids=ids, sparse=True
+        )
         # fresh_copy is LOAD-BEARING, not defensive: _make_state stages
         # numpy and jnp.asarray may ZERO-COPY it on CPU — donating a
         # buffer that aliases live host memory makes the returned
@@ -414,6 +432,8 @@ class JaxBackend:
             rounds_per_dispatch=per_dispatch,
             collect_decisions=True,
             scenario=block,
+            checkpoint_every=checkpoint_every,
+            checkpoint_path=checkpoint_path,
         )
         final = out["final_state"]
         # ONE fetch per row, as in run_round (elementwise fetches pay a

@@ -295,10 +295,17 @@ class Cluster:
         )
         return res, counts, stats
 
-    def run_scenario(self, spec):
+    def run_scenario(self, spec, checkpoint_every=None, checkpoint_path=None):
         """Run a declarative scenario campaign (ba_tpu.scenario) on this
         cluster: the whole ``g-kill``/``g-add``/``g-state`` REPL session
         the spec encodes, executed as ONE pipelined device run.
+
+        ``checkpoint_every``/``checkpoint_path`` (ISSUE 6) thread into
+        the engine's carry checkpoints: every N rounds the campaign's
+        donated carry serializes to the repo's single checkpoint format
+        (``utils/snapshot.py``), so a long-lived campaign survives its
+        process and resumes bit-exactly
+        (``pipeline_sweep(resume=path)``).
 
         The backend (``run_scenario``) compiles the spec against the
         current roster and drives the mutating megastep; afterwards the
@@ -329,6 +336,8 @@ class Cluster:
             res = run(
                 self.generals, leader_idx, order_code, self._round_seed(),
                 spec,
+                checkpoint_every=checkpoint_every,
+                checkpoint_path=checkpoint_path,
             )
         if res is None:
             return None
@@ -371,6 +380,7 @@ class Cluster:
                 "leader_id": self.leader_id,
                 "n": len(self.generals),
                 "dispatches": res["stats"]["dispatches"],
+                "checkpoints": res["stats"].get("checkpoints", 0),
             }
         )
         return counts, res

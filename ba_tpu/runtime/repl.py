@@ -129,17 +129,56 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
         # adversary strategies — as one pipelined device run.  Spec
         # problems print a one-line error; an incapable backend
         # (PyBackend, signed) is silently ignored like other guarded
-        # divergences.
-        if len(cmd) == 1:
+        # divergences.  `scenario <file> <ckpt-path> <every>` (ISSUE 6)
+        # additionally serializes the campaign's carry every <every>
+        # rounds to <ckpt-path> (a literal {round} in the path keeps
+        # every checkpoint; otherwise the latest wins), so a long
+        # campaign survives the REPL process and resumes bit-exactly.
+        # The reference-exact `line.split(" ")` keeps empty tokens, so a
+        # trailing space would otherwise read as an (empty) checkpoint
+        # path and abort the command — drop them here, locally.
+        args = [t for t in cmd[1:] if t]
+        if not args:
             return True
+        ck_path = ck_every = None
+        if len(args) == 2:
+            # A path without <every> would silently run uncheckpointed —
+            # and the user would only find out at resume time.
+            out("scenario error: checkpoint path given without <every> "
+                "(usage: scenario <file> [<ckpt-path> <every>])")
+            return True
+        if len(args) > 3:
+            # Like the path-without-<every> case: extra tokens mean the
+            # user expected something this command does not do — refuse
+            # loudly rather than silently dropping them.
+            out("scenario error: too many arguments "
+                "(usage: scenario <file> [<ckpt-path> <every>])")
+            return True
+        if len(args) == 3:
+            ck_path = args[1]
+            try:
+                ck_every = int(args[2])
+            except ValueError:
+                out(f"scenario error: <every> must be an integer, "
+                    f"got {args[2]!r}")
+                return True
+            if ck_every < 1:
+                out(f"scenario error: <every> must be >= 1, got {ck_every}")
+                return True
         try:
-            spec = scenario_spec.load(cmd[1])
+            spec = scenario_spec.load(args[0])
         except (OSError, ValueError) as e:
             out(f"scenario error: {e}")
             return True
         try:
-            ran = cluster.run_scenario(spec)
-        except ValueError as e:  # e.g. spec names ids not in the roster
+            ran = cluster.run_scenario(
+                spec, checkpoint_every=ck_every, checkpoint_path=ck_path
+            )
+        except (OSError, ValueError) as e:
+            # ValueError: e.g. the spec names ids not in the roster.
+            # OSError: an unwritable checkpoint path surfaces from the
+            # engine's mid-campaign write — one error line, not a dead
+            # REPL (and a dead campaign carry with it).
             out(f"scenario error: {e}")
             return True
         if ran is None:
@@ -154,6 +193,11 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             "Scenario counters: "
             + ", ".join(f"{k}={v}" for k, v in res["counters"].items())
         )
+        if ck_path is not None:
+            out(
+                f"Scenario checkpoints: "
+                f"{res['stats'].get('checkpoints', 0)} -> {ck_path}"
+            )
 
     elif command == "g-state":
         if len(cmd) == 3:

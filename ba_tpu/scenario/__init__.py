@@ -36,6 +36,7 @@ from ba_tpu.scenario.spec import (
     Event,
     Scenario,
     ScenarioError,
+    event_rounds,
     from_dict,
     load,
     save,
@@ -45,9 +46,12 @@ from ba_tpu.scenario.spec import (
 )
 from ba_tpu.scenario.compile import (
     ScenarioBlock,
+    SparseScenarioBlock,
+    as_dense,
     block_from_kills,
     compile_scenario,
     empty_block,
+    zero_chunk,
 )
 
 __all__ = [
@@ -57,9 +61,12 @@ __all__ = [
     "Scenario",
     "ScenarioBlock",
     "ScenarioError",
+    "SparseScenarioBlock",
+    "as_dense",
     "block_from_kills",
     "compile_scenario",
     "empty_block",
+    "event_rounds",
     "from_dict",
     "load",
     "save",
@@ -68,6 +75,7 @@ __all__ = [
     "strategy_id",
     "to_dict",
     "validate",
+    "zero_chunk",
 ]
 
 

@@ -1,50 +1,153 @@
-"""``python -m ba_tpu.scenario <spec.json> ...`` — the CI spec validator.
+"""``python -m ba_tpu.scenario <spec.json|ckpt.npz> ...`` — the CI
+validator for campaign specs AND carry checkpoints.
 
-For every path: load + eagerly validate the spec, round-trip it through
-``to_dict``/``from_dict`` (byte-stable grammar), and lower it through
-the compiler at a probe shape (batch 2, capacity = the largest general
-id the events name, floor 4) so every event's ids/instances/values are
-proven loweable.  Exits non-zero with the offending path on the first
-failure.  Jax-free by construction (spec + compiler are numpy/stdlib
+For every ``.json`` path: load + eagerly validate the spec, round-trip
+it through ``to_dict``/``from_dict`` (byte-stable grammar), lower it
+through BOTH compilers at a probe shape (batch 2, capacity = the
+largest general id the events name, floor 4) — proving every event's
+ids/instances/values loweable — and check the SPARSE lowering (ISSUE
+6): its JSON encoding round-trips exactly
+(``SparseScenarioBlock.to_doc``/``from_doc``) and the chunks it
+materializes are bit-identical to the dense planes — every chunk on
+small specs, every event-bearing chunk plus a spread of empty ones
+(the shared-zero fast path) on long campaigns, keeping this stage
+O(events) rather than O(rounds).
+
+For every ``.npz`` path: schema-check it as a carry checkpoint
+(``utils/snapshot.validate_carry_checkpoint`` — format/version header,
+required carry arrays, round-cursor/KeySchedule-counter agreement,
+counter/strategy shape consistency).
+
+Exits non-zero with the offending path on the first failure.  Jax-free
+by construction (spec + compiler + checkpoint reader are numpy/stdlib
 only) — the same property ba-lint relies on, so this stage costs
 milliseconds in ``scripts/ci.sh``.
 """
 
 from __future__ import annotations
 
+import json
 import sys
 
-from ba_tpu.scenario.compile import compile_scenario
-from ba_tpu.scenario.spec import ScenarioError, from_dict, load, to_dict
+import numpy as np
+
+from ba_tpu.scenario.compile import SparseScenarioBlock, compile_scenario
+from ba_tpu.scenario.spec import (
+    ScenarioError,
+    event_rounds,
+    from_dict,
+    load,
+    to_dict,
+)
+from ba_tpu.utils.snapshot import validate_carry_checkpoint
+
+
+def _check_spec(path: str) -> str:
+    spec = load(path)
+    doc = to_dict(spec)
+    if to_dict(from_dict(doc)) != doc:
+        raise ScenarioError("to_dict/from_dict round-trip drifted")
+    capacity = max([4] + [gid for ev in spec.events for gid in ev.ids])
+    sparse = compile_scenario(spec, batch=2, capacity=capacity, sparse=True)
+    # The dense reference lowering is O(rounds * capacity) host memory
+    # even at probe batch 2 — fine for every committed spec, but a
+    # million-round campaign naming a four-digit general id would need
+    # gigabytes here.  Above the cap the dense side of the parity check
+    # is skipped (the sparse round-trip and chunk/bounds validation
+    # still run); the output line says which mode ran.
+    dense_cells = spec.rounds * 2 * capacity * 4
+    block = (
+        compile_scenario(spec, batch=2, capacity=capacity)
+        if dense_cells <= 64_000_000
+        else None
+    )
+    # Sparse encoding round-trip: exact through its own JSON grammar.
+    sdoc = sparse.to_doc()
+    if SparseScenarioBlock.from_doc(
+        json.loads(json.dumps(sdoc))
+    ).to_doc() != sdoc:
+        raise ScenarioError("sparse to_doc/from_doc round-trip drifted")
+    # Sparse-vs-dense lowering parity, chunk by chunk (window 3 exercises
+    # ragged tails and — on eventless stretches — the shared zero chunk).
+    # The checked-window set is bounded by O(events), not O(rounds): on a
+    # long pure-agreement stretch every window is the SAME shared zero
+    # chunk, so sweeping all of a million-round campaign would cost
+    # minutes while proving nothing new.  Small specs check every
+    # window; large ones check every event-bearing window plus a spread
+    # of empty ones (first/last included) to keep the zero-chunk fast
+    # path pinned.
+    step = 3
+    n_windows = (spec.rounds + step - 1) // step
+    if n_windows <= 512:
+        windows = range(n_windows)
+    else:
+        picked = {r // step for r in sparse.event_rounds}
+        picked.update((0, n_windows - 1))
+        picked.update(range(0, n_windows, n_windows // 8))
+        windows = sorted(picked)
+    for w in windows if block is not None else ():
+        lo = w * step
+        hi = min(lo + step, spec.rounds)
+        dense_chunk = block.chunk(lo, hi)
+        sparse_chunk = sparse.chunk(lo, hi)
+        for name, plane in dense_chunk.items():
+            if not np.array_equal(plane, sparse_chunk[name]):
+                raise ScenarioError(
+                    f"sparse lowering diverges from dense at rounds "
+                    f"[{lo}, {hi}) plane {name!r}"
+                )
+    if block is not None:
+        mutations = int(
+            block.kill.sum()
+            + block.revive.sum()
+            + (block.set_faulty >= 0).sum()
+            + (block.set_strategy >= 0).sum()
+        )
+        parity = f"{mutations} mutated cell(s), sparse parity clean"
+    else:
+        # Exercise the sparse chunk path (bounds, event replay, the
+        # shared zero chunk) even when the dense reference is skipped.
+        for w in windows:
+            sparse.chunk(w * step, min(w * step + step, spec.rounds))
+        parity = (
+            f"dense parity probe skipped ({dense_cells / 1e6:.0f}M cells)"
+        )
+    sparsity = len(event_rounds(spec)) / spec.rounds
+    return (
+        f"{path}: OK — {spec.name!r}, {spec.rounds} round(s), "
+        f"{len(spec.events)} event(s) at probe capacity {capacity}, "
+        f"{parity} ({sparsity:.0%} of rounds carry events)"
+    )
+
+
+def _check_checkpoint(path: str) -> str:
+    meta = validate_carry_checkpoint(path)
+    kind = "scenario" if meta.get("scenario") else "plain"
+    return (
+        f"{path}: OK — carry checkpoint v{meta['v']} ({kind}), "
+        f"round {meta['round']}"
+        + (
+            f" of {meta['rounds_total']}"
+            if meta.get("rounds_total") is not None
+            else ""
+        )
+    )
 
 
 def main(argv) -> int:
     if not argv:
-        print("usage: python -m ba_tpu.scenario <spec.json> ...",
-              file=sys.stderr)
+        print(
+            "usage: python -m ba_tpu.scenario <spec.json|ckpt.npz> ...",
+            file=sys.stderr,
+        )
         return 2
     for path in argv:
         try:
-            spec = load(path)
-            doc = to_dict(spec)
-            if to_dict(from_dict(doc)) != doc:
-                raise ScenarioError("to_dict/from_dict round-trip drifted")
-            capacity = max(
-                [4] + [gid for ev in spec.events for gid in ev.ids]
-            )
-            block = compile_scenario(spec, batch=2, capacity=capacity)
-            mutations = int(
-                block.kill.sum()
-                + block.revive.sum()
-                + (block.set_faulty >= 0).sum()
-                + (block.set_strategy >= 0).sum()
-            )
-            print(
-                f"{path}: OK — {spec.name!r}, {spec.rounds} round(s), "
-                f"{len(spec.events)} event(s), {mutations} mutated "
-                f"cell(s) at probe capacity {capacity}"
-            )
-        except (OSError, ScenarioError) as e:
+            if path.endswith(".npz"):
+                print(_check_checkpoint(path))
+            else:
+                print(_check_spec(path))
+        except (OSError, ValueError) as e:  # ScenarioError is a ValueError
             print(f"{path}: FAIL — {e}", file=sys.stderr)
             return 1
     return 0

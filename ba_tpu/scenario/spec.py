@@ -180,6 +180,18 @@ def validate(spec: Scenario) -> Scenario:
     return spec
 
 
+def event_rounds(spec: Scenario) -> tuple:
+    """Sorted distinct rounds carrying at least one event.
+
+    The campaign's sparsity profile: the streaming engine (ISSUE 6)
+    stages only chunks that intersect these rounds — everything else is
+    the shared zero chunk, uploaded once.  ``python -m ba_tpu.scenario``
+    reports ``len(event_rounds) / rounds`` so a spec author can see what
+    fraction of a long campaign actually mutates.
+    """
+    return tuple(sorted({ev.round for ev in spec.events}))
+
+
 # -- (de)serialization --------------------------------------------------------
 
 

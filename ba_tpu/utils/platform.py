@@ -58,11 +58,15 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
     # Deferred import: obs is jax-free, but platform must stay importable
     # before ba_tpu.utils finishes initializing (utils/__init__ imports
     # this module first).
-    from ba_tpu.obs.instrument import report_compile_cache
+    from ba_tpu.obs.instrument import (
+        configure_compile_ledger,
+        report_compile_cache,
+    )
 
     env = os.environ.get("BA_TPU_COMPILE_CACHE", "")
     if env == "0":
         report_compile_cache(None)
+        configure_compile_ledger(None)
         return None
     if env not in ("", "1"):
         path = env
@@ -77,6 +81,10 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
         jax.config.update("jax_compilation_cache_dir", path)
     except (AttributeError, OSError):
         report_compile_cache(None)
+        # No cache, no ledger: a previously configured ledger must not
+        # keep explaining compiles against a cache dir we just failed
+        # to (re)establish.
+        configure_compile_ledger(None)
         return None  # jax without the cache, or unwritable cache dir
     # Threshold knobs are best-effort AFTER the dir is live: a jax that has
     # the cache but not a threshold knob keeps its default gate (some small
@@ -94,6 +102,31 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
     # trace marker, so first-call "compile" spans (obs.instrument) can be
     # read as cache loads vs real compiles.
     report_compile_cache(path)
+    # Cross-run recompile ledger (ISSUE 6): persist each jitted fn's
+    # compile signature NEXT TO the persistent cache, so a
+    # first-compile-of-the-session can be diffed against the previous
+    # process ("recompiled because jaxlib_version changed" becomes a
+    # row).  jax/jaxlib versions ride as process-constant axes — read
+    # without a backend query, since enable_compilation_cache runs
+    # before platform selection in some callers.  BA_TPU_COMPILE_LEDGER=0
+    # opts out (the test suite does: shared ledger state would make
+    # recompile-record tests order-dependent across processes).
+    if os.environ.get("BA_TPU_COMPILE_LEDGER", "") == "0":
+        configure_compile_ledger(None)
+    else:
+        try:
+            import jaxlib
+
+            jaxlib_version = getattr(jaxlib, "__version__", "unknown")
+        except ImportError:  # pragma: no cover - jax without jaxlib
+            jaxlib_version = "unknown"
+        configure_compile_ledger(
+            os.path.join(path, "ba_tpu_axes_ledger.json"),
+            env_axes={
+                "jax_version": jax.__version__,
+                "jaxlib_version": jaxlib_version,
+            },
+        )
     return path
 
 

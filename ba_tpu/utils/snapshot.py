@@ -21,14 +21,36 @@ good copy.  Cluster snapshots also record the backend configuration
 (protocol / m / signed / backend class) and ``restore_cluster`` refuses a
 mismatch, so a resumed campaign cannot silently continue under different
 protocol semantics.
+
+**Carry checkpoints** (ISSUE 6): the third durable shape is the
+pipelined engine's donated carry — SimState + KeySchedule (key data and
+round counter) + the scenario counter block + the live strategy plane +
+the round cursor — serialized as ONE versioned ``.npz`` whose
+``__meta__`` entry holds a JSON header.  This is the repo's single
+checkpoint format: ``parallel/pipeline.py`` writes it at its retire
+points (zero added sync) and resumes from it bit-exactly,
+``examples/sweep_campaign.py`` chunks long campaigns over it, and
+``python -m ba_tpu.scenario`` validates the schema jax-free (this
+module's reader is numpy + stdlib only — jax appears only inside
+``load_sim_state``).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import zipfile
 
 import numpy as np
+
+CARRY_CHECKPOINT_FORMAT = "ba_tpu.carry_checkpoint"
+CARRY_CHECKPOINT_VERSION = 1
+
+# SimState fields in carry order, then the KeySchedule pair; `counters`
+# and `strategy` ride only on scenario / with_counters carries (the
+# meta header says which).
+CARRY_STATE_FIELDS = ("order", "leader", "faulty", "alive", "ids")
+CARRY_SCHED_FIELDS = ("key_data", "counter")
 
 
 def _atomic_write(path: str, write_fn) -> None:
@@ -135,3 +157,124 @@ def restore_cluster(path: str, cluster) -> None:
     cluster._next_id = doc["next_id"]
     cluster.leader_id = doc["leader_id"]
     cluster.generals = [General(**g) for g in doc["generals"]]
+
+
+# -- carry checkpoints (the pipelined engine's donated carry, durable) --------
+
+
+def write_carry_checkpoint(path: str, arrays: dict, meta: dict) -> None:
+    """Host arrays + JSON-able meta -> one atomic versioned ``.npz``.
+
+    ``arrays`` must already be host numpy (the engine fetches the carry
+    copy inside its existing retire sync — no device handles reach this
+    layer).  ``meta`` is stamped with the format/version keys and stored
+    as the ``__meta__`` entry (a unicode scalar: loads without pickle).
+    """
+    meta = {
+        "format": CARRY_CHECKPOINT_FORMAT,
+        "v": CARRY_CHECKPOINT_VERSION,
+        **meta,
+    }
+
+    def write(tmp):
+        with open(tmp, "wb") as fh:
+            np.savez(
+                fh,
+                __meta__=np.asarray(json.dumps(meta)),
+                **{k: np.asarray(v) for k, v in arrays.items()},
+            )
+
+    _atomic_write(path, write)
+
+
+def read_carry_checkpoint(path: str):
+    """``.npz`` -> ``(meta, {name: numpy array})`` after schema checks.
+
+    Raises ``ValueError`` on anything that could silently resume the
+    wrong campaign: unknown format/version, missing carry arrays, a
+    round cursor that disagrees with the stored KeySchedule counter, or
+    counters/strategy shapes inconsistent with the state.  Numpy +
+    stdlib only — ``python -m ba_tpu.scenario`` runs this jax-free.
+    """
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            fields = {k: data[k] for k in data.files}
+    except zipfile.BadZipFile as e:
+        # np.load raises BadZipFile (not OSError/ValueError) on a
+        # truncated/half-written file — normalize it so callers keeping
+        # this function's documented ValueError contract (the jax-free
+        # CLI validator, resume= error paths) see every corruption the
+        # same way.
+        raise ValueError(f"{path!r}: not a readable .npz ({e})") from None
+    raw = fields.pop("__meta__", None)
+    if raw is None:
+        raise ValueError(f"{path!r}: no __meta__ entry — not a carry checkpoint")
+    try:
+        meta = json.loads(str(raw))
+    except ValueError as e:
+        raise ValueError(f"{path!r}: unparseable __meta__ ({e})") from None
+    if meta.get("format") != CARRY_CHECKPOINT_FORMAT:
+        raise ValueError(
+            f"{path!r}: format {meta.get('format')!r} is not "
+            f"{CARRY_CHECKPOINT_FORMAT!r}"
+        )
+    if meta.get("v") != CARRY_CHECKPOINT_VERSION:
+        raise ValueError(
+            f"{path!r}: carry checkpoint version {meta.get('v')!r} "
+            f"(this build reads v{CARRY_CHECKPOINT_VERSION})"
+        )
+    missing = [
+        k for k in CARRY_STATE_FIELDS + CARRY_SCHED_FIELDS if k not in fields
+    ]
+    if missing:
+        raise ValueError(f"{path!r}: missing carry arrays {missing}")
+    rnd = meta.get("round")
+    if not isinstance(rnd, int) or rnd < 0:
+        raise ValueError(f"{path!r}: bad round cursor {rnd!r}")
+    if int(fields["counter"]) != rnd:
+        raise ValueError(
+            f"{path!r}: round cursor {rnd} disagrees with the KeySchedule "
+            f"counter {int(fields['counter'])} — the carry would replay "
+            f"the wrong key stream"
+        )
+    if fields["faulty"].shape != fields["alive"].shape or fields[
+        "faulty"
+    ].ndim != 2:
+        raise ValueError(
+            f"{path!r}: state planes malformed "
+            f"(faulty {fields['faulty'].shape}, alive {fields['alive'].shape})"
+        )
+    names = meta.get("counter_names")
+    if "counters" in fields:
+        if not isinstance(names, list) or len(names) != fields[
+            "counters"
+        ].shape[-1]:
+            raise ValueError(
+                f"{path!r}: counters block has {fields['counters'].shape} "
+                f"entries but counter_names is {names!r}"
+            )
+    if "strategy" in fields and fields["strategy"].shape != fields[
+        "faulty"
+    ].shape:
+        raise ValueError(
+            f"{path!r}: strategy plane {fields['strategy'].shape} does not "
+            f"match the state {fields['faulty'].shape}"
+        )
+    if meta.get("scenario") and (
+        "counters" not in fields or "strategy" not in fields
+    ):
+        raise ValueError(
+            f"{path!r}: scenario carry without counters/strategy planes"
+        )
+    return meta, fields
+
+
+def validate_carry_checkpoint(path: str) -> dict:
+    """Schema-check a carry checkpoint; returns its meta header.
+
+    The jax-free CI entry (``python -m ba_tpu.scenario <ckpt.npz>``)
+    and anything else that wants to vet a checkpoint without paying a
+    backend init.
+    """
+    meta, _ = read_carry_checkpoint(path)
+    return meta

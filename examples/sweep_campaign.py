@@ -1,22 +1,34 @@
 """Checkpointed fault-sweep campaign with per-checkpoint metrics.
 
 The batched equivalent of running the reference's REPL thousands of
-times with different ``g-state``/``g-kill`` configurations
-(ba.py:401-437): each checkpoint agrees ``SWEEP_BATCH`` independent
-clusters with random sizes and traitor sets under a fresh fold of the
-campaign key, reports the decision histogram, snapshots the campaign's
-metrics into the obs registry (ROADMAP: mid-campaign dashboards for
-free), and checkpoints the final state — something the reference cannot
-do at all, since its state dies with the process.
+times with different fault configurations (ba.py:401-437): ONE
+continuous pipelined campaign agrees ``SWEEP_BATCH`` independent
+clusters over ``SWEEP_CHECKPOINTS x SWEEP_ROUNDS_PER_CKPT`` rounds,
+reporting the per-checkpoint decision histogram and snapshotting the
+campaign's metrics into the obs registry — something the reference
+cannot do at all, since its state dies with the process.
 
-Observability wiring (PR 2's registry, PR 3's ROADMAP item): counters
-for instances/decisions, a log-bucketed histogram of per-checkpoint
-wall time, and one versioned ``{"event": "metrics_snapshot", "v": 1}``
-record per checkpoint.  Point ``BA_TPU_METRICS`` at a path (or ``-``
-for stderr) to capture the JSONL stream; unset, the snapshots are
-returned in-memory only and the example stays file-silent.
+Checkpoint format (ISSUE 6): this example used to roll its own
+chunking (fresh state + one ``save_sim_state`` per checkpoint); it now
+rides the engine's CARRY checkpoints — ``pipeline_sweep(
+checkpoint_every=..., checkpoint_path=...)`` serializes the donated
+carry (SimState + KeySchedule + counter block + round cursor) inside
+the engine's existing retire fetch, in the repo's single checkpoint
+format (``utils/snapshot.py``).  The finale proves the point of the
+format: the campaign RESUMES from its mid-point checkpoint and the
+replayed tail bit-matches the original run.
 
-Runs anywhere: real TPU if available, else an 8-device virtual CPU mesh.
+Observability wiring (PR 2's registry): counters for
+instances/decisions, a log-bucketed histogram of per-checkpoint wall
+time, and one versioned ``{"event": "metrics_snapshot", "v": 1}``
+record per checkpoint (the JSONL stream also carries the engine's
+``scenario_checkpoint`` records now).  Point ``BA_TPU_METRICS`` at a
+path (or ``-`` for stderr) to capture the stream; unset, the snapshots
+are returned in-memory only and the example stays file-silent.
+
+Runs anywhere: real TPU if available, else virtual CPU devices (the
+campaign runs the single-device engine; see ``parallel/mesh.py`` for
+the sharded sweeps).
 
     SWEEP_CHECKPOINTS=3 BA_TPU_METRICS=/tmp/campaign.jsonl \\
         python examples/sweep_campaign.py
@@ -39,13 +51,17 @@ def main() -> None:
     import jax.random as jr
 
     from ba_tpu.obs import default_registry
-    from ba_tpu.parallel import make_mesh, make_sweep_state, sharded_sweep
-    from ba_tpu.utils.snapshot import save_sim_state
+    from ba_tpu.parallel import (
+        fresh_copy,
+        make_sweep_state,
+        pipeline_sweep,
+    )
 
     batch = int(os.environ.get("SWEEP_BATCH", 10_240))
     cap = int(os.environ.get("SWEEP_CAP", 64))
     checkpoints = int(os.environ.get("SWEEP_CHECKPOINTS", 3))
-    ckpt_path = os.environ.get("SWEEP_CKPT", "/tmp/sweep_campaign.npz")
+    per_ckpt = int(os.environ.get("SWEEP_ROUNDS_PER_CKPT", 2))
+    ckpt_path = os.environ.get("SWEEP_CKPT", "/tmp/sweep_campaign_{round}.npz")
 
     reg = default_registry()
     ck_c = reg.counter("sweep_campaign_checkpoints_total")
@@ -56,50 +72,106 @@ def main() -> None:
         for name in ("retreat", "attack", "undefined")
     }
 
-    mesh = make_mesh()
-    campaign_key = jr.key(1)
-    total = np.zeros(3, dtype=np.int64)
+    rounds = checkpoints * per_ckpt
+    state = make_sweep_state(jr.key(0), batch, cap)
     names = ["retreat", "attack", "undefined"]
     print(
-        f"campaign: {checkpoints} checkpoint(s) x {batch} clusters "
-        f"(n <= {cap}, OM(2))"
+        f"campaign: {checkpoints} checkpoint(s) x {per_ckpt} round(s) "
+        f"x {batch} clusters (n <= {cap}, OM(2))"
     )
-    for ck in range(checkpoints):
-        t0 = time.perf_counter()
-        state = make_sweep_state(jr.fold_in(jr.key(0), ck), batch, cap)
-        out = sharded_sweep(
-            mesh, jr.fold_in(campaign_key, ck), state, m=2
-        )
-        hist = np.asarray(out["histogram"])
-        assert hist.sum() == batch
-        total += hist
-        wall_h.record(time.perf_counter() - t0)
+
+    # One metrics_snapshot + wall/decision bookkeeping per checkpoint,
+    # fired from the engine's on_checkpoint hook — the carry serialized
+    # inside the retire fetch, the dashboard record right after it.
+    t_last = time.perf_counter()
+    snapshots = []
+    written = []
+
+    def on_checkpoint(round_cursor, path):
+        nonlocal t_last
+        wall_h.record(time.perf_counter() - t_last)
+        t_last = time.perf_counter()
         ck_c.inc()
-        inst_c.inc(batch)
+        inst_c.inc(batch * per_ckpt)
+        written.append((round_cursor, path))
+        record = reg.emit_snapshot(checkpoint=len(written) - 1,
+                                   round=round_cursor, batch=batch)
+        snapshots.append(record)
+
+    out = pipeline_sweep(
+        jr.key(1),
+        fresh_copy(state),
+        rounds,
+        m=2,
+        rounds_per_dispatch=per_ckpt,
+        with_counters=True,
+        collect_decisions=True,
+        checkpoint_every=per_ckpt,
+        checkpoint_path=ckpt_path,
+        on_checkpoint=on_checkpoint,
+    )
+
+    total = np.zeros(3, dtype=np.int64)
+    for ck in range(checkpoints):
+        hist = out["histograms"][ck * per_ckpt:(ck + 1) * per_ckpt].sum(0)
+        assert hist.sum() == batch * per_ckpt
+        total += hist
         for name, count in zip(names, hist):
             decision_c[name].inc(int(count))
-        save_sim_state(
-            ckpt_path, state, decisions=np.asarray(out["decision"])
-        )
-        # One versioned metrics_snapshot per checkpoint: the JSONL sink
-        # (BA_TPU_METRICS) gets a {"event": "metrics_snapshot", "v": 1}
-        # record a dashboard can tail mid-campaign.
-        record = reg.emit_snapshot(checkpoint=ck, batch=batch)
         counts = " ".join(
             f"{name}={int(count)}" for name, count in zip(names, hist)
         )
-        print(
-            f"  checkpoint {ck}: {counts} "
-            f"(snapshot: {len(record['metrics'])} metrics)"
-        )
-    print(f"{checkpoints * batch} clusters total:")
+        n_metrics = len(snapshots[ck]["metrics"]) if ck < len(snapshots) else 0
+        print(f"  checkpoint {ck}: {counts} (snapshot: {n_metrics} metrics)")
+    print(f"{checkpoints * per_ckpt * batch} cluster-rounds total:")
     for name, count in zip(names, total):
         print(f"  {name:10s} {int(count):7d}")
-    assert total.sum() == checkpoints * batch
+    assert total.sum() == rounds * batch
+    assert len(written) == checkpoints, written
+
+    # Resume proof: replay the tail from a mid-campaign checkpoint and
+    # bit-match the original run — the property that makes the carry
+    # format worth committing to (deterministic replay-from-checkpoint,
+    # elastic migration for the serving layer).  Without a {round}
+    # placeholder every checkpoint overwrote the same file, so the only
+    # carry on disk is the final one (cursor == rounds — nothing left to
+    # replay); same story with a single checkpoint.  Skip the proof
+    # rather than resume a finished campaign.
+    resumable = [
+        (r, p) for r, p in written
+        if r < rounds and "{round}" in ckpt_path
+    ]
+    if not resumable:
+        print(
+            "resume proof skipped: no mid-campaign checkpoint on disk "
+            "(SWEEP_CKPT needs a {round} placeholder and "
+            "SWEEP_CHECKPOINTS >= 2)"
+        )
+    else:
+        mid_round, mid_path = resumable[len(resumable) // 2]
+        resumed = pipeline_sweep(
+            None,
+            None,
+            rounds,
+            m=2,
+            rounds_per_dispatch=per_ckpt,
+            with_counters=True,
+            collect_decisions=True,
+            resume=mid_path,
+        )
+        np.testing.assert_array_equal(
+            resumed["decisions"], out["decisions"][mid_round:]
+        )
+        assert resumed["counters"] == out["counters"]
+        print(
+            f"resume from round {mid_round} ({mid_path}): "
+            f"{rounds - mid_round} replayed round(s) bit-exact"
+        )
+
     sink_target = os.environ.get("BA_TPU_METRICS")
     where = sink_target or "in-memory only (set BA_TPU_METRICS to capture)"
-    print(f"checkpoint -> {ckpt_path}")
-    print(f"metrics_snapshot x{checkpoints} -> {where}")
+    print(f"carry checkpoints -> {ckpt_path}")
+    print(f"metrics_snapshot x{len(snapshots)} -> {where}")
 
 
 if __name__ == "__main__":

@@ -57,6 +57,22 @@ def main() -> int:
             jr.key(2), make_sweep_state(jr.key(3), 4, 8), 2,
             with_counters=True,
         )
+        # Streaming-engine records (ISSUE 6): a tiny sparse campaign
+        # with checkpoint_every drives the real scenario_checkpoint
+        # emitter (carry serialization inside the retire fetch).
+        from ba_tpu.scenario import compile_scenario, from_dict
+
+        spec = from_dict(
+            {"name": "ci", "rounds": 4,
+             "events": [{"round": 1, "kill": [1]}]}
+        )
+        ck_path = path + ".carry.npz"
+        pipeline_sweep(
+            jr.key(4), make_sweep_state(jr.key(5), 4, 4), 4,
+            scenario=compile_scenario(spec, 4, 4, sparse=True),
+            rounds_per_dispatch=2, checkpoint_every=2,
+            checkpoint_path=ck_path,
+        )
         obs.default_registry().emit_snapshot(sink=sink, source="ci-check")
         sink.close()
 
@@ -114,10 +130,25 @@ def main() -> int:
                         isinstance(v, list) and len(v) == 2
                         for v in changed.values()
                     )
+                    and isinstance(rec.get("cross_process"), bool)
                 ):
                     print(
                         f"schema check: line {i} malformed recompile: "
                         f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "scenario_checkpoint":
+                if not (
+                    isinstance(rec.get("round"), int)
+                    and isinstance(rec.get("rounds"), int)
+                    and isinstance(rec.get("bytes"), int)
+                    and isinstance(rec.get("scenario"), bool)
+                    and isinstance(rec.get("path"), str)
+                ):
+                    print(
+                        f"schema check: line {i} malformed "
+                        f"scenario_checkpoint: {line[:160]}",
                         file=sys.stderr,
                     )
                     bad += 1
@@ -126,6 +157,7 @@ def main() -> int:
             "metrics_snapshot",
             "compiled_artifact",
             "recompile",
+            "scenario_checkpoint",
         }
         if not want <= events:
             print(
@@ -140,6 +172,8 @@ def main() -> int:
         return 0
     finally:
         os.unlink(path)
+        if os.path.exists(path + ".carry.npz"):
+            os.unlink(path + ".carry.npz")
 
 
 if __name__ == "__main__":

@@ -24,6 +24,14 @@ force_virtual_cpu_devices(8)
 # BA_TPU_COMPILE_CACHE=0 in the invoking env keeps every compile real
 # (tests/test_platform.py covers the knob; scripts/ci.sh documents the
 # decision).
+# Cross-run recompile ledger hygiene (ISSUE 6): the ledger persists
+# compile signatures in the SHARED cache dir, so with it on, whichever
+# axes the previous test process happened to compile last would make
+# this process's first compiles emit cross_process recompile records —
+# order-dependent test noise.  Tests that cover the ledger configure it
+# explicitly at tmp paths (tests/test_obs_xla.py).
+os.environ.setdefault("BA_TPU_COMPILE_LEDGER", "0")
+
 if os.environ.get("BA_TPU_COMPILE_CACHE") != "0":
     from ba_tpu.utils.platform import enable_compilation_cache
 

@@ -192,6 +192,212 @@ def test_backend_capacity_recompile_is_attributed(fresh_obs):
     assert caps == [4, 8]
 
 
+# -- 2b. cross-run recompile ledger (ISSUE 6) ---------------------------------
+
+
+def test_ledger_explains_first_compile_of_the_session(fresh_obs, tmp_path):
+    # "Process 1": compile fnl under jax_version A; the ledger persists
+    # the merged signature.  "Process 2" (reset + reconfigure): the
+    # FIRST compile of the session diffs against process 1 and emits a
+    # cross_process recompile record naming the env axis — the row the
+    # in-process explainer could never produce.
+    ledger = tmp_path / "axes_ledger.json"
+    obs.configure_compile_ledger(str(ledger), {"jax_version": "0.4.1"})
+    try:
+        with obs.compile_or_dispatch_span(
+            "fnl", axes={"capacity": 4}
+        ) as p:
+            assert p == "compile"
+        metrics.default_sink().close()
+        assert _records(fresh_obs, "recompile") == []  # nothing to diff
+        doc = json.loads(ledger.read_text())
+        assert doc["fns"]["fnl"] == [
+            {"capacity": 4, "jax_version": "0.4.1"}
+        ]
+
+        obs.reset_first_calls()  # "new process"
+        obs.configure_compile_ledger(str(ledger), {"jax_version": "0.5.0"})
+        with obs.compile_or_dispatch_span(
+            "fnl", axes={"capacity": 4}
+        ) as p:
+            assert p == "compile"
+        metrics.default_sink().close()
+        (rec,) = _records(fresh_obs, "recompile")
+        assert rec["fn"] == "fnl"
+        assert rec["cross_process"] is True
+        assert rec["changed"] == {"jax_version": ["0.4.1", "0.5.0"]}
+        assert obs.default_registry().snapshot()[
+            "recompiles_total"
+        ]["value"] == 1
+    finally:
+        obs.configure_compile_ledger(None)
+
+
+def test_ledger_silent_on_identical_signature_and_unknown_fn(
+    fresh_obs, tmp_path
+):
+    ledger = tmp_path / "axes_ledger.json"
+    obs.configure_compile_ledger(str(ledger), {"jax_version": "A"})
+    try:
+        with obs.compile_or_dispatch_span("fns", axes={"m": 1}):
+            pass
+        obs.reset_first_calls()  # same toolchain, same axes: silent
+        obs.configure_compile_ledger(str(ledger), {"jax_version": "A"})
+        with obs.compile_or_dispatch_span("fns", axes={"m": 1}) as p:
+            assert p == "compile"
+        # A fn with no prior row is a plain first compile, no record.
+        with obs.compile_or_dispatch_span("fresh_fn", axes={"m": 2}) as p:
+            assert p == "compile"
+        metrics.default_sink().close()
+        assert _records(fresh_obs, "recompile") == []
+        # In-process re-specialization still reports cross_process=False.
+        with obs.compile_or_dispatch_span("fns", axes={"m": 3}):
+            pass
+        metrics.default_sink().close()
+        (rec,) = _records(fresh_obs, "recompile")
+        assert rec["cross_process"] is False
+        assert rec["changed"] == {"m": [1, 3]}
+        # The ledger's write-through kept every specialization compiled
+        # this session, in compile order.
+        doc = json.loads(ledger.read_text())
+        assert [s["m"] for s in doc["fns"]["fns"]] == [1, 3]
+        assert [s["m"] for s in doc["fns"]["fresh_fn"]] == [2]
+    finally:
+        obs.configure_compile_ledger(None)
+
+
+def test_ledger_corrupt_file_starts_fresh(fresh_obs, tmp_path):
+    ledger = tmp_path / "axes_ledger.json"
+    ledger.write_text("{not json")
+    obs.configure_compile_ledger(str(ledger), {})
+    try:
+        with obs.compile_or_dispatch_span("fnc", axes={"n": 1}) as p:
+            assert p == "compile"
+        metrics.default_sink().close()
+        assert _records(fresh_obs, "recompile") == []
+        assert json.loads(ledger.read_text())["fns"]["fnc"] == [{"n": 1}]
+    finally:
+        obs.configure_compile_ledger(None)
+
+
+def test_ledger_remembers_every_specialization(fresh_obs, tmp_path):
+    # A fn that legitimately compiles at several signatures every session
+    # (backends.py's capacity re-specialization) must NOT read as a
+    # cross-process change when the next process replays the same set —
+    # only a genuinely new signature does.
+    ledger = tmp_path / "axes_ledger.json"
+    obs.configure_compile_ledger(str(ledger), {"jax_version": "A"})
+    try:
+        with obs.compile_or_dispatch_span("fnm", axes={"capacity": 4}):
+            pass
+        with obs.compile_or_dispatch_span("fnm", axes={"capacity": 8}):
+            pass
+        metrics.default_sink().close()
+        # The in-process 4 -> 8 re-specialization is the only record.
+        (rec,) = _records(fresh_obs, "recompile")
+        assert rec["cross_process"] is False
+
+        obs.reset_first_calls()  # "new process", identical workload
+        obs.configure_compile_ledger(str(ledger), {"jax_version": "A"})
+        with obs.compile_or_dispatch_span("fnm", axes={"capacity": 4}) as p:
+            assert p == "compile"  # session-first, but ledger-known
+        metrics.default_sink().close()
+        recs = _records(fresh_obs, "recompile")
+        assert not [r for r in recs if r.get("cross_process")]
+        # Dying after replaying only capacity=4 must not shrink the
+        # ledger: a third session whose FIRST compile is a signature
+        # neither prior process ever had still gets the cross-process
+        # diff, against the most recent prior specialization.
+        obs.reset_first_calls()
+        obs.configure_compile_ledger(str(ledger), {"jax_version": "A"})
+        with obs.compile_or_dispatch_span("fnm", axes={"capacity": 16}):
+            pass
+        metrics.default_sink().close()
+        cross = [r for r in _records(fresh_obs, "recompile")
+                 if r.get("cross_process")]
+        assert [r["changed"] for r in cross] == [{"capacity": [8, 16]}]
+    finally:
+        obs.configure_compile_ledger(None)
+
+
+def test_ledger_diffs_against_closest_prior_signature(fresh_obs, tmp_path):
+    # A fn the previous process compiled at capacities 4 AND 8 that
+    # recompiles at capacity 4 after a toolchain bump must read as
+    # "jax_version changed" alone — diffing against the most recent
+    # prior row (capacity 8) would also name capacity, an axis that
+    # forced nothing.
+    ledger = tmp_path / "axes_ledger.json"
+    obs.configure_compile_ledger(str(ledger), {"jax_version": "old"})
+    try:
+        for cap in (4, 8):
+            with obs.compile_or_dispatch_span("fnc", axes={"capacity": cap}):
+                pass
+        obs.reset_first_calls()
+        obs.configure_compile_ledger(str(ledger), {"jax_version": "new"})
+        with obs.compile_or_dispatch_span("fnc", axes={"capacity": 4}):
+            pass
+        metrics.default_sink().close()
+        cross = [r for r in _records(fresh_obs, "recompile")
+                 if r.get("cross_process")]
+        assert [r["changed"] for r in cross] == [
+            {"jax_version": ["old", "new"]}
+        ]
+    finally:
+        obs.configure_compile_ledger(None)
+
+
+def test_ledger_merges_concurrent_writer_rows(fresh_obs, tmp_path):
+    # Two processes share one cache dir (the default outside the test
+    # suite) and each rewrites the whole file.  A row another process
+    # stored AFTER this process read its configure-time snapshot must
+    # survive this process's next write — otherwise the next session
+    # reads the erased row as a spurious cross-process recompile.
+    ledger = tmp_path / "axes_ledger.json"
+    obs.configure_compile_ledger(str(ledger), {"jax_version": "A"})
+    try:
+        other_sig = {"capacity": 16, "jax_version": "A"}
+        ledger.write_text(
+            json.dumps({"v": 1, "fns": {"other_fn": [other_sig]}})
+        )
+        with obs.compile_or_dispatch_span("mine", axes={"capacity": 4}):
+            pass
+        doc = json.loads(ledger.read_text())
+        assert doc["fns"]["other_fn"] == [other_sig]
+        assert {"capacity": 4, "jax_version": "A"} in doc["fns"]["mine"]
+    finally:
+        obs.configure_compile_ledger(None)
+
+
+def test_enable_compilation_cache_configures_ledger(monkeypatch, tmp_path):
+    # The wiring contract: a live persistent cache places the ledger
+    # NEXT TO it with jax/jaxlib env axes, and BA_TPU_COMPILE_LEDGER=0
+    # (what conftest sets suite-wide) keeps it off.
+    from ba_tpu.obs import instrument
+    from ba_tpu.utils.platform import enable_compilation_cache
+
+    monkeypatch.setenv("BA_TPU_COMPILE_CACHE", str(tmp_path / "xla"))
+    monkeypatch.setenv("BA_TPU_COMPILE_LEDGER", "1")
+    try:
+        path = enable_compilation_cache()
+        assert path == str(tmp_path / "xla")
+        assert instrument._ledger_path == str(
+            tmp_path / "xla" / "ba_tpu_axes_ledger.json"
+        )
+        import jax
+
+        assert instrument._ledger_env["jax_version"] == jax.__version__
+        assert "jaxlib_version" in instrument._ledger_env
+        monkeypatch.setenv("BA_TPU_COMPILE_LEDGER", "0")
+        enable_compilation_cache()
+        assert instrument._ledger_path is None
+    finally:
+        # Restore the suite's shared cache dir + ledger-off hygiene.
+        monkeypatch.delenv("BA_TPU_COMPILE_CACHE")
+        monkeypatch.setenv("BA_TPU_COMPILE_LEDGER", "0")
+        enable_compilation_cache()
+        obs.configure_compile_ledger(None)
+
+
 # -- 3. disabled path ---------------------------------------------------------
 
 

@@ -40,9 +40,11 @@ from ba_tpu.parallel import (
     SCENARIO_COUNTER_NAMES,
     failover_sweep,
     fresh_copy as _fresh,
+    load_carry_checkpoint,
     make_mesh,
     make_sweep_state,
     pipeline_sweep,
+    save_carry_checkpoint,
     scenario_megastep,
     scenario_counters_init,
     scenario_sweep,
@@ -51,11 +53,13 @@ from ba_tpu.parallel.pipeline import make_key_schedule, round_keys
 from ba_tpu.parallel.sweep import agreement_step
 from ba_tpu.scenario import (
     ScenarioError,
+    SparseScenarioBlock,
     block_from_kills,
     compile_scenario,
     empty_block,
     from_dict,
     to_dict,
+    zero_chunk,
 )
 from ba_tpu.scenario import spec as spec_mod
 from ba_tpu.scenario import strategies as strat_mod
@@ -219,6 +223,180 @@ def test_scenario_cli_round_trips_committed_specs(tmp_path):
         capture_output=True, text=True, cwd=str(repo), timeout=120,
     )
     assert proc.returncode == 1 and "FAIL" in proc.stderr
+
+
+# -- sparse lowering (ISSUE 6 tentpole piece 1) -------------------------------
+
+
+def _churn_doc(rounds):
+    return {
+        "name": "sparse-demo",
+        "rounds": rounds,
+        "order": "attack",
+        "events": [
+            {"round": 1, "kill": [2]},
+            {"round": 4, "set_faulty": [3], "value": True,
+             "instances": [0]},
+            {"round": 5, "set_strategy": [3], "value": "collude_attack"},
+            {"round": rounds - 1, "revive": [2]},
+        ],
+    }
+
+
+def test_sparse_vs_dense_lowering_parity_per_chunk():
+    # Every chunk window the engine could request — ragged tails, event
+    # windows, pure-agreement stretches — must materialize bit-identical
+    # to the dense lowering's slice of the same rounds.
+    R = 20
+    spec = from_dict(_churn_doc(R))
+    dense = compile_scenario(spec, batch=3, capacity=4)
+    sparse = compile_scenario(spec, batch=3, capacity=4, sparse=True)
+    assert (sparse.rounds, sparse.batch, sparse.n) == (R, 3, 4)
+    for step in (1, 3, 7, R):
+        for lo in range(0, R, step):
+            hi = min(lo + step, R)
+            d, s = dense.chunk(lo, hi), sparse.chunk(lo, hi)
+            for name in d:
+                np.testing.assert_array_equal(
+                    d[name], s[name], err_msg=f"window [{lo}, {hi})"
+                )
+    # Emptiness agrees between the lowerings (bisect vs array scan).
+    for lo, hi in [(0, 2), (2, 4), (6, R - 1), (R - 1, R)]:
+        assert sparse.chunk_is_empty(lo, hi) == dense.chunk_is_empty(lo, hi)
+
+
+def test_sparse_empty_chunk_fast_path_is_shared_and_readonly():
+    spec = from_dict(
+        {"name": "mostly-empty", "rounds": 1000,
+         "events": [{"round": 2, "kill": [1]}]}
+    )
+    sparse = compile_scenario(spec, batch=2, capacity=4, sparse=True)
+    assert sparse.event_rounds == (2,)
+    # Two different empty windows of equal length: the SAME arrays.
+    a, b = sparse.chunk(100, 200), sparse.chunk(500, 600)
+    assert a["kill"] is b["kill"]
+    assert a["kill"] is zero_chunk(100, 2, 4)["kill"]
+    # Shared planes are read-only: scribbling fails loudly.
+    with pytest.raises(ValueError):
+        a["kill"][0, 0, 0] = True
+    # Event windows allocate fresh, writable planes.
+    ev = sparse.chunk(0, 10)
+    assert ev["kill"] is not zero_chunk(10, 2, 4)["kill"]
+    assert ev["kill"][2, :, 0].all()
+
+
+def test_sparse_block_is_o_events_not_o_rounds():
+    # A million-round campaign compiles instantly and holds no [R, ...]
+    # arrays — only the resolved event tuples (the memory contract that
+    # makes campaign length unbounded).
+    R = 1_000_000
+    spec = from_dict(
+        {"name": "long", "rounds": R,
+         "events": [{"round": R // 2, "kill": [1]}]}
+    )
+    sparse = compile_scenario(spec, batch=8, capacity=8, sparse=True)
+    assert len(sparse.events) == 1
+    assert sparse.chunk_nbytes(0, 64) == 64 * 8 * 8 * 4
+    # Only the requested window materializes.
+    ck = sparse.chunk(R // 2 - 1, R // 2 + 1)
+    assert ck["kill"].shape == (2, 8, 8)
+    assert ck["kill"][1, :, 0].all() and not ck["kill"][0].any()
+
+
+def test_sparse_doc_round_trip_exact():
+    spec = from_dict(_churn_doc(12))
+    sparse = compile_scenario(spec, batch=3, capacity=4, sparse=True)
+    doc = sparse.to_doc()
+    again = SparseScenarioBlock.from_doc(json.loads(json.dumps(doc)))
+    assert again == sparse
+    assert again.to_doc() == doc
+    for bad in (
+        {"format": "nope"},
+        dict(doc, v=99),
+        dict(doc, events=[{"round": 0}]),
+        # Hand-edited docs with JSON-plausible but unindexable types
+        # must fail HERE (ScenarioError at construction), not as an
+        # IndexError/TypeError mid-campaign inside chunk staging.
+        dict(doc, rounds=float(sparse.rounds)),
+        dict(doc, rounds=str(sparse.rounds)),
+        dict(doc, events=[dict(doc["events"][0], round=1.0)]),
+        dict(doc, events=[dict(doc["events"][0], slots=[0.0])]),
+        # Values too: the resolved contract is None for kill/revive,
+        # 0/1 for set_faulty, a strategy-table id for set_strategy — a
+        # hand-edited doc carrying the SPEC grammar's string form, an
+        # out-of-table id, or a stray tri-state value must fail here,
+        # not inside _apply_event's plane write mid-campaign.
+        dict(doc, events=[dict(doc["events"][0], value=1)]),  # kill
+        dict(doc, events=[dict(doc["events"][1], value=3)]),  # set_faulty
+        dict(doc, events=[dict(doc["events"][1], value=True)]),
+        dict(doc, events=[dict(doc["events"][2], value="silent")]),
+        dict(doc, events=[dict(doc["events"][2], value=200)]),
+        dict(doc, events=[dict(doc["events"][2], value=None)]),
+    ):
+        with pytest.raises(ScenarioError):
+            SparseScenarioBlock.from_doc(bad)
+    with pytest.raises(ScenarioError):  # events validate on construction
+        SparseScenarioBlock(rounds=2, batch=1, capacity=4,
+                            events=((5, "kill", None, (0,), None),))
+
+
+def test_sparse_scenario_engine_bit_exact_vs_dense():
+    # The whole campaign through the engine under both lowerings:
+    # decisions, leaders, histograms, counters — and the staging stats
+    # prove the sparse side stayed O(chunk).
+    B, cap, R = 16, 8, 12
+    key = jr.key(47)
+    state = make_sweep_state(jr.key(46), B, cap, order=ATTACK)
+    spec = from_dict(_churn_doc(R))
+    dense = compile_scenario(spec, B, cap)
+    sparse = compile_scenario(spec, B, cap, sparse=True)
+    out_d = scenario_sweep(
+        key, _fresh(state), dense, rounds_per_dispatch=3,
+        collect_decisions=True,
+    )
+    out_s = scenario_sweep(
+        key, _fresh(state), sparse, rounds_per_dispatch=3,
+        collect_decisions=True,
+    )
+    for k in ("decisions", "leaders", "histograms", "counters_per_round"):
+        np.testing.assert_array_equal(out_d[k], out_s[k])
+    assert out_d["counters"] == out_s["counters"]
+    # Peak staged bytes bounded by ONE chunk, not the campaign.
+    assert out_s["stats"]["plane_peak_bytes"] <= 3 * B * cap * 4
+    assert out_s["stats"]["plane_peak_bytes"] > 0
+
+
+def test_sparse_staging_reuses_zero_chunk_and_reports_gauges():
+    from ba_tpu import obs
+    from ba_tpu.obs.registry import MetricsRegistry
+
+    # Events only in the FIRST chunk: every later chunk is the shared
+    # zero chunk — peak bytes stay at exactly one chunk's planes even
+    # though the campaign is 100x that, and the gauges expose it.
+    B, cap, R, kpd = 8, 8, 200, 2
+    spec = from_dict(
+        {"name": "front-loaded", "rounds": R,
+         "events": [{"round": 0, "kill": [2]}]}
+    )
+    sparse = compile_scenario(spec, B, cap, sparse=True)
+    reg = MetricsRegistry()
+    old = obs.registry._default
+    obs.registry._default = reg
+    try:
+        out = scenario_sweep(
+            jr.key(48), make_sweep_state(jr.key(49), B, cap), sparse,
+            rounds_per_dispatch=kpd,
+        )
+    finally:
+        obs.registry._default = old
+    chunk_bytes = kpd * B * cap * 4
+    assert out["stats"]["plane_peak_bytes"] == chunk_bytes
+    snap = reg.snapshot()
+    assert snap["scenario_plane_bytes"]["value"] == chunk_bytes
+    assert snap["scenario_stage_overlap_s"]["value"] >= 0
+    assert snap["scenario_stage_overlap_s"]["value"] == pytest.approx(
+        out["stats"]["stage_s"]
+    )
 
 
 # -- parity (the ISSUE's three, all bit-exact) --------------------------------
@@ -692,6 +870,386 @@ def test_scenario_registry_counters_and_gauges():
         assert snap[f"scenario_{name}"]["value"] == out["counters"][name]
 
 
+def test_sparse_depth_k_no_blocking_with_staging_and_checkpoints(
+    monkeypatch, tmp_path
+):
+    # ISSUE 6 acceptance: the dispatch-count proof holds with a SPARSE
+    # block — double-buffered staging live, zero-chunk reuse live, carry
+    # checkpoints live — and the engine still never calls
+    # block_until_ready (checkpoint serialization rides the existing
+    # retire fetch, staging is an async upload).
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    B, cap, R, depth = 8, 8, 7, 3
+    state = make_sweep_state(jr.key(55), B, cap)
+    spec = from_dict(
+        {
+            "name": "sparse-noblock",
+            "rounds": R,
+            "events": [
+                {"round": 2, "kill": [1]},
+                {"round": 4, "kill": [2]},
+            ],
+        }
+    )
+    sparse = compile_scenario(spec, B, cap, sparse=True)
+    events = []
+    ckpts = []
+    out = scenario_sweep(
+        jr.key(56), state, sparse,
+        depth=depth, rounds_per_dispatch=1,
+        on_event=lambda kind, i: events.append((kind, i)),
+        checkpoint_every=3,
+        checkpoint_path=str(tmp_path / "nb_{round}.npz"),
+        on_checkpoint=lambda r, p: ckpts.append((r, p)),
+    )
+    assert [i for kind, i in events if kind == "dispatch"] == list(range(R))
+    assert [i for kind, i in events if kind == "retire"] == list(range(R))
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [("dispatch", i) for i in range(depth + 1)]
+    for r in range(R - depth):
+        assert events.index(("retire", r)) > events.index(("dispatch", r + depth))
+    assert out["stats"]["max_in_flight"] == depth + 1
+    assert out["stats"]["retires_before_drain"] == R - depth
+    # The campaign mutated (leaders moved) and the checkpoints landed.
+    assert out["leaders"][0, 0] == 0
+    assert out["leaders"][2, 0] == 1
+    assert out["leaders"][4, 0] == 2
+    assert [r for r, _ in ckpts] == [3, 6]
+    assert out["stats"]["checkpoints"] == 2
+    assert (tmp_path / "nb_3.npz").exists()
+    assert (tmp_path / "nb_6.npz").exists()
+
+
+# -- checkpointed carries (ISSUE 6 tentpole piece 3) --------------------------
+
+
+def _mid_campaign_setup(R=12):
+    B, cap = 16, 8
+    key = jr.key(91)
+    state = make_sweep_state(jr.key(90), B, cap, order=ATTACK)
+    state = dataclasses.replace(
+        state, faulty=state.faulty.at[: B // 2, 0].set(True)
+    )
+    events = [
+        e
+        for e in [
+            {"round": 2, "kill": [1]},
+            {"round": 5, "set_faulty": [3], "value": True},
+            {"round": 6, "set_strategy": [3], "value": "adaptive_split"},
+            {"round": 9, "revive": [1]},
+        ]
+        if e["round"] < R
+    ]
+    spec = from_dict(
+        {
+            "name": "ckpt-campaign",
+            "rounds": R,
+            "order": "attack",
+            "events": events,
+        }
+    )
+    return key, state, compile_scenario(spec, B, cap, sparse=True)
+
+
+def test_resume_from_checkpoint_bit_exact_mid_campaign(tmp_path):
+    # The headline contract: interrupt nowhere, checkpoint mid-flight,
+    # resume in a FRESH engine run — decisions, leaders, every counter,
+    # the final strategy plane, alive masks and the schedule cursor all
+    # bit-match the uninterrupted campaign's tail.
+    R = 12
+    key, state, block = _mid_campaign_setup(R)
+    full = scenario_sweep(
+        key, _fresh(state), block, rounds_per_dispatch=2,
+        collect_decisions=True,
+    )
+    ckpts = []
+    path = str(tmp_path / "carry_{round}.npz")
+    pipeline_sweep(
+        key, _fresh(state), R, scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, checkpoint_every=4, checkpoint_path=path,
+        on_checkpoint=lambda r, p: ckpts.append((r, p)),
+    )
+    assert [r for r, _ in ckpts] == [4, 8, 12]
+    for r0, p0 in ckpts[:-1]:
+        tail = pipeline_sweep(
+            None, None, R, scenario=block, rounds_per_dispatch=2,
+            collect_decisions=True, resume=p0,
+        )
+        assert tail["stats"]["start_round"] == r0
+        assert tail["stats"]["rounds"] == R - r0
+        np.testing.assert_array_equal(
+            tail["decisions"], full["decisions"][r0:]
+        )
+        np.testing.assert_array_equal(tail["leaders"], full["leaders"][r0:])
+        np.testing.assert_array_equal(
+            tail["histograms"], full["histograms"][r0:]
+        )
+        np.testing.assert_array_equal(
+            tail["counters_per_round"], full["counters_per_round"][r0:]
+        )
+        assert tail["counters"] == full["counters"]
+        np.testing.assert_array_equal(
+            np.asarray(tail["final_strategy"]),
+            np.asarray(full["final_strategy"]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tail["final_state"].alive),
+            np.asarray(full["final_state"].alive),
+        )
+        assert int(jax.device_get(tail["final_schedule"].counter)) == R
+
+
+def test_save_load_carry_checkpoint_public_api(tmp_path):
+    from ba_tpu.parallel import CarryCheckpoint
+
+    # A caller can checkpoint a finished run's live carry directly and
+    # continue it later — the library form of the engine's in-retire
+    # writer (same format, same loader).
+    R = 6
+    key, state, block = _mid_campaign_setup(R)
+    head = scenario_sweep(
+        key, _fresh(state), block, rounds_per_dispatch=3,
+    )
+    path = str(tmp_path / "manual.npz")
+    save_carry_checkpoint(
+        path,
+        CarryCheckpoint(
+            state=head["final_state"],
+            schedule=head["final_schedule"],
+            counters=head["final_counters"],
+            strategy=head["final_strategy"],
+            round=R,
+        ),
+        rounds_total=R,
+    )
+    ck = load_carry_checkpoint(path)
+    assert ck.round == R
+    np.testing.assert_array_equal(
+        np.asarray(ck.state.alive), np.asarray(head["final_state"].alive)
+    )
+    assert int(jax.device_get(ck.schedule.counter)) == R
+    # The loaded carry is donation-safe: run it straight into the engine.
+    cont = pipeline_sweep(
+        None, None, 2 * R,
+        scenario=compile_scenario(
+            from_dict({"name": "tail", "rounds": 2 * R, "events": []}),
+            16, 8, sparse=True,
+        ),
+        rounds_per_dispatch=3, resume=ck,
+    )
+    assert cont["stats"]["rounds"] == R
+
+
+def test_resume_validation_errors(tmp_path):
+    R = 6
+    key, state, block = _mid_campaign_setup(R)
+    path = str(tmp_path / "ck_{round}.npz")
+    pipeline_sweep(
+        key, _fresh(state), R, scenario=block, rounds_per_dispatch=3,
+        checkpoint_every=3, checkpoint_path=path,
+    )
+    ck = load_carry_checkpoint(str(tmp_path / "ck_3.npz"))
+    with pytest.raises(ValueError, match="key=None"):
+        pipeline_sweep(jr.key(0), _fresh(state), R, scenario=block,
+                       resume=ck)
+    with pytest.raises(ValueError, match="initial_strategy"):
+        pipeline_sweep(
+            None, None, R, scenario=block, resume=ck,
+            initial_strategy=jnp.zeros((16, 8), jnp.int8),
+        )
+    with pytest.raises(ValueError, match="outside campaign"):
+        short_block = compile_scenario(
+            from_dict({"name": "short", "rounds": ck.round, "events": []}),
+            16, 8, sparse=True,
+        )
+        pipeline_sweep(None, None, ck.round, scenario=short_block,
+                       resume=ck)
+    with pytest.raises(ValueError, match="strategy plane"):
+        pipeline_sweep(None, None, R, resume=ck)  # scenario ckpt, no block
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        pipeline_sweep(jr.key(0), _fresh(state), R,
+                       checkpoint_path=str(tmp_path / "x.npz"))
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        pipeline_sweep(jr.key(0), _fresh(state), R, checkpoint_every=0)
+
+
+def test_checkpoint_schema_rejects_corruption(tmp_path):
+    from ba_tpu.utils.snapshot import (
+        read_carry_checkpoint,
+        write_carry_checkpoint,
+    )
+
+    R = 6
+    key, state, block = _mid_campaign_setup(R)
+    path = str(tmp_path / "ck.npz")
+    pipeline_sweep(
+        key, _fresh(state), R, scenario=block, rounds_per_dispatch=3,
+        checkpoint_every=3, checkpoint_path=path,
+    )
+    meta, arrays = read_carry_checkpoint(path)
+    assert meta["scenario"] is True and meta["rounds_total"] == R
+    # Cursor/counter disagreement is the resume-wrong-keys hazard.
+    bad = str(tmp_path / "bad.npz")
+    write_carry_checkpoint(bad, arrays, dict(meta, round=meta["round"] + 1))
+    with pytest.raises(ValueError, match="disagrees"):
+        read_carry_checkpoint(bad)
+    # Missing carry arrays.
+    broken = dict(arrays)
+    del broken["key_data"]
+    write_carry_checkpoint(bad, broken, meta)
+    with pytest.raises(ValueError, match="missing carry arrays"):
+        read_carry_checkpoint(bad)
+    # Scenario carry without its planes.
+    no_strat = {k: v for k, v in arrays.items() if k != "strategy"}
+    write_carry_checkpoint(bad, no_strat, meta)
+    with pytest.raises(ValueError, match="without counters/strategy"):
+        read_carry_checkpoint(bad)
+    # A truncated/half-written file raises ValueError like every other
+    # corruption (np.load's BadZipFile is normalized), so the jax-free
+    # CLI validator and resume= callers catching the documented
+    # ValueError see it instead of a raw zipfile traceback.
+    with open(path, "rb") as fh:
+        head = fh.read(40)
+    with open(bad, "wb") as fh:
+        fh.write(head)
+    with pytest.raises(ValueError, match="not a readable"):
+        read_carry_checkpoint(bad)
+
+
+def test_checkpoint_emits_jsonl_record(tmp_path):
+    from ba_tpu.utils import metrics
+
+    R = 6
+    key, state, block = _mid_campaign_setup(R)
+    sink = tmp_path / "metrics.jsonl"
+    path = str(tmp_path / "ck_{round}.npz")
+    old = metrics._default
+    metrics._default = metrics.MetricsSink(str(sink))
+    try:
+        pipeline_sweep(
+            key, _fresh(state), R, scenario=block, rounds_per_dispatch=3,
+            checkpoint_every=3, checkpoint_path=path,
+        )
+    finally:
+        metrics._default.close()
+        metrics._default = old
+    recs = [
+        json.loads(l)
+        for l in sink.read_text().splitlines()
+        if '"scenario_checkpoint"' in l
+    ]
+    assert [r["round"] for r in recs] == [3, 6]
+    for r in recs:
+        assert r["v"] == 1 and r["rounds"] == R and r["scenario"] is True
+        assert r["bytes"] > 0 and r["path"].endswith(f"ck_{r['round']}.npz")
+
+
+def test_resume_across_process_boundary_bit_exact(tmp_path):
+    # The carry crosses a PROCESS boundary: a subprocess runs the head
+    # of the campaign and checkpoints; this process resumes from the
+    # file and must bit-match its own uninterrupted run (threefry
+    # derivation is process-independent — the checkpoint carries
+    # everything else).  The written file is also vetted by the jax-free
+    # CLI, proving ops can sanity-check checkpoints without a backend.
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    R = 8
+    key, state, block = _mid_campaign_setup(R)
+    full = scenario_sweep(
+        key, _fresh(state), block, rounds_per_dispatch=2,
+        collect_decisions=True,
+    )
+    ck_path = tmp_path / "boundary_{round}.npz"
+    child = f'''
+import dataclasses, jax.random as jr
+from ba_tpu.parallel import make_sweep_state, pipeline_sweep, fresh_copy
+from ba_tpu.scenario import compile_scenario, from_dict
+
+key = jr.key(91)
+state = make_sweep_state(jr.key(90), 16, 8, order=1)
+state = dataclasses.replace(
+    state, faulty=state.faulty.at[:8, 0].set(True)
+)
+spec = from_dict({{
+    "name": "ckpt-campaign", "rounds": {R}, "order": "attack",
+    "events": [
+        {{"round": 2, "kill": [1]}},
+        {{"round": 5, "set_faulty": [3], "value": True}},
+        {{"round": 6, "set_strategy": [3], "value": "adaptive_split"}},
+    ],
+}})
+block = compile_scenario(spec, 16, 8, sparse=True)
+pipeline_sweep(
+    key, state, {R}, scenario=block, rounds_per_dispatch=2,
+    checkpoint_every=4, checkpoint_path={str(ck_path)!r},
+)
+'''
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", child],
+        capture_output=True, text=True, cwd=str(repo), timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    mid = tmp_path / "boundary_4.npz"
+    assert mid.exists()
+    # Jax-free CLI validation of the child's checkpoint.
+    code = (
+        "import sys\n"
+        "from ba_tpu.scenario.__main__ import main\n"
+        "rc = main(sys.argv[1:])\n"
+        "banned = {m for m in sys.modules if m.split('.')[0] in"
+        " ('jax', 'jaxlib')}\n"
+        "assert not banned, banned\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, str(mid)],
+        capture_output=True, text=True, cwd=str(repo), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "carry checkpoint v1 (scenario), round 4 of 8" in proc.stdout
+    # Resume the child's carry HERE, against this process's compile of
+    # the same 8-round spec (events 2/5/6 — the round-9 revive is past
+    # R, filtered identically in both processes).
+    tail = pipeline_sweep(
+        None, None, R, scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, resume=str(mid),
+    )
+    np.testing.assert_array_equal(tail["decisions"], full["decisions"][4:])
+    np.testing.assert_array_equal(tail["leaders"], full["leaders"][4:])
+    assert tail["counters"] == full["counters"]
+
+
+def test_cluster_scenario_checkpoint_every(tmp_path):
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    cluster = Cluster(4, JaxBackend(platform="cpu"), seed=0)
+    spec = from_dict(
+        {"name": "ck", "rounds": 4, "order": "attack",
+         "events": [{"round": 1, "kill": [1]}]}
+    )
+    path = str(tmp_path / "cluster_{round}.npz")
+    counts, res = cluster.run_scenario(
+        spec, checkpoint_every=2, checkpoint_path=path
+    )
+    assert sum(counts.values()) == 4
+    assert res["stats"]["checkpoints"] >= 1
+    written = sorted(tmp_path.glob("cluster_*.npz"))
+    assert written
+    from ba_tpu.utils.snapshot import validate_carry_checkpoint
+
+    meta = validate_carry_checkpoint(str(written[-1]))
+    assert meta["scenario"] is True and meta["rounds_total"] == 4
+
+
 # -- runtime wiring -----------------------------------------------------------
 
 
@@ -765,6 +1323,27 @@ def test_repl_scenario_command_guards(tmp_path):
     handle_command(jx, f"scenario {bad}", out.append)
     assert len(out) == 1 and "not in the roster" in out[0]
     assert len(jx.generals) == 4  # roster untouched on error
+    # A trailing space (trivial to type interactively) must not read as
+    # an empty checkpoint path — the campaign just runs.  (_write_spec
+    # reuses one filename; restore the good spec the bad one clobbered.)
+    path = _write_spec(tmp_path, {"name": "s", "rounds": 1, "events": []})
+    out = []
+    assert handle_command(jx, f"scenario {path} ", out.append)
+    assert out and out[0].startswith("Scenario s:")
+    # An unwritable checkpoint path is one error line mid-campaign, not
+    # a dead REPL (checkpoint writes surface OSError, not ValueError).
+    out = []
+    assert handle_command(
+        jx, f"scenario {path} {tmp_path}/no/such/dir/ck.npz 1", out.append
+    )
+    assert len(out) == 1 and out[0].startswith("scenario error:")
+    # Extra tokens refuse loudly (same class as path-without-<every>).
+    out = []
+    assert handle_command(
+        jx, f"scenario {path} ck.npz 1 500", out.append
+    )
+    assert out == ["scenario error: too many arguments "
+                   "(usage: scenario <file> [<ckpt-path> <every>])"]
 
 
 def test_cluster_scenario_emits_campaign_record(tmp_path):
