@@ -55,6 +55,8 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import os.path
+import threading
 import time
 
 import jax
@@ -492,8 +494,20 @@ def _carry_arrays(host_state, host_sched, host_counters, host_strategy):
     return arrays
 
 
+# Carry-header fields the engine/writer stamp themselves: ONE literal
+# shared by _carry_meta's clash check and pipeline_sweep's eager
+# checkpoint_meta validation, so a future header field cannot be added
+# to one and forgotten in the other (a caller's meta key silently
+# colliding with an engine field is the misclassified-checkpoint
+# hazard both checks exist to prevent).
+RESERVED_CARRY_META_KEYS = frozenset(
+    {"format", "v", "round", "scenario", "counter_names", "sha256",
+     "rounds_total"}
+)
+
+
 def _carry_meta(round_cursor: int, counters, strategy, **extra) -> dict:
-    clash = {"format", "v", "round", "scenario", "counter_names"} & set(extra)
+    clash = (RESERVED_CARRY_META_KEYS - {"rounds_total"}) & set(extra)
     if clash:
         # Silently overriding a header field would write a checkpoint
         # every reader rejects (or worse, misclassifies): catch it at
@@ -606,8 +620,14 @@ def pipeline_sweep(  # ba-lint: donates(state)
     initial_strategy: jax.Array | None = None,
     checkpoint_every: int | None = None,
     checkpoint_path: str | None = None,
+    checkpoint_keep_last: int | None = None,
+    checkpoint_meta: dict | None = None,
     on_checkpoint=None,
     resume=None,
+    exec_seam=None,
+    retire_timeout_s: float | None = None,
+    on_stall=None,
+    on_rows=None,
 ):
     """Run ``rounds`` sweep rounds through the depth-k pipelined engine.
 
@@ -695,7 +715,46 @@ def pipeline_sweep(  # ba-lint: donates(state)
     original run had.  The resumed rounds are bit-exact with the
     uninterrupted run's tail (same key schedule, same counters, same
     strategy plane), which the resume tests pin mid-campaign and across
-    a process boundary.
+    a process boundary.  ``checkpoint_keep_last=N`` (ISSUE 7) prunes a
+    ``{round}``-templated family to its N newest members after every
+    write (``utils/snapshot.prune_checkpoints``; companion sidecars go
+    with them).  ``checkpoint_meta`` (JSON-able dict) rides every
+    checkpoint's ``__meta__`` header next to the engine's own fields —
+    the supervisor stamps its campaign fingerprint here; reserved header
+    keys are rejected at write time.
+
+    RESILIENCE SEAMS (ISSUE 7; all host-side, zero added device
+    synchronization — the no-blocking test re-runs with every seam
+    live):
+
+    - ``exec_seam(call, phase, dispatch, lo, hi)`` — the injectable
+      execution seam.  When set, every megastep invocation (``phase ==
+      "dispatch"``) and every retire fetch (``phase == "retire"``) runs
+      as ``exec_seam(call, ...)`` where ``call`` is the zero-arg real
+      operation and ``[lo, hi)`` the dispatch's round window.  The
+      execution supervisor (``runtime/supervisor.py``) composes fault
+      injection and transient-retry here; a seam that simply returns
+      ``call()`` is the identity.  Retrying ``call`` at the retire
+      phase is always safe (the fetched outputs are not donated);
+      retrying at the dispatch phase is safe exactly when the previous
+      attempt raised BEFORE the jitted call consumed the donated carry
+      (an injected fault; a real post-donation failure raises
+      use-after-donate on retry and escalates).
+    - ``retire_timeout_s`` + ``on_stall(dispatch, timeout_s)`` — the
+      wall-clock watchdog on the depth-delayed retire: a
+      ``threading.Timer`` armed around each retire fetch declares the
+      dispatch STALLED if the fetch runs past the timeout (a
+      ``dispatch_stalled`` instant, the ``pipeline_stalls_total``
+      counter, and the callback — fired from the timer thread, which
+      can only observe: an in-process hung fetch is not interruptible,
+      recovery by process replacement + checkpoint resume is the
+      supervisor's job).  The fetch itself is untouched — detection
+      adds a timer arm/cancel, never a sync.
+    - ``on_rows(dispatch, lo, hi, host_ys)`` — per-retire delivery of
+      the host-fetched output block, BEFORE any checkpoint write of the
+      same retire: a supervisor can persist campaign history alongside
+      each checkpoint and stitch a full bit-exact result across
+      recoveries.
     """
     if rounds < 1:
         raise ValueError(f"rounds={rounds} must be >= 1")
@@ -719,6 +778,50 @@ def pipeline_sweep(  # ba-lint: donates(state)
         # finds an empty disk at resume time.  on_checkpoint alone is no
         # sink either: the hook receives (round, path), not the carry.
         raise ValueError("checkpoint_every needs checkpoint_path")
+    if checkpoint_keep_last is not None:
+        if checkpoint_keep_last < 1:
+            raise ValueError(
+                f"checkpoint_keep_last={checkpoint_keep_last} must be >= 1"
+            )
+        if checkpoint_every is None:
+            raise ValueError("checkpoint_keep_last needs checkpoint_every")
+        if "{round}" in os.path.dirname(checkpoint_path):
+            # snapshot.checkpoint_paths would reject this from inside
+            # the first mid-campaign prune — the exact late failure the
+            # eager checks here exist to prevent.
+            raise ValueError(
+                "checkpoint_path cannot carry the {round} slot in its "
+                "directory component (retention scans one directory)"
+            )
+        if "{round}" not in os.path.basename(checkpoint_path):
+            # A non-templated path IS a keep-last-1 family already;
+            # asking for retention on it means the caller expected a
+            # history that will never exist.  Basename, not the whole
+            # path: a {round} slot in the DIRECTORY component would
+            # pass here only to blow up snapshot.checkpoint_paths at
+            # the first mid-campaign prune.
+            raise ValueError(
+                "checkpoint_keep_last needs a {round}-templated "
+                "checkpoint FILENAME (the directory component cannot "
+                "carry the slot)"
+            )
+    if checkpoint_meta is not None:
+        if checkpoint_every is None:
+            raise ValueError("checkpoint_meta needs checkpoint_every")
+        reserved = RESERVED_CARRY_META_KEYS & set(checkpoint_meta)
+        if reserved:
+            # Eagerly, not at the first mid-campaign write: the engine
+            # stamps these itself, and _carry_meta's own clash check
+            # would only fire after checkpoint_every rounds of device
+            # work.
+            raise ValueError(
+                f"checkpoint_meta key(s) {sorted(reserved)} are "
+                f"reserved for the carry header"
+            )
+    if retire_timeout_s is not None and retire_timeout_s <= 0:
+        raise ValueError(f"retire_timeout_s={retire_timeout_s} must be > 0")
+    if on_stall is not None and retire_timeout_s is None:
+        raise ValueError("on_stall needs retire_timeout_s")
 
     if resume is not None:
         if isinstance(resume, str):
@@ -857,6 +960,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
     max_in_flight = 0
     retires_before_drain = 0
     n_checkpoints = 0
+    n_stalls = 0
     plane_peak_bytes = 0
     stage_s = 0.0
 
@@ -943,6 +1047,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
             _carry_meta(
                 round_cursor, host_counters, host_strategy,
                 rounds_total=rounds,
+                **(checkpoint_meta or {}),
             ),
         )
         n_checkpoints += 1
@@ -960,12 +1065,37 @@ def pipeline_sweep(  # ba-lint: donates(state)
                 "bytes": nbytes,
             }
         )
+        if checkpoint_keep_last is not None:
+            # Retention is hygiene: prune never raises into the retire.
+            _snapshot.prune_checkpoints(checkpoint_path, checkpoint_keep_last)
         if on_checkpoint is not None:
             on_checkpoint(round_cursor, written)
 
+    def declare_stalled(d, lo, hi):
+        # Timer-thread path (ISSUE 7 watchdog): the retire fetch for
+        # dispatch d has run past retire_timeout_s.  Observe and report
+        # only — an in-process hung fetch cannot be interrupted, so
+        # recovery (process replacement + checkpoint resume) belongs to
+        # the supervisor reading these signals.
+        nonlocal n_stalls
+        n_stalls += 1
+        obs.instant(
+            "dispatch_stalled", dispatch=d, lo=lo, hi=hi,
+            timeout_s=retire_timeout_s,
+        )
+        reg.counter("pipeline_stalls_total").inc()
+        if on_stall is not None:
+            try:
+                on_stall(d, retire_timeout_s)
+            except Exception:
+                # A watchdog reporter must never take down the fetch it
+                # is watching (the timer thread would only print a
+                # traceback, but the noise reads as a second fault).
+                pass
+
     def retire():
         # t_sub rides the in-flight tuple (perf_counter_ns at submit).
-        d, ys, t_sub, pending = inflight.popleft()
+        d, ys, t_sub, pending, lo, hi = inflight.popleft()
         with obs.timed_span("retire", lag_h, dispatch=d):
             # The ONLY blocking operation in the engine: fetch dispatch
             # d's outputs, which waits on a dispatch `depth` behind the
@@ -973,13 +1103,35 @@ def pipeline_sweep(  # ba-lint: donates(state)
             # xla.annotate marker aligns this host phase with the device
             # timeline when a BA_TPU_XPROF capture is running.)
             with obs.xla.annotate("megastep_retire", dispatch=d):
-                retired.append(jax.device_get(ys))
+                watchdog = None
+                if retire_timeout_s is not None:
+                    watchdog = threading.Timer(
+                        retire_timeout_s, declare_stalled, args=(d, lo, hi)
+                    )
+                    watchdog.daemon = True
+                    watchdog.start()
+                try:
+                    fetch = functools.partial(jax.device_get, ys)
+                    if exec_seam is None:
+                        host_ys = fetch()
+                    else:
+                        host_ys = exec_seam(fetch, "retire", d, lo, hi)
+                finally:
+                    if watchdog is not None:
+                        watchdog.cancel()
+                retired.append(host_ys)
         # Latency records BEFORE the checkpoint write: the histogram
         # measures submit->retire of the dispatch itself, and folding a
         # slow disk target's serialization time in would skew the
         # distribution the engine's overlap analysis is built on.
         lat_h.record((time.perf_counter_ns() - t_sub) / 1e9)
         ret_c.inc()
+        if on_rows is not None:
+            # Before the checkpoint write on purpose: a supervisor
+            # persisting campaign history next to each checkpoint needs
+            # this dispatch's rows already delivered when on_checkpoint
+            # fires for the same retire.
+            on_rows(d, lo, hi, host_ys)
         if pending is not None:
             # The checkpoint copy was made right after this dispatch's
             # outputs; the fetch above already waited for them, so this
@@ -995,6 +1147,12 @@ def pipeline_sweep(  # ba-lint: donates(state)
         # overlap with); every later chunk stages in the overlap slot.
         staged_ev = stage_chunk(start, start + chunks[0])
     for d, nr in enumerate(chunks):
+        # The round window this dispatch covers — threaded through the
+        # execution seam and the in-flight tuple so fault injection,
+        # stall reports and row delivery all speak in ROUNDS (stable
+        # across supervised restarts), never dispatch indices (which
+        # reset to 0 on every resume).
+        lo, hi = round_base, round_base + nr
         # First dispatch of a fresh static specialization pays trace +
         # compile (or a persistent-cache load) synchronously before the
         # async dispatch; later ones are cached dispatches — the span is
@@ -1032,9 +1190,17 @@ def pipeline_sweep(  # ba-lint: donates(state)
                 "scenario_megastep", axes=axes, dispatch=d, rounds=nr
             ) as phase:
                 with obs.xla.annotate("megastep_dispatch", dispatch=d):
-                    out = scenario_megastep(
-                        state, sched, strategy, counters, ev, **kwargs
+                    # functools.partial (not a lambda) binds the carry
+                    # NOW: the seam may retry the zero-arg call, and the
+                    # names `state`/`sched`/... rebind right below.
+                    call = functools.partial(
+                        scenario_megastep,
+                        state, sched, strategy, counters, ev, **kwargs,
                     )
+                    if exec_seam is None:
+                        out = call()
+                    else:
+                        out = exec_seam(call, "dispatch", d, lo, hi)
             if phase == "compile" and obs.xla.enabled():
                 # Donated args keep their shape/dtype metadata after the
                 # dispatch consumes them, which is all abstractify reads
@@ -1061,7 +1227,13 @@ def pipeline_sweep(  # ba-lint: donates(state)
                 "pipeline_megastep", axes=axes, dispatch=d, rounds=nr
             ) as phase:
                 with obs.xla.annotate("megastep_dispatch", dispatch=d):
-                    out = pipeline_megastep(state, sched, **kwargs)
+                    call = functools.partial(
+                        pipeline_megastep, state, sched, **kwargs
+                    )
+                    if exec_seam is None:
+                        out = call()
+                    else:
+                        out = exec_seam(call, "dispatch", d, lo, hi)
             if phase == "compile" and obs.xla.enabled():
                 # Device-tier artifact: AOT-harvest this specialization's
                 # cost/memory analysis (flops, bytes, donation-alias
@@ -1082,7 +1254,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
                     obs.xla.abstractify(kwargs),
                     axes=axes,
                 )
-        round_base += nr
+        round_base = hi
         t_sub = time.perf_counter_ns()
         disp_c.inc()
         if scenario is not None:
@@ -1113,7 +1285,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
             next_ckpt = round_base + checkpoint_every
         if on_event is not None:
             on_event("dispatch", d)
-        inflight.append((d, ys, t_sub, pending))
+        inflight.append((d, ys, t_sub, pending, lo, hi))
         max_in_flight = max(max_in_flight, len(inflight))
         occ_h.record(len(inflight))
         if scenario is not None and d + 1 < len(chunks):
@@ -1150,6 +1322,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
             "max_in_flight": max_in_flight,
             "retires_before_drain": retires_before_drain,
             "checkpoints": n_checkpoints,
+            "stalls": n_stalls,
             "plane_peak_bytes": plane_peak_bytes,
             "stage_s": round(stage_s, 6),
         },

@@ -368,6 +368,9 @@ class JaxBackend:
         spec,
         checkpoint_every=None,
         checkpoint_path=None,
+        checkpoint_keep_last=None,
+        supervise=False,
+        fault_plan=None,
     ):
         """A declarative scenario campaign on the B=1 interactive cluster.
 
@@ -383,9 +386,22 @@ class JaxBackend:
         a million-round churn soak without the roster process caring.
         ``checkpoint_every``/``checkpoint_path`` thread straight into
         the engine's carry checkpoints (resume via
-        ``pipeline_sweep(resume=...)`` against the same roster).
-        Oral-message protocols only, exactly like ``run_rounds`` —
-        returns None for sm/signed.
+        ``pipeline_sweep(resume=...)`` against the same roster);
+        ``checkpoint_keep_last`` prunes a ``{round}``-templated family
+        to its N newest members.  Oral-message protocols only, exactly
+        like ``run_rounds`` — returns None for sm/signed.
+
+        ``supervise=True`` (ISSUE 7) runs the campaign under the
+        resilient execution supervisor
+        (``runtime/supervisor.supervised_sweep``): watchdogged retires,
+        transient retry with backoff, automatic resume from the newest
+        valid checkpoint, OOM degradation — same results dict, plus the
+        ``supervisor`` stats block (attempts/retries/recoveries/...)
+        folded into ``stats``.  ``fault_plan`` (a
+        ``runtime.chaos.FaultPlan`` or a live ``ChaosInjector``) injects
+        deterministic faults for drills and tests; it requires
+        ``supervise=True`` — injecting faults with nobody to catch them
+        would just kill the campaign.
 
         Returns a dict: ``decisions`` (per-round quorum codes),
         ``leaders`` (per-round roster indices), ``counters``
@@ -399,6 +415,8 @@ class JaxBackend:
 
         if self.protocol != "om" or self.signed:
             return None
+        if fault_plan is not None and not supervise:
+            raise ValueError("fault_plan requires supervise=True")
 
         from ba_tpu.parallel.pipeline import fresh_copy, pipeline_sweep
         from ba_tpu.scenario.compile import compile_scenario
@@ -423,10 +441,10 @@ class JaxBackend:
         per_dispatch = min(
             spec.rounds, int(os.environ.get("BA_TPU_PIPELINE_ROUNDS", 8))
         )
-        out = pipeline_sweep(
-            jr.key(seed),
-            state,
-            spec.rounds,
+        # ONE kwargs dict for both arms: supervised and unsupervised
+        # campaigns must stay dial-for-dial identical — a future engine
+        # dial added to one arm only would silently diverge them.
+        kwargs = dict(
             m=self.m,
             depth=depth,
             rounds_per_dispatch=per_dispatch,
@@ -434,15 +452,43 @@ class JaxBackend:
             scenario=block,
             checkpoint_every=checkpoint_every,
             checkpoint_path=checkpoint_path,
+            checkpoint_keep_last=checkpoint_keep_last,
         )
+        if supervise:
+            from ba_tpu.runtime.supervisor import supervised_sweep
+
+            out = supervised_sweep(
+                jr.key(seed), state, spec.rounds,
+                chaos=fault_plan, **kwargs,
+            )
+        else:
+            out = pipeline_sweep(jr.key(seed), state, spec.rounds, **kwargs)
         final = out["final_state"]
+        stats = out["stats"]
+        if supervise:
+            stats = dict(stats, supervisor=out["supervisor"])
+            if out["supervisor"]["history_start"] != 0:
+                # The per-round consumers below (decision tally,
+                # leaders) assume row 0 is campaign round 0.  A resume
+                # whose prior checkpoints carry no usable rows history
+                # (e.g. written by an UNSUPERVISED run — no sidecars)
+                # assembles only the tail; printing a fractional tally
+                # as the full campaign would be silently wrong output.
+                raise ValueError(
+                    f"supervised resume assembled only rounds "
+                    f"[{out['supervisor']['history_start']}, "
+                    f"{spec.rounds}) — the prior checkpoints at "
+                    f"{checkpoint_path!r} have no rows-history "
+                    f"sidecars (written unsupervised?); rerun with a "
+                    f"fresh checkpoint_path, or resume unsupervised"
+                )
         # ONE fetch per row, as in run_round (elementwise fetches pay a
         # tunnel round-trip per element).
         return {
             "decisions": [int(v) for v in out["decisions"][:, 0]],
             "leaders": [int(v) for v in out["leaders"][:, 0]],
             "counters": out["counters"],
-            "stats": out["stats"],
+            "stats": stats,
             "alive": [bool(v) for v in np.asarray(final.alive[0, :n])],
             "faulty": [bool(v) for v in np.asarray(final.faulty[0, :n])],
         }

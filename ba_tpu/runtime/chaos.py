@@ -1,0 +1,347 @@
+"""Host-side fault injection: deterministic chaos for the supervised
+engine (ISSUE 7).
+
+The paper's failure model is simulated-world (a traitor lies, a general
+dies on command); the EXECUTION layer's failure model — a raised XLA
+error, a hung dispatch, a preempted process, a rotten checkpoint — had
+no counterpart until the execution supervisor
+(``ba_tpu.runtime.supervisor``).  This module is the supervisor's proof
+harness: a :class:`FaultPlan` is plain data (JSON round-trip, eagerly
+validated, exactly the scenario-spec pattern) naming faults at chosen
+ROUNDS, and a :class:`ChaosInjector` fires them deterministically from
+the engine's execution seam (``pipeline_sweep(exec_seam=...)``) and
+checkpoint hook:
+
+- ``transient`` / ``fatal`` / ``oom`` — raise a marked exception
+  (:class:`InjectedTransient` / :class:`InjectedFatal` /
+  :class:`InjectedOOM`) before the wrapped operation runs, so the
+  donated carry is NEVER consumed by an injected failure and an
+  in-place retry is bit-exact;
+- ``stall`` — sleep ``seconds`` inside the watchdogged region (at the
+  ``retire`` phase this sits inside the engine's retire-timeout timer,
+  so an injected stall trips the real watchdog);
+- ``kill`` — ``SIGKILL`` this process mid-campaign: the real
+  preemption, used by the subprocess recovery tests and the
+  ``resilience`` bench;
+- ``corrupt`` — damage the just-written checkpoint file (``flip`` bytes
+  mid-file or ``truncate`` it), exercising digest verification and
+  quarantine fallback.
+
+Faults are keyed by ROUND, not dispatch index: dispatch numbering
+restarts at 0 on every supervised resume, while the round cursor is the
+campaign's stable coordinate — a ``times: 1`` fault fired before a
+recovery stays fired after it (one injector instance spans the whole
+supervised run).
+
+Jax-free by construction (stdlib + the host-side obs/metrics layers):
+``python -m ba_tpu.runtime.chaos plan.json ...`` validates committed
+plans in milliseconds, exactly like ``python -m ba_tpu.scenario`` does
+for campaign specs, and ``scripts/ci.sh`` runs it as the chaos smoke
+stage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import sys
+import time
+
+from ba_tpu import obs
+from ba_tpu.utils import metrics as _metrics
+
+FAULT_KINDS = ("transient", "fatal", "oom", "stall", "kill", "corrupt")
+# corrupt fires from the checkpoint hook, everything else from the
+# execution seam's dispatch/retire phases.
+FAULT_PHASES = ("dispatch", "retire", "checkpoint")
+
+
+class FaultPlanError(ValueError):
+    """A malformed fault plan (bad kind/phase/fields) — eagerly raised
+    at ``from_dict`` time, never mid-campaign."""
+
+
+class InjectedFault(RuntimeError):
+    """Base of every chaos-raised error; ``ba_tpu_fault`` is the
+    classification marker ``supervisor.classify_fault`` reads (duck
+    typing, so the supervisor never imports this module)."""
+
+    ba_tpu_fault = "fatal"
+
+
+class InjectedTransient(InjectedFault):
+    ba_tpu_fault = "transient"
+
+
+class InjectedFatal(InjectedFault):
+    ba_tpu_fault = "fatal"
+
+
+class InjectedOOM(InjectedFault):
+    """Message mimics the XLA allocator's phrasing so the string-marker
+    classification path is exercised too, not just the duck-typed one."""
+
+    ba_tpu_fault = "oom"
+
+
+_RAISES = {
+    "transient": InjectedTransient,
+    "fatal": InjectedFatal,
+    "oom": InjectedOOM,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One planned fault.  ``times`` is how often it fires (-1 =
+    unlimited — the poison-window tests); ``seconds`` is the stall
+    length; ``mode`` the corruption style."""
+
+    round: int
+    kind: str
+    phase: str = "dispatch"
+    times: int = 1
+    seconds: float = 0.0
+    mode: str = "flip"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    name: str
+    faults: tuple
+
+
+def from_dict(doc: dict) -> FaultPlan:
+    """Parse + eagerly validate a fault-plan document."""
+    if not isinstance(doc, dict):
+        raise FaultPlanError(f"fault plan must be an object, got {type(doc)}")
+    unknown = set(doc) - {"name", "faults"}
+    if unknown:
+        raise FaultPlanError(f"unknown fault plan key(s) {sorted(unknown)}")
+    name = doc.get("name")
+    if not isinstance(name, str) or not name:
+        raise FaultPlanError(f"fault plan needs a non-empty name, got {name!r}")
+    raw = doc.get("faults")
+    if not isinstance(raw, list):
+        raise FaultPlanError(f"faults must be a list, got {type(raw)}")
+    faults = []
+    for i, f in enumerate(raw):
+        if not isinstance(f, dict):
+            raise FaultPlanError(f"faults[{i}] must be an object")
+        unknown = set(f) - {"round", "kind", "phase", "times", "seconds",
+                            "mode"}
+        if unknown:
+            raise FaultPlanError(
+                f"faults[{i}]: unknown key(s) {sorted(unknown)}"
+            )
+        kind = f.get("kind")
+        if kind not in FAULT_KINDS:
+            raise FaultPlanError(
+                f"faults[{i}]: kind {kind!r} not in {FAULT_KINDS}"
+            )
+        rnd = f.get("round")
+        if not isinstance(rnd, int) or isinstance(rnd, bool) or rnd < 0:
+            raise FaultPlanError(f"faults[{i}]: bad round {rnd!r}")
+        phase = f.get("phase", "checkpoint" if kind == "corrupt" else
+                      "dispatch")
+        if phase not in FAULT_PHASES:
+            raise FaultPlanError(
+                f"faults[{i}]: phase {phase!r} not in {FAULT_PHASES}"
+            )
+        if (kind == "corrupt") != (phase == "checkpoint"):
+            raise FaultPlanError(
+                f"faults[{i}]: kind {kind!r} cannot fire at phase {phase!r} "
+                f"(corrupt fires at 'checkpoint', everything else at "
+                f"'dispatch'/'retire')"
+            )
+        times = f.get("times", 1)
+        if not isinstance(times, int) or isinstance(times, bool) or (
+            times < 1 and times != -1
+        ):
+            raise FaultPlanError(
+                f"faults[{i}]: times must be >= 1 or -1 (unlimited), "
+                f"got {times!r}"
+            )
+        seconds = f.get("seconds", 0.0)
+        if not isinstance(seconds, (int, float)) or isinstance(
+            seconds, bool
+        ) or seconds < 0:
+            raise FaultPlanError(f"faults[{i}]: bad seconds {seconds!r}")
+        if (kind == "stall") != (seconds > 0):
+            raise FaultPlanError(
+                f"faults[{i}]: seconds is the stall length — required > 0 "
+                f"for kind 'stall', meaningless otherwise"
+            )
+        mode = f.get("mode", "flip")
+        if mode not in ("flip", "truncate"):
+            raise FaultPlanError(
+                f"faults[{i}]: corrupt mode {mode!r} not in "
+                f"('flip', 'truncate')"
+            )
+        faults.append(
+            Fault(round=rnd, kind=kind, phase=phase, times=times,
+                  seconds=float(seconds), mode=mode)
+        )
+    return FaultPlan(name=name, faults=tuple(faults))
+
+
+def to_dict(plan: FaultPlan) -> dict:
+    """The exact inverse of :func:`from_dict` (round-trip pinned by the
+    CLI and tests): defaulted fields are omitted, so a loaded-and-saved
+    plan is byte-stable."""
+    faults = []
+    for f in plan.faults:
+        d = {"round": f.round, "kind": f.kind}
+        default_phase = "checkpoint" if f.kind == "corrupt" else "dispatch"
+        if f.phase != default_phase:
+            d["phase"] = f.phase
+        if f.times != 1:
+            d["times"] = f.times
+        if f.kind == "stall":
+            d["seconds"] = f.seconds
+        if f.kind == "corrupt" and f.mode != "flip":
+            d["mode"] = f.mode
+        faults.append(d)
+    return {"name": plan.name, "faults": faults}
+
+
+def load(path: str) -> FaultPlan:
+    with open(path) as fh:
+        return from_dict(json.load(fh))
+
+
+def save(path: str, plan: FaultPlan) -> None:
+    with open(path, "w") as fh:
+        json.dump(to_dict(plan), fh, indent=2)
+        fh.write("\n")
+
+
+def corrupt_file(path: str, mode: str = "flip") -> None:
+    """Deterministically damage ``path``: ``flip`` inverts 64 bytes at
+    the middle of the file (data-region damage the content digest
+    catches even when the zip directory survives); ``truncate`` keeps
+    the first half (torn-file damage the zip reader catches)."""
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as fh:
+            fh.truncate(max(1, size // 2))
+        return
+    with open(path, "r+b") as fh:
+        fh.seek(size // 2)
+        chunk = fh.read(min(64, max(1, size - size // 2)))
+        fh.seek(size // 2)
+        fh.write(bytes(b ^ 0xFF for b in chunk))
+
+
+class ChaosInjector:
+    """Fires a plan's faults from the engine's execution seam.
+
+    One injector instance spans one supervised campaign INCLUDING its
+    recoveries: consumed ``times`` stay consumed across engine restarts,
+    which is what makes "inject one fatal fault, recover, complete"
+    deterministic.  ``fired`` records every injection (kind, round,
+    phase) for tests and the supervisor's stats block.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._remaining = [f.times for f in plan.faults]
+        self.fired = []
+
+    def _consume(self, i, fault, lo, hi):
+        if self._remaining[i] > 0:
+            self._remaining[i] -= 1
+        self.fired.append(
+            {"kind": fault.kind, "phase": fault.phase, "round": fault.round,
+             "window": [lo, hi]}
+        )
+        obs.instant(
+            "fault_injected", kind=fault.kind, phase=fault.phase,
+            round=fault.round, lo=lo, hi=hi,
+        )
+        obs.default_registry().counter("chaos_injected_total").inc()
+        _metrics.emit(
+            {
+                "event": "fault_injected",
+                "v": _metrics.SCHEMA_VERSION,
+                "plan": self.plan.name,
+                "kind": fault.kind,
+                "phase": fault.phase,
+                "round": fault.round,
+            }
+        )
+
+    def fire(self, call, phase, lo, hi):
+        """The seam body: inject any due faults for rounds ``[lo, hi)``
+        at ``phase``, then run the real operation.
+
+        Raising kinds fire BEFORE ``call`` so the donated carry is never
+        consumed by an injected failure — the supervisor's in-place
+        retry of the same zero-arg ``call`` is then bit-exact.
+        """
+        for i, f in enumerate(self.plan.faults):
+            if f.phase != phase or not lo <= f.round < hi:
+                continue
+            if self._remaining[i] == 0:
+                continue
+            self._consume(i, f, lo, hi)
+            if f.kind == "stall":
+                time.sleep(f.seconds)
+                continue
+            if f.kind == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            raise _RAISES[f.kind](
+                f"injected {f.kind} fault at rounds [{lo}, {hi}) "
+                f"(plan {self.plan.name!r}"
+                + (", RESOURCE_EXHAUSTED: Out of memory)"
+                   if f.kind == "oom" else ")")
+            )
+        return call()
+
+    def after_checkpoint(self, round_cursor, path):
+        """The checkpoint hook: corrupt a just-written checkpoint whose
+        round window reached the fault's round."""
+        for i, f in enumerate(self.plan.faults):
+            if f.kind != "corrupt" or self._remaining[i] == 0:
+                continue
+            if round_cursor < f.round:
+                continue
+            self._consume(i, f, round_cursor, round_cursor)
+            corrupt_file(path, f.mode)
+
+
+def _check_plan(path: str) -> str:
+    plan = load(path)
+    doc = to_dict(plan)
+    if to_dict(from_dict(json.loads(json.dumps(doc)))) != doc:
+        raise FaultPlanError("to_dict/from_dict round-trip drifted")
+    kinds = {}
+    for f in plan.faults:
+        kinds[f.kind] = kinds.get(f.kind, 0) + 1
+    summary = ", ".join(f"{k}x{v}" for k, v in sorted(kinds.items()))
+    return (
+        f"{path}: OK — {plan.name!r}, {len(plan.faults)} fault(s)"
+        + (f" ({summary})" if summary else "")
+    )
+
+
+def main(argv) -> int:
+    if not argv:
+        print(
+            "usage: python -m ba_tpu.runtime.chaos <plan.json> ...",
+            file=sys.stderr,
+        )
+        return 2
+    for path in argv:
+        try:
+            print(_check_plan(path))
+        except (OSError, ValueError) as e:  # FaultPlanError is a ValueError
+            print(f"{path}: FAIL — {e}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

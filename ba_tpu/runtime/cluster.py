@@ -295,7 +295,15 @@ class Cluster:
         )
         return res, counts, stats
 
-    def run_scenario(self, spec, checkpoint_every=None, checkpoint_path=None):
+    def run_scenario(
+        self,
+        spec,
+        checkpoint_every=None,
+        checkpoint_path=None,
+        checkpoint_keep_last=None,
+        supervise=False,
+        fault_plan=None,
+    ):
         """Run a declarative scenario campaign (ba_tpu.scenario) on this
         cluster: the whole ``g-kill``/``g-add``/``g-state`` REPL session
         the spec encodes, executed as ONE pipelined device run.
@@ -305,7 +313,13 @@ class Cluster:
         donated carry serializes to the repo's single checkpoint format
         (``utils/snapshot.py``), so a long-lived campaign survives its
         process and resumes bit-exactly
-        (``pipeline_sweep(resume=path)``).
+        (``pipeline_sweep(resume=path)``).  ``supervise=True`` (ISSUE 7)
+        runs the campaign under the resilient execution supervisor —
+        watchdogged retires, transient retry, automatic checkpoint
+        recovery, OOM degradation — and ``fault_plan`` injects
+        deterministic chaos faults for drills (requires supervision);
+        the supervisor's stats block lands in the ``scenario_campaign``
+        record.
 
         The backend (``run_scenario``) compiles the spec against the
         current roster and drives the mutating megastep; afterwards the
@@ -338,6 +352,9 @@ class Cluster:
                 spec,
                 checkpoint_every=checkpoint_every,
                 checkpoint_path=checkpoint_path,
+                checkpoint_keep_last=checkpoint_keep_last,
+                supervise=supervise,
+                fault_plan=fault_plan,
             )
         if res is None:
             return None
@@ -381,6 +398,13 @@ class Cluster:
                 "n": len(self.generals),
                 "dispatches": res["stats"]["dispatches"],
                 "checkpoints": res["stats"].get("checkpoints", 0),
+                # Present only on supervised campaigns: the supervisor's
+                # attempts/retries/recoveries/degrades/stalls block.
+                **(
+                    {"supervisor": res["stats"]["supervisor"]}
+                    if "supervisor" in res["stats"]
+                    else {}
+                ),
             }
         )
         return counts, res

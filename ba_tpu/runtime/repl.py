@@ -26,7 +26,10 @@ stay byte-identical):
   the decision tally and the on-device scenario counters (incl. IC1/IC2
   verdicts), then leaves the roster in the campaign's final state — the
   whole ``g-kill``/``g-state`` session the spec encodes, as one device
-  run.
+  run.  ``scenario <file> <ckpt-path> <every>`` checkpoints the carry;
+  a trailing ``supervise`` token runs the campaign under the resilient
+  execution supervisor (``runtime/supervisor.py``: watchdog, transient
+  retry, automatic checkpoint recovery) and prints its stats line.
 - ``stats`` — dump the observability registry (``ba_tpu.obs``) as
   Prometheus-style text: round wall-time histogram, pipeline dispatch /
   retire latencies and depth occupancy, election and failover counters.
@@ -46,6 +49,7 @@ from __future__ import annotations
 
 from ba_tpu import obs
 from ba_tpu.runtime.cluster import Cluster
+from ba_tpu.runtime.supervisor import SupervisorError
 from ba_tpu.scenario import spec as scenario_spec
 
 
@@ -136,8 +140,15 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
         # campaign survives the REPL process and resumes bit-exactly.
         # The reference-exact `line.split(" ")` keeps empty tokens, so a
         # trailing space would otherwise read as an (empty) checkpoint
-        # path and abort the command — drop them here, locally.
+        # path and abort the command — drop them here, locally.  A
+        # trailing `supervise` token (ISSUE 7) runs the campaign under
+        # the resilient execution supervisor (watchdog, transient retry,
+        # automatic checkpoint recovery).
         args = [t for t in cmd[1:] if t]
+        supervise = False
+        if args and args[-1] == "supervise":
+            supervise = True
+            args = args[:-1]
         if not args:
             return True
         ck_path = ck_every = None
@@ -145,14 +156,16 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             # A path without <every> would silently run uncheckpointed —
             # and the user would only find out at resume time.
             out("scenario error: checkpoint path given without <every> "
-                "(usage: scenario <file> [<ckpt-path> <every>])")
+                "(usage: scenario <file> [<ckpt-path> <every>] "
+                "[supervise])")
             return True
         if len(args) > 3:
             # Like the path-without-<every> case: extra tokens mean the
             # user expected something this command does not do — refuse
             # loudly rather than silently dropping them.
             out("scenario error: too many arguments "
-                "(usage: scenario <file> [<ckpt-path> <every>])")
+                "(usage: scenario <file> [<ckpt-path> <every>] "
+                "[supervise])")
             return True
         if len(args) == 3:
             ck_path = args[1]
@@ -172,13 +185,17 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             return True
         try:
             ran = cluster.run_scenario(
-                spec, checkpoint_every=ck_every, checkpoint_path=ck_path
+                spec, checkpoint_every=ck_every, checkpoint_path=ck_path,
+                supervise=supervise,
             )
-        except (OSError, ValueError) as e:
+        except (OSError, ValueError, SupervisorError) as e:
             # ValueError: e.g. the spec names ids not in the roster.
             # OSError: an unwritable checkpoint path surfaces from the
             # engine's mid-campaign write — one error line, not a dead
             # REPL (and a dead campaign carry with it).
+            # SupervisorError: a supervised campaign exhausted its
+            # retry/recovery budgets (or quarantined a poisoned window)
+            # — the diagnosis IS the message.
             out(f"scenario error: {e}")
             return True
         if ran is None:
@@ -197,6 +214,13 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             out(
                 f"Scenario checkpoints: "
                 f"{res['stats'].get('checkpoints', 0)} -> {ck_path}"
+            )
+        if supervise:
+            sup = res["stats"]["supervisor"]
+            out(
+                f"Scenario supervisor: attempts={sup['attempts']}, "
+                f"retries={sup['retries']}, "
+                f"recoveries={sup['recoveries']}, stalls={sup['stalls']}"
             )
 
     elif command == "g-state":

@@ -37,6 +37,7 @@ module's reader is numpy + stdlib only — jax appears only inside
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import zipfile
@@ -53,11 +54,45 @@ CARRY_STATE_FIELDS = ("order", "leader", "faulty", "alive", "ids")
 CARRY_SCHED_FIELDS = ("key_data", "counter")
 
 
-def _atomic_write(path: str, write_fn) -> None:
+def _atomic_write(path: str, write_fn, durable: bool = True) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         write_fn(tmp)
+        # fsync BEFORE the rename: os.replace is atomic against other
+        # processes (a reader sees old-or-new, never a torn file — the
+        # mid-write SIGKILL test pins it), but only the fsync makes the
+        # rename crash-durable against a whole-SYSTEM crash: without it
+        # the journal may commit the rename before the data blocks, and
+        # the "complete" file after power loss reads as garbage.
+        # ``durable=False`` skips it for DERIVED data (the supervisor's
+        # rows sidecars): a reader still never sees a torn file, and a
+        # power-loss-garbled sidecar is detected by its own schema check
+        # and costs only assembled history, never the resume.
+        if durable:
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
         os.replace(tmp, path)
+        if durable:
+            # The rename itself lives in the DIRECTORY: without fsyncing
+            # the parent, power loss can forget the new name even though
+            # the data blocks are safe — and a just-pruned older
+            # checkpoint may be the one that survived.  Best-effort:
+            # platforms that refuse directory fds degrade to the
+            # pre-fsync guarantee instead of failing the write.
+            try:
+                dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+            except OSError:
+                pass
+            else:
+                try:
+                    os.fsync(dfd)
+                except OSError:
+                    pass
+                finally:
+                    os.close(dfd)
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)
@@ -162,18 +197,42 @@ def restore_cluster(path: str, cluster) -> None:
 # -- carry checkpoints (the pipelined engine's donated carry, durable) --------
 
 
+def content_digest(arrays: dict) -> str:
+    """sha256 over every array's name, dtype, shape and raw bytes.
+
+    The end-to-end integrity check for carry checkpoints (ISSUE 7): zip
+    CRCs only cover what the zip reader happens to decompress, while
+    this digest is recomputed by ``read_carry_checkpoint`` over the
+    arrays as loaded — any silent corruption between writer and reader
+    (bit rot, a chaos-injected flip, a buggy transfer) fails validation
+    instead of resuming a subtly wrong campaign.
+    """
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(np.asarray(arrays[name]))
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
 def write_carry_checkpoint(path: str, arrays: dict, meta: dict) -> None:
     """Host arrays + JSON-able meta -> one atomic versioned ``.npz``.
 
     ``arrays`` must already be host numpy (the engine fetches the carry
     copy inside its existing retire sync — no device handles reach this
-    layer).  ``meta`` is stamped with the format/version keys and stored
-    as the ``__meta__`` entry (a unicode scalar: loads without pickle).
+    layer).  ``meta`` is stamped with the format/version keys plus the
+    ``sha256`` content digest (:func:`content_digest`) and stored as the
+    ``__meta__`` entry (a unicode scalar: loads without pickle).
     """
     meta = {
         "format": CARRY_CHECKPOINT_FORMAT,
         "v": CARRY_CHECKPOINT_VERSION,
         **meta,
+        # Last so caller meta can never mask it: the digest is computed,
+        # not declared.
+        "sha256": content_digest(arrays),
     }
 
     def write(tmp):
@@ -223,6 +282,19 @@ def read_carry_checkpoint(path: str):
             f"{path!r}: carry checkpoint version {meta.get('v')!r} "
             f"(this build reads v{CARRY_CHECKPOINT_VERSION})"
         )
+    want_digest = meta.get("sha256")
+    if want_digest is not None:
+        # End-to-end integrity (ISSUE 7): recompute the content digest
+        # over the arrays as LOADED.  Verified when present so pre-digest
+        # checkpoints still read; every checkpoint this build writes
+        # carries one.
+        got = content_digest(fields)
+        if got != want_digest:
+            raise ValueError(
+                f"{path!r}: content digest mismatch (stored "
+                f"{want_digest[:12]}..., recomputed {got[:12]}...) — the "
+                f"checkpoint is corrupt; refusing to resume from it"
+            )
     missing = [
         k for k in CARRY_STATE_FIELDS + CARRY_SCHED_FIELDS if k not in fields
     ]
@@ -278,3 +350,155 @@ def validate_carry_checkpoint(path: str) -> dict:
     """
     meta, _ = read_carry_checkpoint(path)
     return meta
+
+
+# -- checkpoint retention + recovery scanning (ISSUE 7) -----------------------
+#
+# A ``{round}``-templated checkpoint path names a FAMILY of files; the
+# helpers below are the numpy/stdlib-only machinery the engine's
+# ``checkpoint_keep_last=`` retention and the execution supervisor's
+# automatic recovery share: enumerate the family, prune it, and find the
+# newest member that still validates — quarantining corrupt ones to
+# ``<path>.corrupt`` so a damaged file is diagnosed once instead of
+# blocking every future resume scan.
+
+# Sidecar suffixes that travel with a checkpoint (the supervisor's
+# campaign-history rows ride next to the carry): retention and
+# quarantine move/remove them together with their checkpoint.
+CHECKPOINT_COMPANION_SUFFIXES = (".rows.npz",)
+
+
+def checkpoint_paths(template: str) -> list:
+    """All on-disk checkpoints of a ``{round}``-templated path, as
+    ``[(round, path)]`` sorted oldest-first by round cursor.
+
+    Matching is purely lexical (the basename's ``{round}`` slot must be
+    digits), so ``.tmp.<pid>`` strays from killed writers and
+    ``.corrupt`` quarantines never appear in the family.
+    """
+    if "{round}" not in template:
+        raise ValueError(f"checkpoint template {template!r} has no {{round}}")
+    dirname, base = os.path.split(template)
+    if "{round}" in dirname:
+        raise ValueError(
+            f"{{round}} must be in the filename, not the directory "
+            f"({template!r})"
+        )
+    prefix, suffix = base.split("{round}", 1)
+    out = []
+    try:
+        names = os.listdir(dirname or ".")
+    except OSError:
+        return []
+    for name in names:
+        if not (name.startswith(prefix) and name.endswith(suffix)):
+            continue
+        mid = name[len(prefix):len(name) - len(suffix)]
+        if mid.isdigit():
+            out.append((int(mid), os.path.join(dirname, name)))
+    out.sort()
+    return out
+
+
+def _remove_companions(path: str) -> None:
+    for suffix in CHECKPOINT_COMPANION_SUFFIXES:
+        side = path + suffix
+        if os.path.exists(side):
+            try:
+                os.remove(side)
+            except OSError:
+                pass
+
+
+def prune_checkpoints(
+    template: str, keep_last: int, companions: bool = True
+) -> list:
+    """Delete all but the ``keep_last`` newest checkpoints of a
+    ``{round}``-templated family (companion sidecars go with them unless
+    ``companions=False`` — the execution supervisor keeps its rows
+    sidecars: they ARE the campaign history, O(R) total by design,
+    while the carry checkpoints they ride beside are point-in-time and
+    safely bounded).  Returns the removed paths.  Never raises on a
+    racing writer/reader — retention is hygiene, not correctness.
+    """
+    if keep_last < 1:
+        raise ValueError(f"keep_last={keep_last} must be >= 1")
+    removed = []
+    for _, path in checkpoint_paths(template)[:-keep_last]:
+        try:
+            os.remove(path)
+        except OSError:
+            continue
+        if companions:
+            _remove_companions(path)
+        removed.append(path)
+    return removed
+
+
+def quarantine_checkpoint(path: str) -> str:
+    """Move a corrupt checkpoint (and companions) to ``<path>.corrupt``.
+
+    ``os.replace`` so a half-quarantined state cannot exist; the renamed
+    file keeps its bytes for post-mortem.  Returns the quarantine path.
+    """
+    target = path + ".corrupt"
+    os.replace(path, target)
+    for suffix in CHECKPOINT_COMPANION_SUFFIXES:
+        side = path + suffix
+        if os.path.exists(side):
+            try:
+                os.replace(side, side + ".corrupt")
+            except OSError:
+                pass
+    return target
+
+
+def newest_valid_checkpoint(
+    path_or_template: str,
+    quarantine: bool = True,
+    below: int | None = None,
+    accept=None,
+):
+    """The newest checkpoint that passes full schema+digest validation.
+
+    Scans a ``{round}``-templated family newest-first (a plain path is a
+    family of one); each member that fails :func:`read_carry_checkpoint`
+    is quarantined to ``<path>.corrupt`` (when ``quarantine``) and the
+    scan FALLS BACK to the next-newest instead of failing — the recovery
+    contract: one torn or rotten file costs one checkpoint interval, not
+    the campaign.  ``below`` skips members at round cursors >= it
+    WITHOUT quarantining (they are valid, just not resumable — the
+    engine refuses a cursor at the campaign end, and a completed
+    campaign's final checkpoint must not poison its own rerun).
+    ``accept(meta) -> bool`` skips non-matching members the same way —
+    valid-but-not-ours (the supervisor's campaign-fingerprint filter),
+    so a foreign family sharing the path is stepped over, never
+    quarantined.  Returns ``(path, meta)`` or ``None`` when nothing
+    valid remains.
+    """
+    if "{round}" in path_or_template:
+        members = checkpoint_paths(path_or_template)
+        if below is not None:
+            members = [(r, p) for r, p in members if r < below]
+        candidates = [p for _, p in reversed(members)]
+    else:
+        candidates = [path_or_template] if os.path.exists(path_or_template) else []
+    for path in candidates:
+        try:
+            meta = validate_carry_checkpoint(path)
+        except (OSError, ValueError):
+            if quarantine:
+                try:
+                    quarantine_checkpoint(path)
+                except OSError:
+                    pass
+            continue
+        if below is not None and meta.get("round", 0) >= below:
+            # Valid but at/after the cut (a plain path, or a templated
+            # member whose filename lied about its cursor): skip, never
+            # quarantine.
+            continue
+        if accept is not None and not accept(meta):
+            continue
+        return path, meta
+    return None

@@ -9,8 +9,10 @@ records), a registry ``metrics_snapshot``, and (ISSUE 4) the DEVICE
 tier: two tiny ``pipeline_sweep`` runs on the CPU backend at different
 capacities drive the real ``compiled_artifact`` (obs/xla.py AOT
 introspection) and ``recompile`` (obs/instrument.py explainer) emitters
-— into a temp sink, then validates every line, including the typed
-shape of the two device-tier records.  Run by ``scripts/ci.sh`` before
+— plus (ISSUE 7) a tiny supervised run with a chaos plan driving the
+real ``fault_injected`` and ``recovery`` emitters — into a temp sink,
+then validates every line, including the typed shape of the
+device-tier and resilience records.  Run by ``scripts/ci.sh`` before
 the tier-1 suite; standalone: ``JAX_PLATFORMS=cpu python
 scripts/check_metrics_schema.py``.
 """
@@ -72,6 +74,27 @@ def main() -> int:
             scenario=compile_scenario(spec, 4, 4, sparse=True),
             rounds_per_dispatch=2, checkpoint_every=2,
             checkpoint_path=ck_path,
+        )
+        # Resilience records (ISSUE 7): a tiny supervised run with a
+        # chaos plan drives the real fault_injected (chaos.py) and
+        # recovery (supervisor.py) emitters — one in-place transient
+        # retry, one fatal -> checkpoint resume.
+        from ba_tpu.runtime import chaos
+        from ba_tpu.runtime.supervisor import (
+            SupervisorConfig, supervised_sweep,
+        )
+
+        plan = chaos.from_dict(
+            {"name": "ci-chaos", "faults": [
+                {"round": 0, "kind": "transient"},
+                {"round": 2, "kind": "fatal"},
+            ]}
+        )
+        supervised_sweep(
+            jr.key(6), make_sweep_state(jr.key(7), 4, 4), 4,
+            rounds_per_dispatch=2, chaos=plan,
+            checkpoint_every=2, checkpoint_path=path + ".sup_{round}.npz",
+            config=SupervisorConfig(timeout_s=60.0, backoff_base_s=0.0),
         )
         obs.default_registry().emit_snapshot(sink=sink, source="ci-check")
         sink.close()
@@ -138,6 +161,36 @@ def main() -> int:
                         file=sys.stderr,
                     )
                     bad += 1
+            elif rec.get("event") == "recovery":
+                if not (
+                    rec.get("fault") in ("transient", "fatal", "oom")
+                    and rec.get("action") in (
+                        "resume", "degrade", "quarantine"
+                    )
+                    and isinstance(rec.get("attempt"), int)
+                    and isinstance(rec.get("from_round"), int)
+                    and isinstance(rec.get("lost_rounds"), int)
+                    and isinstance(rec.get("error"), str)
+                ):
+                    print(
+                        f"schema check: line {i} malformed recovery: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "fault_injected":
+                if not (
+                    isinstance(rec.get("plan"), str)
+                    and rec.get("kind") in chaos.FAULT_KINDS
+                    and rec.get("phase") in chaos.FAULT_PHASES
+                    and isinstance(rec.get("round"), int)
+                ):
+                    print(
+                        f"schema check: line {i} malformed "
+                        f"fault_injected: {line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
             elif rec.get("event") == "scenario_checkpoint":
                 if not (
                     isinstance(rec.get("round"), int)
@@ -158,6 +211,8 @@ def main() -> int:
             "compiled_artifact",
             "recompile",
             "scenario_checkpoint",
+            "recovery",
+            "fault_injected",
         }
         if not want <= events:
             print(
@@ -174,6 +229,10 @@ def main() -> int:
         os.unlink(path)
         if os.path.exists(path + ".carry.npz"):
             os.unlink(path + ".carry.npz")
+        import glob
+
+        for stray in glob.glob(path + ".sup_*"):
+            os.unlink(stray)
 
 
 if __name__ == "__main__":

@@ -145,6 +145,26 @@ if ! python -m ba_tpu.scenario examples/scenarios/*.json; then
     exit 1
 fi
 
+echo "== chaos smoke: fault plans + fast fault-injection tests =="
+# ISSUE 7: the committed fault plans must load, eagerly validate, and
+# round-trip exactly through to_dict/from_dict — `python -m
+# ba_tpu.runtime.chaos` is jax-free by construction (pinned by
+# tests/test_supervisor.py::test_chaos_cli_jax_free_subprocess), so
+# this mirrors the scenario stage above at the same sub-second cost.
+if ! python -m ba_tpu.runtime.chaos examples/faults/*.json; then
+    echo "fault plan validation failed" >&2
+    exit 1
+fi
+# The fast fault-injection unit layer (classification, backoff jitter,
+# watchdog derivation, plan grammar) — seconds, no engine runs; the
+# full supervised-parity / kill-recovery tests run in tier-1 below.
+if ! JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q \
+        -k "classify or backoff or derive_timeout or fault_plan or chaos_cli" \
+        -p no:cacheprovider; then
+    echo "chaos smoke tests failed" >&2
+    exit 1
+fi
+
 echo "== metrics JSONL schema check =="
 # Every record the layer emits must parse and carry event + v (schema
 # version 1) — exercised end-to-end through the real emitters.

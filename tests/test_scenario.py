@@ -1343,7 +1343,8 @@ def test_repl_scenario_command_guards(tmp_path):
         jx, f"scenario {path} ck.npz 1 500", out.append
     )
     assert out == ["scenario error: too many arguments "
-                   "(usage: scenario <file> [<ckpt-path> <every>])"]
+                   "(usage: scenario <file> [<ckpt-path> <every>] "
+                   "[supervise])"]
 
 
 def test_cluster_scenario_emits_campaign_record(tmp_path):

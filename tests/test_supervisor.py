@@ -1,0 +1,1036 @@
+"""Resilient execution supervisor tests (ISSUE 7: runtime/supervisor.py,
+runtime/chaos.py, the pipeline's resilience seams, and the checkpoint
+integrity/retention machinery in utils/snapshot.py).
+
+The contracts, each pinned independently:
+
+1. **Supervised parity** — a supervised campaign, with or without
+   injected faults (transient retries, a fatal mid-campaign fault, an
+   OOM degrade, a corrupt checkpoint, a process kill), produces
+   decisions, leaders and every counter block bit-identical to the
+   uninterrupted unsupervised run.
+2. **Zero added sync** — the no-blocking dispatch-count proof re-runs
+   under FULL supervision (watchdog armed, seam installed, rows
+   collection + checkpointing live) with an unchanged schedule and
+   ``jax.block_until_ready`` monkeypatched to raise.
+3. **Checkpoint integrity** — the sha256 content digest rejects silent
+   corruption, ``keep_last`` retention prunes families, corrupt files
+   quarantine to ``.corrupt`` and recovery falls back to the next-newest
+   valid checkpoint, and a REAL mid-write ``SIGKILL`` never leaves a
+   half-written file a reader can see.
+4. **FaultPlan** — JSON round-trip exactness, eager validation, and the
+   jax-free ``python -m ba_tpu.runtime.chaos`` CLI.
+"""
+
+import dataclasses
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.random as jr
+import numpy as np
+import pytest
+
+from ba_tpu.core.types import ATTACK
+from ba_tpu.parallel import make_sweep_state, pipeline_sweep
+from ba_tpu.parallel.pipeline import fresh_copy as _fresh
+from ba_tpu.runtime import chaos
+from ba_tpu.runtime.supervisor import (
+    PoisonousWindow,
+    SupervisorConfig,
+    backoff_s,
+    classify_fault,
+    derive_timeout_s,
+    supervised_sweep,
+)
+from ba_tpu.scenario import compile_scenario, from_dict
+from ba_tpu.utils import snapshot
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _campaign_setup(R=12):
+    """A churny scenario campaign: kills, fault flips, a strategy, a
+    revive — every counter has something to count."""
+    B, cap = 16, 8
+    key = jr.key(91)
+    state = make_sweep_state(jr.key(90), B, cap, order=ATTACK)
+    state = dataclasses.replace(
+        state, faulty=state.faulty.at[: B // 2, 0].set(True)
+    )
+    spec = from_dict(
+        {
+            "name": "supervised-campaign",
+            "rounds": R,
+            "order": "attack",
+            "events": [
+                e
+                for e in [
+                    {"round": 2, "kill": [1]},
+                    {"round": 5, "set_faulty": [3], "value": True},
+                    {"round": 6, "set_strategy": [3],
+                     "value": "adaptive_split"},
+                    {"round": 9, "revive": [1]},
+                ]
+                if e["round"] < R
+            ],
+        }
+    )
+    return key, state, compile_scenario(spec, B, cap, sparse=True)
+
+
+def _baseline(key, state, block, R, **kw):
+    return pipeline_sweep(
+        key, _fresh(state), R, scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, **kw,
+    )
+
+
+def _assert_bit_identical(got, want):
+    np.testing.assert_array_equal(got["decisions"], want["decisions"])
+    np.testing.assert_array_equal(got["leaders"], want["leaders"])
+    np.testing.assert_array_equal(
+        got["counters_per_round"], want["counters_per_round"]
+    )
+    np.testing.assert_array_equal(got["histograms"], want["histograms"])
+    assert got["counters"] == want["counters"]
+
+
+# -- fault classification + backoff + timeout ---------------------------------
+
+
+def test_classify_fault_duck_marker_wins():
+    assert classify_fault(chaos.InjectedTransient("x")) == "transient"
+    assert classify_fault(chaos.InjectedFatal("x")) == "fatal"
+    assert classify_fault(chaos.InjectedOOM("x")) == "oom"
+
+
+def test_classify_fault_message_markers():
+    assert classify_fault(RuntimeError("UNAVAILABLE: socket closed")) == (
+        "transient"
+    )
+    assert classify_fault(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 1GB")
+    ) == "oom"
+    # OOM beats the transient envelope it often travels in.
+    assert classify_fault(
+        RuntimeError("ABORTED: Allocation failure on device")
+    ) == "oom"
+    assert classify_fault(RuntimeError("something else broke")) == "fatal"
+    assert classify_fault(ValueError("bad shape")) == "fatal"
+
+
+def test_backoff_deterministic_and_bounded():
+    cfg = SupervisorConfig(
+        backoff_base_s=0.1, backoff_factor=2.0, backoff_max_s=1.0,
+        jitter_frac=0.25, seed=3,
+    )
+    a = backoff_s(cfg, 1, "dispatch:4")
+    assert a == backoff_s(cfg, 1, "dispatch:4")  # same site: same delay
+    assert a != backoff_s(cfg, 1, "retire:4")    # different site: different
+    assert a != backoff_s(cfg, 2, "dispatch:4")  # different attempt too
+    for attempt in range(1, 8):
+        for token in ("dispatch:0", "retire:6", "recover:12"):
+            d = backoff_s(cfg, attempt, token)
+            raw = min(0.1 * 2.0 ** (attempt - 1), 1.0)
+            assert 0.0 <= d <= raw * 1.25
+    with pytest.raises(ValueError):
+        backoff_s(cfg, 0, "x")
+
+
+def test_derive_timeout_pins_and_floor(monkeypatch):
+    monkeypatch.delenv("BA_TPU_SUPERVISE_TIMEOUT_S", raising=False)
+    assert derive_timeout_s(SupervisorConfig(timeout_s=7.5)) == 7.5
+    monkeypatch.setenv("BA_TPU_SUPERVISE_TIMEOUT_S", "12.5")
+    assert derive_timeout_s(SupervisorConfig()) == 12.5
+    monkeypatch.delenv("BA_TPU_SUPERVISE_TIMEOUT_S")
+    # Empty registry histogram: the floor.
+    from ba_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    cfg = SupervisorConfig(timeout_floor_s=30.0, timeout_multiplier=16.0)
+    assert derive_timeout_s(cfg, registry=reg) == 30.0
+    # A populated histogram: multiplier x the worst observed latency.
+    reg.histogram("pipeline_dispatch_latency_s").record(4.0)
+    assert derive_timeout_s(cfg, registry=reg) == 64.0
+
+
+# -- FaultPlan grammar + CLI --------------------------------------------------
+
+
+def test_fault_plan_round_trip_exact():
+    for path in sorted((REPO / "examples" / "faults").glob("*.json")):
+        doc = json.loads(path.read_text())
+        assert chaos.to_dict(chaos.from_dict(doc)) == doc, path
+
+
+def test_fault_plan_validation_errors():
+    bad = [
+        {"faults": []},                                      # no name
+        {"name": "x", "faults": [{"round": 0, "kind": "nope"}]},
+        {"name": "x", "faults": [{"round": -1, "kind": "fatal"}]},
+        {"name": "x", "faults": [{"round": 0, "kind": "fatal",
+                                  "phase": "checkpoint"}]},
+        {"name": "x", "faults": [{"round": 0, "kind": "corrupt",
+                                  "phase": "dispatch"}]},
+        {"name": "x", "faults": [{"round": 0, "kind": "stall"}]},  # no secs
+        {"name": "x", "faults": [{"round": 0, "kind": "fatal",
+                                  "seconds": 1.0}]},
+        {"name": "x", "faults": [{"round": 0, "kind": "fatal",
+                                  "times": 0}]},
+        {"name": "x", "faults": [{"round": 0, "kind": "fatal",
+                                  "bogus": 1}]},
+        {"name": "x", "extra": 1, "faults": []},
+    ]
+    for doc in bad:
+        with pytest.raises(chaos.FaultPlanError):
+            chaos.from_dict(doc)
+
+
+def test_chaos_cli_jax_free_subprocess():
+    # The chaos smoke stage ci.sh runs: validate every committed fault
+    # plan WITHOUT jax ever being imported.
+    code = (
+        "import sys\n"
+        "from ba_tpu.runtime.chaos import main\n"
+        "rc = main(sys.argv[1:])\n"
+        "banned = {m for m in sys.modules if m.split('.')[0] in"
+        " ('jax', 'jaxlib')}\n"
+        "assert not banned, banned\n"
+        "sys.exit(rc)\n"
+    )
+    plans = sorted(str(p) for p in (REPO / "examples" / "faults").glob("*.json"))
+    assert plans
+    proc = subprocess.run(
+        [sys.executable, "-c", code, *plans],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count(": OK") == len(plans)
+    # And a malformed plan fails with a one-line diagnosis, not a traceback.
+    proc = subprocess.run(
+        [sys.executable, "-c", code, os.devnull],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "FAIL" in proc.stderr and "Traceback" not in proc.stderr
+
+
+# -- checkpoint integrity + retention (utils/snapshot.py) ---------------------
+
+
+def _toy_checkpoint(path, round_=4, R=8):
+    """A real carry checkpoint via the engine: 4 rounds in, 8 total."""
+    key, state, block = _campaign_setup(R)
+    pipeline_sweep(
+        key, _fresh(state), R, scenario=block, rounds_per_dispatch=2,
+        checkpoint_every=round_, checkpoint_path=str(path),
+    )
+
+
+def test_content_digest_rejects_silent_flip(tmp_path):
+    path = tmp_path / "ck_{round}.npz"
+    _toy_checkpoint(path)
+    ck = tmp_path / "ck_4.npz"
+    assert ck.exists()
+    meta = snapshot.validate_carry_checkpoint(str(ck))
+    assert len(meta["sha256"]) == 64
+    chaos.corrupt_file(str(ck), "flip")
+    with pytest.raises(ValueError, match="digest|corrupt|bad|invalid"):
+        snapshot.read_carry_checkpoint(str(ck))
+
+
+def test_checkpoint_family_scan_ignores_strays(tmp_path):
+    tmpl = str(tmp_path / "ck_{round}.npz")
+    for r in (2, 4, 10):
+        (tmp_path / f"ck_{r}.npz").write_bytes(b"x")
+    (tmp_path / "ck_4.npz.tmp.123").write_bytes(b"x")
+    (tmp_path / "ck_2.npz.corrupt").write_bytes(b"x")
+    (tmp_path / "ck_nope.npz").write_bytes(b"x")
+    assert snapshot.checkpoint_paths(tmpl) == [
+        (2, str(tmp_path / "ck_2.npz")),
+        (4, str(tmp_path / "ck_4.npz")),
+        (10, str(tmp_path / "ck_10.npz")),
+    ]
+    with pytest.raises(ValueError):
+        snapshot.checkpoint_paths(str(tmp_path / "ck.npz"))
+
+
+def test_prune_keep_last_removes_sidecars_too(tmp_path):
+    tmpl = str(tmp_path / "ck_{round}.npz")
+    for r in (2, 4, 6, 8):
+        (tmp_path / f"ck_{r}.npz").write_bytes(b"x")
+        (tmp_path / f"ck_{r}.npz.rows.npz").write_bytes(b"y")
+    removed = snapshot.prune_checkpoints(tmpl, keep_last=2)
+    assert sorted(removed) == [
+        str(tmp_path / "ck_2.npz"), str(tmp_path / "ck_4.npz")
+    ]
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == [
+        "ck_6.npz", "ck_6.npz.rows.npz", "ck_8.npz", "ck_8.npz.rows.npz"
+    ]
+
+
+def test_engine_checkpoint_keep_last_retention(tmp_path):
+    R = 12
+    key, state, block = _campaign_setup(R)
+    path = str(tmp_path / "ck_{round}.npz")
+    out = pipeline_sweep(
+        key, _fresh(state), R, scenario=block, rounds_per_dispatch=2,
+        checkpoint_every=2, checkpoint_path=path, checkpoint_keep_last=2,
+    )
+    assert out["stats"]["checkpoints"] == 6
+    kept = [r for r, _ in snapshot.checkpoint_paths(path)]
+    assert kept == [10, 12]
+    # Validation: retention needs a templated path + checkpointing on.
+    with pytest.raises(ValueError):
+        pipeline_sweep(
+            key, None, R, checkpoint_keep_last=2,
+            checkpoint_every=2, checkpoint_path=str(tmp_path / "flat.npz"),
+        )
+    with pytest.raises(ValueError):
+        pipeline_sweep(key, None, R, checkpoint_keep_last=2)
+
+
+def test_newest_valid_checkpoint_quarantines_and_falls_back(tmp_path):
+    path = tmp_path / "ck_{round}.npz"
+    _toy_checkpoint(path, round_=4, R=8)  # writes ck_4 and ck_8
+    assert (tmp_path / "ck_8.npz").exists()
+    chaos.corrupt_file(str(tmp_path / "ck_8.npz"), "truncate")
+    found = snapshot.newest_valid_checkpoint(str(path))
+    assert found is not None
+    got_path, meta = found
+    assert got_path == str(tmp_path / "ck_4.npz")
+    assert meta["round"] == 4
+    # The corrupt newest was quarantined, bytes preserved for post-mortem.
+    assert not (tmp_path / "ck_8.npz").exists()
+    assert (tmp_path / "ck_8.npz.corrupt").exists()
+    # Nothing valid at all -> None.
+    chaos.corrupt_file(str(tmp_path / "ck_4.npz"), "flip")
+    assert snapshot.newest_valid_checkpoint(str(path)) is None
+
+
+def test_torn_write_sigkill_never_exposes_half_file(tmp_path):
+    # The atomic-write claim under a REAL mid-write SIGKILL: the child
+    # dies with half the npz bytes written to the .tmp staging file; the
+    # final path must never exist half-written, and the stray .tmp must
+    # not break the next write to the same path.
+    ck = tmp_path / "torn.npz"
+    child = f'''
+import io, os, signal
+import numpy as np
+from ba_tpu.utils import snapshot
+
+real_savez = np.savez
+def savez_half_then_die(fh, **kw):
+    buf = io.BytesIO()
+    real_savez(buf, **kw)
+    data = buf.getvalue()
+    fh.write(data[: len(data) // 2])
+    fh.flush()
+    os.fsync(fh.fileno())
+    os.kill(os.getpid(), signal.SIGKILL)
+
+np.savez = savez_half_then_die
+snapshot.write_carry_checkpoint(
+    {str(ck)!r},
+    {{"alive": np.ones((2, 4), bool)}},
+    {{"round": 3}},
+)
+raise SystemExit("unreachable: the writer must have died mid-write")
+'''
+    proc = subprocess.run(
+        [sys.executable, "-c", child],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stdout + proc.stderr
+    # The reader can never see a torn file: the final path simply does
+    # not exist (the rename never happened).
+    assert not ck.exists()
+    strays = list(tmp_path.glob("torn.npz.tmp.*"))
+    assert strays, "the killed writer should have left its staging file"
+    # A stray .tmp from the killed writer must not break the next write.
+    arrays = {"alive": np.ones((2, 4), bool)}
+    snapshot.write_carry_checkpoint(str(ck), arrays, {"round": 3})
+    with np.load(ck, allow_pickle=False) as data:
+        meta = json.loads(str(data["__meta__"]))
+    assert meta["round"] == 3
+    assert meta["sha256"] == snapshot.content_digest(arrays)
+
+
+# -- pipeline resilience seams ------------------------------------------------
+
+
+def test_retire_watchdog_fires_on_injected_stall():
+    R = 6
+    key, state, block = _campaign_setup(R)
+    plan = chaos.from_dict(
+        {"name": "stall", "faults": [
+            {"round": 2, "kind": "stall", "phase": "retire",
+             "seconds": 0.3},
+        ]}
+    )
+    inj = chaos.ChaosInjector(plan)
+    stalls = []
+    out = pipeline_sweep(
+        key, _fresh(state), R, scenario=block, rounds_per_dispatch=2,
+        exec_seam=lambda call, phase, d, lo, hi: inj.fire(
+            call, phase, lo, hi
+        ),
+        retire_timeout_s=0.05,
+        on_stall=lambda d, t: stalls.append((d, t)),
+    )
+    assert out["stats"]["stalls"] == 1
+    assert stalls == [(1, 0.05)]  # rounds [2,4) = dispatch 1
+    assert [f["kind"] for f in inj.fired] == ["stall"]
+    # Validation: a watchdog callback needs a timeout to arm.
+    with pytest.raises(ValueError):
+        pipeline_sweep(key, None, R, on_stall=lambda d, t: None)
+    with pytest.raises(ValueError):
+        pipeline_sweep(key, None, R, retire_timeout_s=0.0)
+
+
+def test_supervised_no_blocking_schedule_unchanged(monkeypatch, tmp_path):
+    # ISSUE 7 acceptance: the engine's only sync stays the depth-delayed
+    # retire fetch even under FULL supervision — watchdog armed, seam
+    # installed, rows collection and checkpointing live.
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    R, depth = 7, 3
+    state = make_sweep_state(jr.key(5), 8, 8)
+    events = []
+    out = supervised_sweep(
+        jr.key(23), state, R,
+        config=SupervisorConfig(timeout_s=60.0),
+        depth=depth, rounds_per_dispatch=1, with_counters=True,
+        checkpoint_every=3, checkpoint_path=str(tmp_path / "nb_{round}.npz"),
+        on_event=lambda kind, i: events.append((kind, i)),
+    )
+    dispatches = [i for kind, i in events if kind == "dispatch"]
+    retires = [i for kind, i in events if kind == "retire"]
+    assert dispatches == list(range(R))
+    assert retires == list(range(R))
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [
+        ("dispatch", i) for i in range(depth + 1)
+    ]
+    assert out["stats"]["max_in_flight"] == depth + 1
+    assert out["stats"]["stalls"] == 0
+    assert out["supervisor"]["attempts"] == 1
+    assert out["supervisor"]["retries"] == 0
+
+
+# -- supervised parity --------------------------------------------------------
+
+
+def test_supervised_clean_run_matches_unsupervised():
+    R = 12
+    key, state, block = _campaign_setup(R)
+    want = _baseline(key, state, block, R)
+    got = supervised_sweep(
+        key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, config=SupervisorConfig(timeout_s=60.0),
+    )
+    _assert_bit_identical(got, want)
+    sup = got["supervisor"]
+    assert sup["attempts"] == 1 and sup["recoveries"] == 0
+    assert sup["history_rounds"] == R
+
+
+def test_supervised_transient_storm_parity():
+    # Transient faults at both seam phases retry in place; a retire
+    # stall trips the watchdog; everything stays bit-identical.
+    R = 12
+    key, state, block = _campaign_setup(R)
+    want = _baseline(key, state, block, R)
+    plan = chaos.from_dict(
+        {"name": "storm", "faults": [
+            {"round": 2, "kind": "transient"},
+            {"round": 6, "kind": "transient", "phase": "retire",
+             "times": 2},
+            {"round": 8, "kind": "stall", "phase": "retire",
+             "seconds": 0.2},
+        ]}
+    )
+    got = supervised_sweep(
+        key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, chaos=plan,
+        config=SupervisorConfig(timeout_s=0.05, backoff_base_s=0.01),
+    )
+    _assert_bit_identical(got, want)
+    sup = got["supervisor"]
+    assert sup["attempts"] == 1 and sup["retries"] == 3
+    assert sup["stalls"] == 1 and sup["injected"] == 4
+
+
+def test_supervised_fatal_recovers_from_checkpoint_bit_exact(tmp_path):
+    R = 12
+    key, state, block = _campaign_setup(R)
+    want = _baseline(key, state, block, R)
+    plan = chaos.from_dict(
+        {"name": "fatal", "faults": [
+            {"round": 8, "kind": "fatal"},
+        ]}
+    )
+    got = supervised_sweep(
+        key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, chaos=plan,
+        checkpoint_every=4, checkpoint_path=str(tmp_path / "f_{round}.npz"),
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    _assert_bit_identical(got, want)
+    sup = got["supervisor"]
+    assert sup["attempts"] == 2 and sup["recoveries"] == 1
+    # Resumed from the round-4 checkpoint; "lost" counts only rounds
+    # whose rows had already retired past the resume point (the fault
+    # fired at the round-8 dispatch, before those retires caught up).
+    assert sup["lost_rounds"] <= 4
+    # stats["checkpoints"] spans EVERY attempt (a failed attempt's
+    # engine stats die with its exception): all three family members
+    # on disk were written by this one supervised call.
+    assert got["stats"]["checkpoints"] == len(
+        snapshot.checkpoint_paths(str(tmp_path / "f_{round}.npz"))
+    )
+
+
+def test_supervised_corrupt_checkpoint_falls_back(tmp_path):
+    # The round-4 checkpoint is chaos-corrupted as it is written; the
+    # round-8 fatal then forces recovery: the scan quarantines the
+    # rotten file (nothing older survives, so the campaign restarts
+    # from round 0) and still completes bit-identically — one rotten
+    # file costs a replay, never the campaign.
+    R = 12
+    key, state, block = _campaign_setup(R)
+    want = _baseline(key, state, block, R)
+    plan = chaos.from_dict(
+        {"name": "rot", "faults": [
+            {"round": 4, "kind": "corrupt", "mode": "flip"},
+            {"round": 8, "kind": "fatal"},
+        ]}
+    )
+    got = supervised_sweep(
+        key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, chaos=plan,
+        checkpoint_every=4, checkpoint_path=str(tmp_path / "c_{round}.npz"),
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    _assert_bit_identical(got, want)
+    sup = got["supervisor"]
+    assert sup["recoveries"] == 1
+    # The corrupt newest (and only) checkpoint was quarantined for
+    # post-mortem and attempt 2 rewrote a fresh, valid one in its place.
+    assert (tmp_path / "c_4.npz.corrupt").exists()
+    snapshot.validate_carry_checkpoint(str(tmp_path / "c_4.npz"))
+
+
+def test_supervised_oom_degrades_depth_and_completes(tmp_path):
+    R = 12
+    key, state, block = _campaign_setup(R)
+    want = _baseline(key, state, block, R)
+    plan = chaos.from_dict(
+        {"name": "oom", "faults": [{"round": 6, "kind": "oom"}]}
+    )
+    got = supervised_sweep(
+        key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, chaos=plan, depth=2,
+        checkpoint_every=4, checkpoint_path=str(tmp_path / "o_{round}.npz"),
+        config=SupervisorConfig(timeout_s=60.0, backoff_base_s=0.01),
+    )
+    _assert_bit_identical(got, want)
+    sup = got["supervisor"]
+    assert sup["degrades"] == 1
+    assert sup["depth"] == 1  # halved from 2 — a scheduling dial only
+    assert sup["recoveries"] == 0  # degrade is not a recovery
+
+
+def test_poison_window_quarantines_with_reproducer(tmp_path):
+    R = 12
+    key, state, block = _campaign_setup(R)
+    plan = chaos.from_dict(
+        {"name": "poison", "faults": [
+            {"round": 6, "kind": "fatal", "times": -1},
+        ]}
+    )
+    with pytest.raises(PoisonousWindow) as exc:
+        supervised_sweep(
+            key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+            collect_decisions=True, chaos=plan,
+            checkpoint_every=2,
+            checkpoint_path=str(tmp_path / "p_{round}.npz"),
+            config=SupervisorConfig(
+                timeout_s=60.0, poison_threshold=3, backoff_base_s=0.01,
+            ),
+        )
+    rep = exc.value.reproducer
+    assert rep["failures"] == 3 and rep["fault"] == "fatal"
+    # The window keys off the campaign's completed-rows high-water mark
+    # — STABLE across attempts because replay is bit-exact (rounds [0,2)
+    # retired before the depth-delayed schedule reached the fault).
+    assert rep["window"] == [2, 4]
+    assert rep["resume"] is not None and rep["resume"].endswith("p_2.npz")
+    on_disk = json.loads((tmp_path / "poison_2.json").read_text())
+    assert on_disk["window"] == rep["window"]
+    assert on_disk["hint"]
+
+
+def test_supervised_kill_and_rerun_completes_bit_exact(tmp_path):
+    # THE acceptance criterion: a mid-campaign SIGKILL (the real
+    # preemption, injected by the chaos plan) kills the child process;
+    # rerunning the SAME supervised call picks the campaign up from the
+    # newest checkpoint (resume="auto") and the assembled result —
+    # decisions, leaders, every counter block — is bit-identical to the
+    # uninterrupted run.
+    R = 12
+    key, state, block = _campaign_setup(R)
+    want = _baseline(key, state, block, R)
+    ck = tmp_path / "kill_{round}.npz"
+    child = f'''
+import dataclasses, jax.random as jr
+from ba_tpu.parallel import make_sweep_state
+from ba_tpu.runtime import chaos
+from ba_tpu.runtime.supervisor import SupervisorConfig, supervised_sweep
+from ba_tpu.scenario import compile_scenario, from_dict
+
+key = jr.key(91)
+state = make_sweep_state(jr.key(90), 16, 8, order=1)
+state = dataclasses.replace(
+    state, faulty=state.faulty.at[:8, 0].set(True)
+)
+spec = from_dict({{
+    "name": "supervised-campaign", "rounds": {R}, "order": "attack",
+    "events": [
+        {{"round": 2, "kill": [1]}},
+        {{"round": 5, "set_faulty": [3], "value": True}},
+        {{"round": 6, "set_strategy": [3], "value": "adaptive_split"}},
+        {{"round": 9, "revive": [1]}},
+    ],
+}})
+block = compile_scenario(spec, 16, 8, sparse=True)
+plan = chaos.from_dict({{
+    "name": "mid-kill",
+    "faults": [{{"round": 10, "kind": "kill"}}],
+}})
+supervised_sweep(
+    key, state, scenario=block, rounds_per_dispatch=2,
+    collect_decisions=True, chaos=plan,
+    checkpoint_every=4, checkpoint_path={str(ck)!r},
+    checkpoint_keep_last=1,
+    config=SupervisorConfig(timeout_s=60.0),
+)
+raise SystemExit("unreachable: the kill fault must have fired")
+'''
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", child],
+        capture_output=True, text=True, cwd=str(REPO), timeout=600, env=env,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stdout + proc.stderr
+    # The child got the round-4 checkpoint out before dying (the kill
+    # fires at the [10, 12) dispatch, BEFORE the depth-delayed retire
+    # that would have written the round-8 checkpoint), and
+    # checkpoint_keep_last=1 retention kept only the newest CARRY —
+    # but every rows sidecar survives (supervisor-owned retention is
+    # sidecar-preserving: the sidecars ARE the campaign history).
+    assert (tmp_path / "kill_4.npz").exists()
+    assert (tmp_path / "kill_4.npz.rows.npz").exists()
+    # The successor: the SAME call, no chaos — resume="auto" finds the
+    # newest valid checkpoint and merges the sidecar chain, including
+    # ORPHAN sidecars whose carry was pruned.
+    got = supervised_sweep(
+        key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True,
+        checkpoint_every=4, checkpoint_path=str(ck),
+        checkpoint_keep_last=1,
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    _assert_bit_identical(got, want)
+    sup = got["supervisor"]
+    assert sup["history_start"] == 0  # the rows sidecar restored [0, 8)
+    assert sup["attempts"] == 1
+    # Retention end-state: one carry (the final), every sidecar.
+    carries = [r for r, _ in snapshot.checkpoint_paths(str(ck))]
+    assert carries == [R]
+    side_rounds = sorted(
+        int(p.name.split("_")[1].split(".")[0])
+        for p in tmp_path.glob("kill_*.npz.rows.npz")
+    )
+    assert side_rounds == [4, 8, 12]
+
+
+def test_supervised_plain_sweep_parity_and_donation_guard():
+    # The non-scenario path: plain pipeline_sweep under supervision,
+    # with the supervisor's own engine-kwarg guard.
+    R = 6
+    key = jr.key(7)
+    state = make_sweep_state(jr.key(0), 16, 8, order=ATTACK)
+    want = pipeline_sweep(
+        key, _fresh(state), R, rounds_per_dispatch=2, collect_decisions=True
+    )
+    got = supervised_sweep(
+        key, _fresh(state), R, rounds_per_dispatch=2,
+        collect_decisions=True, config=SupervisorConfig(timeout_s=60.0),
+    )
+    np.testing.assert_array_equal(got["decisions"], want["decisions"])
+    np.testing.assert_array_equal(got["histograms"], want["histograms"])
+    with pytest.raises(ValueError, match="owned by the supervisor"):
+        supervised_sweep(key, None, R, exec_seam=lambda *a: None)
+    with pytest.raises(ValueError, match="rounds"):
+        supervised_sweep(key, None)
+
+
+def test_rerun_after_completion_replays_last_window(tmp_path):
+    # A COMPLETED campaign's final checkpoint (round == rounds) is valid
+    # but not resumable; rerunning the same supervised call must pick
+    # the previous checkpoint (below=rounds), replay the last window and
+    # return the full bit-identical result — NOT poison itself retrying
+    # the final checkpoint the engine refuses.
+    R = 8
+    key, state, block = _campaign_setup(R)
+    want = _baseline(key, state, block, R)
+    ck = str(tmp_path / "done_{round}.npz")
+    kw = dict(
+        scenario=block, rounds_per_dispatch=2, collect_decisions=True,
+        checkpoint_every=4, checkpoint_path=ck,
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    first = supervised_sweep(key, _fresh(state), **kw)
+    _assert_bit_identical(first, want)
+    assert (tmp_path / "done_8.npz").exists()
+    again = supervised_sweep(key, _fresh(state), **kw)
+    _assert_bit_identical(again, want)
+    assert again["supervisor"]["attempts"] == 1
+    assert again["supervisor"]["history_start"] == 0
+    # The final checkpoint was skipped, never quarantined.
+    assert (tmp_path / "done_8.npz").exists()
+    assert not (tmp_path / "done_8.npz.corrupt").exists()
+
+
+def test_auto_resume_refuses_foreign_campaign(tmp_path):
+    # A checkpoint family left behind by a DIFFERENT campaign at the
+    # same path must refuse loudly (campaign_sha256 fingerprint), not
+    # silently splice its carry into this run.
+    R = 12
+    key, state, block = _campaign_setup(R)
+    ck = str(tmp_path / "own_{round}.npz")
+    kw = dict(
+        scenario=block, rounds_per_dispatch=2, collect_decisions=True,
+        checkpoint_every=4, checkpoint_path=ck,
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    supervised_sweep(key, _fresh(state), **kw)
+    meta = snapshot.validate_carry_checkpoint(str(tmp_path / "own_4.npz"))
+    assert len(meta["campaign_sha256"]) == 64
+    from ba_tpu.runtime.supervisor import SupervisorError
+
+    with pytest.raises(SupervisorError, match="DIFFERENT campaign"):
+        supervised_sweep(jr.key(12345), _fresh(state), **kw)
+
+
+def test_recovery_skips_foreign_family_resumes_own(tmp_path):
+    # A stale FOREIGN campaign's newer checkpoints share the template
+    # (the operator overrode the entry guard with resume=None): fault
+    # recovery must step over them (campaign_sha256 filter) and resume
+    # this campaign's own newest checkpoint — never splice the foreign
+    # carry in.
+    R = 12
+    key, state, block = _campaign_setup(R)
+    want = _baseline(key, state, block, R)
+    ck = str(tmp_path / "shared_{round}.npz")
+    # Campaign A (different key): leaves ck_4/8/12 with A's fingerprint.
+    supervised_sweep(
+        jr.key(777), _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, checkpoint_every=4, checkpoint_path=ck,
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    # Campaign B: fresh start (resume=None), fatal at round 8 — by then
+    # B has overwritten ck_4 with its own; recovery must pick B's ck_4,
+    # skipping A's newer ck_8/ck_12.
+    plan = chaos.from_dict(
+        {"name": "f", "faults": [{"round": 8, "kind": "fatal"}]}
+    )
+    got = supervised_sweep(
+        key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, chaos=plan, resume=None,
+        checkpoint_every=4, checkpoint_path=ck,
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    _assert_bit_identical(got, want)
+    assert got["supervisor"]["recoveries"] == 1
+    # A's checkpoints were stepped over, not quarantined.
+    assert not (tmp_path / "shared_12.npz.corrupt").exists()
+
+
+def test_initial_strategy_campaign_recovers(tmp_path):
+    # The engine rejects initial_strategy alongside resume= (the carry
+    # supplies the live plane); the supervisor must drop it on resumed
+    # attempts — otherwise the first recovery of any initial_strategy
+    # campaign dies in a bogus PoisonousWindow.
+    import numpy as np
+
+    R = 12
+    key, state, block = _campaign_setup(R)
+    plane = np.zeros((16, 8), np.int8)
+    want = pipeline_sweep(
+        key, _fresh(state), R, scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, initial_strategy=plane,
+    )
+    plan = chaos.from_dict(
+        {"name": "f", "faults": [{"round": 8, "kind": "fatal"}]}
+    )
+    got = supervised_sweep(
+        key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, chaos=plan, initial_strategy=plane,
+        checkpoint_every=4, checkpoint_path=str(tmp_path / "is_{round}.npz"),
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    _assert_bit_identical(got, want)
+    assert got["supervisor"]["recoveries"] == 1
+
+
+def test_unrecoverable_explicit_resume_raises_cleanly(tmp_path):
+    # Entered via explicit resume= (key/state None) with no
+    # checkpoint_path: a fatal fault has nothing to restart from, and
+    # must surface a clear SupervisorError chaining the real fault —
+    # not a TypeError from the engine consuming state=None.
+    from ba_tpu.runtime.supervisor import SupervisorError
+
+    R = 8
+    path = tmp_path / "seed_{round}.npz"
+    _toy_checkpoint(path, round_=4, R=R)
+    _, _, block = _campaign_setup(R)
+    plan = chaos.from_dict(
+        {"name": "dead-end", "faults": [
+            {"round": 6, "kind": "fatal", "times": -1},
+        ]}
+    )
+    with pytest.raises(SupervisorError, match="cannot recover") as exc:
+        supervised_sweep(
+            None, None, scenario=block, rounds_per_dispatch=2,
+            collect_decisions=True, chaos=plan,
+            resume=str(tmp_path / "seed_4.npz"),
+            config=SupervisorConfig(timeout_s=60.0),
+        )
+    assert isinstance(exc.value.__cause__, chaos.InjectedFatal)
+
+
+def test_prune_companions_false_keeps_sidecars(tmp_path):
+    tmpl = str(tmp_path / "ck_{round}.npz")
+    for r in (2, 4, 6):
+        (tmp_path / f"ck_{r}.npz").write_bytes(b"x")
+        (tmp_path / f"ck_{r}.npz.rows.npz").write_bytes(b"y")
+    removed = snapshot.prune_checkpoints(tmpl, keep_last=1, companions=False)
+    assert sorted(removed) == [
+        str(tmp_path / "ck_2.npz"), str(tmp_path / "ck_4.npz")
+    ]
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == [
+        "ck_2.npz.rows.npz", "ck_4.npz.rows.npz",
+        "ck_6.npz", "ck_6.npz.rows.npz",
+    ]
+
+
+def test_checkpoint_meta_reserved_keys_rejected_eagerly():
+    key = jr.key(0)
+    with pytest.raises(ValueError, match="reserved"):
+        pipeline_sweep(
+            key, make_sweep_state(jr.key(1), 4, 4), 4,
+            checkpoint_every=2, checkpoint_path="x_{round}.npz",
+            checkpoint_meta={"round": 5},
+        )
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        pipeline_sweep(
+            key, make_sweep_state(jr.key(1), 4, 4), 4,
+            checkpoint_meta={"campaign_sha256": "x"},
+        )
+
+
+def test_config_errors_bypass_recovery(monkeypatch, tmp_path):
+    # Deterministic engine/parameter validation errors must surface
+    # IMMEDIATELY — not burn the poison budget re-running the campaign
+    # and then masquerade as a PoisonousWindow.
+    R = 8
+    key, state, block = _campaign_setup(R)
+    # rounds disagrees with the scenario block: the engine's eager
+    # ValueError propagates on attempt 1, no recovery records emitted.
+    with pytest.raises(ValueError, match="scenario block covers"):
+        supervised_sweep(
+            key, _fresh(state), R + 4, scenario=block,
+            rounds_per_dispatch=2,
+            config=SupervisorConfig(timeout_s=60.0),
+        )
+    # A zero watchdog timeout is a config error naming the knob, caught
+    # before any attempt runs.
+    monkeypatch.setenv("BA_TPU_SUPERVISE_TIMEOUT_S", "0")
+    with pytest.raises(ValueError, match="BA_TPU_SUPERVISE_TIMEOUT_S"):
+        supervised_sweep(
+            key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        )
+    # keep_last with the {round} slot in the DIRECTORY component is
+    # rejected eagerly, not at the first mid-campaign prune.
+    monkeypatch.delenv("BA_TPU_SUPERVISE_TIMEOUT_S")
+    with pytest.raises(ValueError, match="directory component"):
+        supervised_sweep(
+            key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+            checkpoint_every=2,
+            checkpoint_path=str(tmp_path / "d_{round}" / "carry.npz"),
+            checkpoint_keep_last=2,
+            config=SupervisorConfig(timeout_s=60.0),
+        )
+
+
+def test_cluster_supervised_refuses_partial_history(tmp_path, monkeypatch):
+    # Checkpoints written UNSUPERVISED carry no rows sidecars; a
+    # supervised rerun over them can only assemble the tail — the
+    # cluster's per-round decision tally would silently cover a
+    # fraction of the campaign, so the backend refuses loudly.
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    spec = _wiring_spec()
+    ck = str(tmp_path / "un_{round}.npz")
+    # 2 rounds/dispatch so a MID-campaign checkpoint exists (the final
+    # one is excluded from resume by the below=rounds cut).
+    monkeypatch.setenv("BA_TPU_PIPELINE_ROUNDS", "2")
+    Cluster(4, JaxBackend(platform="cpu", m=1), seed=7).run_scenario(
+        spec, checkpoint_every=4, checkpoint_path=ck
+    )
+    with pytest.raises(ValueError, match="sidecars"):
+        Cluster(4, JaxBackend(platform="cpu", m=1), seed=7).run_scenario(
+            spec, checkpoint_every=4, checkpoint_path=ck, supervise=True
+        )
+
+
+def test_newest_valid_checkpoint_below_cut(tmp_path):
+    path = tmp_path / "cut_{round}.npz"
+    _toy_checkpoint(path, round_=4, R=8)  # writes cut_4 and cut_8
+    found = snapshot.newest_valid_checkpoint(str(path), below=8)
+    assert found is not None and found[1]["round"] == 4
+    # below respects the meta cursor too, and never quarantines.
+    assert snapshot.newest_valid_checkpoint(str(path), below=4) is None
+    assert (tmp_path / "cut_4.npz").exists()
+    assert (tmp_path / "cut_8.npz").exists()
+
+
+# -- runtime wiring (backend / cluster / REPL) --------------------------------
+
+
+def _wiring_spec():
+    return from_dict(
+        {"name": "wire", "order": "attack", "rounds": 8,
+         "events": [{"round": 2, "kill": [2]},
+                    {"round": 5, "revive": [2]}]}
+    )
+
+
+def test_cluster_supervised_scenario_parity(tmp_path):
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    spec = _wiring_spec()
+    ref = Cluster(4, JaxBackend(platform="cpu", m=1), seed=7).run_scenario(
+        spec
+    )
+    plan = chaos.from_dict(
+        {"name": "t", "faults": [{"round": 3, "kind": "transient"}]}
+    )
+    sup = Cluster(4, JaxBackend(platform="cpu", m=1), seed=7).run_scenario(
+        spec, checkpoint_every=4,
+        checkpoint_path=str(tmp_path / "cl_{round}.npz"),
+        supervise=True, fault_plan=plan,
+    )
+    (rc, rres), (sc, sres) = ref, sup
+    assert rc == sc
+    assert rres["decisions"] == sres["decisions"]
+    assert rres["leaders"] == sres["leaders"]
+    assert rres["counters"] == sres["counters"]
+    assert rres["alive"] == sres["alive"]
+    assert sres["stats"]["supervisor"]["retries"] == 1
+
+
+def test_backend_fault_plan_requires_supervise():
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+
+    plan = chaos.from_dict({"name": "t", "faults": []})
+    cluster = Cluster(4, JaxBackend(platform="cpu", m=1), seed=0)
+    with pytest.raises(ValueError, match="supervise"):
+        cluster.run_scenario(_wiring_spec(), fault_plan=plan)
+
+
+def test_repl_scenario_supervise_flag(tmp_path):
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    spec_path = tmp_path / "s.json"
+    spec_path.write_text(json.dumps(
+        {"name": "s", "order": "attack", "rounds": 4,
+         "events": [{"round": 1, "kill": [2]}]}
+    ))
+    cluster = Cluster(4, JaxBackend(platform="cpu", m=1), seed=3)
+    lines = []
+    handle_command(cluster, f"scenario {spec_path} supervise", lines.append)
+    assert any(l.startswith("Scenario supervisor: attempts=1") for l in lines)
+    # Unsupervised output stays supervisor-line-free.
+    cluster2 = Cluster(4, JaxBackend(platform="cpu", m=1), seed=3)
+    lines2 = []
+    handle_command(cluster2, f"scenario {spec_path}", lines2.append)
+    assert not any("supervisor" in l for l in lines2)
+    # A bare `scenario supervise` has no file: ignored like `scenario`.
+    lines3 = []
+    assert handle_command(cluster2, "scenario supervise", lines3.append)
+    assert lines3 == []
+
+
+# -- observability records ----------------------------------------------------
+
+
+def test_recovery_and_fault_records_schema(tmp_path):
+    # The supervised run's JSONL stream carries versioned recovery +
+    # fault_injected records (the shapes check_metrics_schema.py
+    # type-checks in CI).
+    from ba_tpu.utils import metrics
+
+    R = 12
+    key, state, block = _campaign_setup(R)
+    plan = chaos.from_dict(
+        {"name": "rec", "faults": [
+            {"round": 2, "kind": "transient"},
+            {"round": 8, "kind": "fatal"},
+        ]}
+    )
+    sink = tmp_path / "metrics.jsonl"
+    old = metrics._default
+    metrics._default = metrics.MetricsSink(str(sink))
+    try:
+        supervised_sweep(
+            key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+            collect_decisions=True, chaos=plan,
+            checkpoint_every=4,
+            checkpoint_path=str(tmp_path / "r_{round}.npz"),
+            config=SupervisorConfig(timeout_s=60.0, backoff_base_s=0.01),
+        )
+    finally:
+        metrics._default.close()
+        metrics._default = old
+    recs = [json.loads(l) for l in sink.read_text().splitlines()]
+    inj = [r for r in recs if r["event"] == "fault_injected"]
+    assert [r["kind"] for r in inj] == ["transient", "fatal"]
+    for r in inj:
+        assert r["v"] == 1 and r["plan"] == "rec"
+        assert isinstance(r["round"], int) and r["phase"] in (
+            "dispatch", "retire", "checkpoint"
+        )
+    rec = [r for r in recs if r["event"] == "recovery"]
+    assert len(rec) == 1
+    r = rec[0]
+    assert r["v"] == 1 and r["fault"] == "fatal" and r["action"] == "resume"
+    assert isinstance(r["from_round"], int)
+    assert isinstance(r["lost_rounds"], int) and r["lost_rounds"] >= 0
+    assert r["error"].startswith("InjectedFatal")
