@@ -31,11 +31,15 @@ import ast
 from ba_tpu.analysis.base import Rule, register
 
 HOT_TREE = "ba_tpu.parallel."
-# The round-loop modules: the only two whose steady-state statements run
-# once per round / per dispatch.
+# The round-loop modules: the ones whose steady-state statements run
+# once per round / per dispatch.  ISSUE 8 added the mesh scan core
+# (parallel/shard.py — the shard_map megasteps and the retire-time
+# host reduction both sit on the dispatch path); mesh/multihost stay
+# out as the package's sanctioned host-topology numpy users.
 HOT_CONVERSION_MODULES = {
     "ba_tpu.parallel.pipeline",
     "ba_tpu.parallel.sweep",
+    "ba_tpu.parallel.shard",
 }
 PIPELINE_MODULE = "ba_tpu.parallel.pipeline"
 

@@ -32,6 +32,12 @@ Canonical metric names (so dashboards/tests never chase spellings):
 - ``xla_<fn>_flops`` / ``_bytes_accessed`` / ``_temp_bytes`` /
   ``_alias_bytes``                 gauges, per-program cost/memory
   (``obs/xla.py`` artifact introspection)
+- ``pipeline_shards``              gauge, mesh data-axis device count
+  of the last sweep (1 = single device)
+- ``pipeline_carry_bytes_per_shard`` / ``scenario_plane_bytes_per_shard``
+  gauges, ONE device's share of the donated carry / staged event chunk
+  (the ISSUE 8 weak-scaling denominators; sharded leaves count by
+  their local shard, replicated leaves in full)
 
 The **recompile explainer** (ISSUE 4) extends ``first_call``: callers
 that pass a NAMED ``axes`` signature (shapes/dtypes/capacity/depth/

@@ -77,6 +77,23 @@ def make_mesh(
         devices = jax.devices()
     if shape is None:
         shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    if len(shape) != len(axis_names):
+        raise ValueError(
+            f"mesh shape {shape} names {len(shape)} axis(es) but "
+            f"axis_names {axis_names} has {len(axis_names)}"
+        )
     n_dev = int(np.prod(shape))
+    if n_dev < 1:
+        raise ValueError(f"mesh shape {shape} must be all-positive")
+    if n_dev > len(devices):
+        # Without this, the oversized request dies inside Mesh with an
+        # opaque reshape error; name the numbers so the caller (or the
+        # REPL/bench one-line error paths) can act on them.
+        raise ValueError(
+            f"mesh shape {shape} needs {n_dev} device(s) but only "
+            f"{len(devices)} are available — shrink the shape or force "
+            f"more virtual devices (--xla_force_host_platform_"
+            f"device_count)"
+        )
     devs = np.asarray(devices[:n_dev]).reshape(shape)
     return Mesh(devs, axis_names)

@@ -138,7 +138,9 @@ def make_key_schedule(key: jax.Array, counter: int = 0) -> KeySchedule:
     )
 
 
-def round_keys(sched: KeySchedule, batch: int) -> jax.Array:
+def round_keys(
+    sched: KeySchedule, batch: int, index_base=None
+) -> jax.Array:
     """The current round's per-instance typed keys, derived on device.
 
     Trace-time only (call under jit): one ``fold_in`` of the carried
@@ -148,12 +150,19 @@ def round_keys(sched: KeySchedule, batch: int) -> jax.Array:
     fold keeps this module free of the banned host-split idiom ba-lint's
     BA102 rule (ba_tpu/analysis, run by scripts/ci.sh) checks for — this
     ``fold_in`` is sanctioned because it sits outside any host loop.
+
+    ``index_base`` (ISSUE 8) offsets the instance index: a mesh shard
+    holding instances ``[base, base + batch)`` of the global batch folds
+    by its GLOBAL indices, so the sharded engine draws bit-identical
+    per-instance streams to the single-device run — sharding is layout
+    only, never a different key schedule.
     """
     base = jr.wrap_key_data(sched.key_data)
     kr = jr.fold_in(base, sched.counter)
-    return jax.vmap(jr.fold_in, in_axes=(None, 0))(
-        kr, jnp.arange(batch, dtype=jnp.uint32)
-    )
+    idx = jnp.arange(batch, dtype=jnp.uint32)
+    if index_base is not None:
+        idx = idx + jnp.asarray(index_base, jnp.uint32)
+    return jax.vmap(jr.fold_in, in_axes=(None, 0))(kr, idx)
 
 
 def agreement_counters_init() -> jax.Array:
@@ -161,7 +170,9 @@ def agreement_counters_init() -> jax.Array:
     return jnp.zeros((len(COUNTER_NAMES),), jnp.int32)
 
 
-def agreement_counter_delta(out: dict, state: SimState) -> jax.Array:
+def agreement_counter_delta(
+    out: dict, state: SimState, axis_name: str | None = None
+) -> jax.Array:
     """One round's counter increments, derived ON DEVICE (trace-time,
     called inside the compiled scan body) from ``agreement_step``'s
     outputs — the paper's agreement semantics as values, not emissions:
@@ -178,11 +189,32 @@ def agreement_counter_delta(out: dict, state: SimState) -> jax.Array:
 
     Every count is host-reproducible from the decisions/majorities
     streams (tests/test_pipeline.py pins the bit-match).
+
+    ``axis_name`` (ISSUE 8) is the mesh shard axis when the scan runs
+    inside ``shard_map``: the per-instance counts stay shard-local (the
+    per-shard blocks SUM to the single-device block — that is the
+    retire-time tree-reduction contract), but unanimity is a GLOBAL
+    property of the round, so the 3-bin histogram is psummed (the only
+    cross-shard traffic in the whole scan, 3 ints per round) and the
+    verdict — globally unanimous iff one bin holds the whole summed
+    batch — is credited to shard 0 alone so the shard sum still equals
+    the single-device count.
     """
     decision = out["decision"]
     maj = out["majorities"]
     quorum_failures = jnp.sum(decision == UNDEFINED, dtype=jnp.int32)
-    unanimous = (out["histogram"].max() == decision.shape[0]).astype(jnp.int32)
+    if axis_name is None:
+        unanimous = (
+            out["histogram"].max() == decision.shape[0]
+        ).astype(jnp.int32)
+    else:
+        hist = jax.lax.psum(out["histogram"], axis_name)
+        # The bins partition the global batch (every instance decides
+        # exactly one way), so max == sum is "one bin holds everyone".
+        unanimous = (hist.max() == hist.sum()).astype(jnp.int32)
+        unanimous = jnp.where(
+            jax.lax.axis_index(axis_name) == 0, unanimous, 0
+        )
     idx = jnp.arange(state.faulty.shape[1])[None, :]
     lieutenants = state.alive & (idx != state.leader[:, None])
     big = jnp.asarray(127, maj.dtype)
@@ -192,6 +224,55 @@ def agreement_counter_delta(out: dict, state: SimState) -> jax.Array:
     traitor_present = (state.faulty & state.alive).any(axis=1)
     equivocation = jnp.sum(disagree & traitor_present, dtype=jnp.int32)
     return jnp.stack([quorum_failures, unanimous, equivocation])
+
+
+def _pipeline_scan(
+    state: SimState,
+    sched: KeySchedule,
+    counters: jax.Array | None,
+    *,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+    index_base=None,
+    axis_name: str | None = None,
+):
+    """The plain (non-mutating) scan core (trace-time; shared verbatim by
+    the donated :func:`pipeline_megastep` and the mesh-sharded
+    ``parallel.shard.sharded_pipeline_megastep``, so the single- and
+    multi-chip engines run exactly ONE implementation of the round).
+
+    ``index_base``/``axis_name`` are the sharding seam (ISSUE 8): a
+    shard folds per-instance keys by its GLOBAL instance indices and
+    the counter delta psums the 3-bin histogram for the global
+    unanimity verdict (see :func:`agreement_counter_delta`).  With the
+    defaults the trace is bit-identical to the pre-mesh engine.
+
+    Returns ``(carry, ys)`` with carry ``(state, sched[, counters])``
+    and ys ``(histograms[, decisions][, counter_rows])``.
+    """
+    with_counters = counters is not None
+
+    def body(carry, _):
+        if with_counters:
+            st, sc, ctr = carry
+        else:
+            st, sc = carry
+        keys = round_keys(sc, st.batch, index_base)
+        out = agreement_step(keys, st, m=m, max_liars=max_liars)
+        nxt = KeySchedule(sc.key_data, sc.counter + 1)
+        ys = (out["histogram"],)
+        if collect_decisions:
+            ys += (out["decision"],)
+        if with_counters:
+            ctr = ctr + agreement_counter_delta(out, st, axis_name)
+            return (st, nxt, ctr), ys + (ctr,)
+        return (st, nxt), ys
+
+    init = (state, sched, counters) if with_counters else (state, sched)
+    return jax.lax.scan(body, init, None, length=rounds, unroll=unroll)
 
 
 @functools.partial(
@@ -236,26 +317,16 @@ def pipeline_megastep(
     with or without the counter block (counters read the step's outputs,
     never its RNG).
     """
-    with_counters = counters is not None
-
-    def body(carry, _):
-        if with_counters:
-            st, sc, ctr = carry
-        else:
-            st, sc = carry
-        keys = round_keys(sc, st.batch)
-        out = agreement_step(keys, st, m=m, max_liars=max_liars)
-        nxt = KeySchedule(sc.key_data, sc.counter + 1)
-        ys = (out["histogram"],)
-        if collect_decisions:
-            ys += (out["decision"],)
-        if with_counters:
-            ctr = ctr + agreement_counter_delta(out, st)
-            return (st, nxt, ctr), ys + (ctr,)
-        return (st, nxt), ys
-
-    init = (state, sched, counters) if with_counters else (state, sched)
-    carry, ys = jax.lax.scan(body, init, None, length=rounds, unroll=unroll)
+    carry, ys = _pipeline_scan(
+        state,
+        sched,
+        counters,
+        rounds=rounds,
+        m=m,
+        max_liars=max_liars,
+        unroll=unroll,
+        collect_decisions=collect_decisions,
+    )
     return (carry[0], carry[1], *ys)
 
 
@@ -266,7 +337,9 @@ def scenario_counters_init() -> jax.Array:
     return jnp.zeros((len(SCENARIO_COUNTER_NAMES),), jnp.int32)
 
 
-def scenario_counter_delta(out: dict, state: SimState) -> jax.Array:
+def scenario_counter_delta(
+    out: dict, state: SimState, axis_name: str | None = None
+) -> jax.Array:
     """One round's scenario counter increments (trace-time, in-scan).
 
     The PR 4 agreement deltas (:func:`agreement_counter_delta`, first
@@ -286,8 +359,12 @@ def scenario_counter_delta(out: dict, state: SimState) -> jax.Array:
     outputs and the (post-mutation) state only, never the round's RNG —
     and host-reproducible from the majorities stream, which the
     kill-mid-campaign bit-match test pins.
+
+    ``axis_name`` (ISSUE 8) threads the mesh shard axis into the base
+    delta exactly as :func:`agreement_counter_delta` documents; the
+    IC1/IC2 verdicts are per-instance sums and stay shard-local.
     """
-    base = agreement_counter_delta(out, state)
+    base = agreement_counter_delta(out, state, axis_name)
     maj = out["majorities"]
     idx = jnp.arange(state.faulty.shape[1])[None, :]
     honest_lt = (
@@ -319,11 +396,17 @@ def _scenario_scan(
     max_liars: int | None = None,
     unroll: int = 1,
     collect_decisions: bool = False,
+    index_base=None,
+    axis_name: str | None = None,
 ):
     """The mutating-round scan core (trace-time; shared verbatim by the
-    donated :func:`scenario_megastep` and the jittable
-    ``parallel.sweep.failover_sweep`` wrapper, so there is exactly ONE
-    implementation of the kill → re-elect → agree transition).
+    donated :func:`scenario_megastep`, the jittable
+    ``parallel.sweep.failover_sweep`` wrapper, and the mesh-sharded
+    ``parallel.shard.sharded_scenario_megastep``, so there is exactly
+    ONE implementation of the kill → re-elect → agree transition — the
+    sharded engine inherits it through ``index_base``/``axis_name``
+    (global-instance key folding + the psummed unanimity verdict,
+    see :func:`_pipeline_scan`).
 
     ``events`` is a dict of ``[rounds, B, n]`` planes (a
     ``ScenarioBlock.chunk``): ``kill``/``revive`` bool alive-mask
@@ -357,11 +440,11 @@ def _scenario_scan(
             leader_alive, st.leader, elect_lowest_id(st.ids, alive)
         )
         st = SimState(st.order, leader, faulty, alive, st.ids)
-        keys = round_keys(sc, st.batch)
+        keys = round_keys(sc, st.batch, index_base)
         out = agreement_step(
             keys, st, m=m, max_liars=max_liars, strategies=strat
         )
-        ctr = ctr + scenario_counter_delta(out, st)
+        ctr = ctr + scenario_counter_delta(out, st, axis_name)
         nxt = KeySchedule(sc.key_data, sc.counter + 1)
         ys = (out["histogram"], leader, ctr)
         if collect_decisions:
@@ -459,6 +542,15 @@ class CarryCheckpoint:
     ``counters``/``strategy`` are ``None`` on carries that never had
     them (a plain sweep without ``with_counters``).
 
+    Shard layout (ISSUE 8): a checkpoint is DEVICE-COUNT-FREE.  A mesh
+    campaign's per-shard counter blocks gather (sum) to the canonical
+    single-device block at write time, state/strategy planes fetch to
+    their full global shapes, and ``shard_layout`` records the writing
+    mesh's axis sizes (``{"data": 1}`` for single-device) as
+    provenance — so a campaign checkpointed on d devices resumes
+    bit-exactly on d' (``pipeline_sweep(resume=..., mesh=...)``
+    re-splits on read; subprocess-pinned in tests/test_scenario.py).
+
     Serialized via :func:`save_carry_checkpoint` to the repo's single
     checkpoint format (``utils/snapshot.py``: one versioned ``.npz``
     with a JSON ``__meta__`` header, atomic write); the engine writes
@@ -472,6 +564,7 @@ class CarryCheckpoint:
     counters: jax.Array | None
     strategy: jax.Array | None
     round: int
+    shard_layout: dict | None = None
 
 
 def _carry_arrays(host_state, host_sched, host_counters, host_strategy):
@@ -502,11 +595,13 @@ def _carry_arrays(host_state, host_sched, host_counters, host_strategy):
 # hazard both checks exist to prevent).
 RESERVED_CARRY_META_KEYS = frozenset(
     {"format", "v", "round", "scenario", "counter_names", "sha256",
-     "rounds_total"}
+     "rounds_total", "shard_layout"}
 )
 
 
-def _carry_meta(round_cursor: int, counters, strategy, **extra) -> dict:
+def _carry_meta(
+    round_cursor: int, counters, strategy, shard_layout=None, **extra
+) -> dict:
     clash = (RESERVED_CARRY_META_KEYS - {"rounds_total"}) & set(extra)
     if clash:
         # Silently overriding a header field would write a checkpoint
@@ -528,27 +623,49 @@ def _carry_meta(round_cursor: int, counters, strategy, **extra) -> dict:
         "round": int(round_cursor),
         "scenario": strategy is not None,
         "counter_names": names,
+        # Provenance, not a resume constraint: the stored arrays are
+        # canonical (gather-on-write), so any device count reads them.
+        "shard_layout": shard_layout or {"data": 1},
         **extra,
     }
 
 
-def save_carry_checkpoint(path: str, ckpt: CarryCheckpoint, **extra) -> None:
+def save_carry_checkpoint(path: str, ckpt: CarryCheckpoint, **extra) -> int:
     """Serialize a live carry to ``path`` (atomic, versioned).
 
     Fetches the carry to host first — callers on the engine's donation
     thread must pass a carry they own (``fresh_copy`` the live one; the
     engine's ``checkpoint_every`` path does this for you at its existing
     retire sync, so prefer it inside sweeps).  ``extra`` keys ride the
-    JSON meta header (campaign name, total rounds, ...).
+    JSON meta header (campaign name, total rounds, ...).  Returns the
+    total array bytes written (the engine's ``scenario_checkpoint``
+    JSONL record reports it).
+
+    A per-shard counter block ([d, C], a live mesh carry) gathers to
+    the canonical single-device block here (gather-on-write: the sum is
+    the invariant), so the written file is device-count-free whatever
+    carry the caller held.  This is the ONE implementation of that
+    rule — the engine's in-retire writer routes through here.
     """
-    host = jax.device_get(
-        (ckpt.state, ckpt.schedule, ckpt.counters, ckpt.strategy)
+    host = list(
+        jax.device_get(
+            (ckpt.state, ckpt.schedule, ckpt.counters, ckpt.strategy)
+        )
     )
+    layout = ckpt.shard_layout
+    if host[2] is not None and host[2].ndim == 2:
+        if layout is None:
+            layout = {"data": int(host[2].shape[0])}
+        host[2] = host[2].sum(axis=0, dtype=host[2].dtype)
+    arrays = _carry_arrays(*host)
     _snapshot.write_carry_checkpoint(
         path,
-        _carry_arrays(*host),
-        _carry_meta(ckpt.round, host[2], host[3], **extra),
+        arrays,
+        _carry_meta(
+            ckpt.round, host[2], host[3], shard_layout=layout, **extra
+        ),
     )
+    return sum(v.nbytes for v in arrays.values())
 
 
 def load_carry_checkpoint(path: str) -> CarryCheckpoint:
@@ -598,6 +715,7 @@ def load_carry_checkpoint(path: str) -> CarryCheckpoint:
         counters=counters,
         strategy=strategy,
         round=meta["round"],
+        shard_layout=meta.get("shard_layout"),
     )
 
 
@@ -642,10 +760,30 @@ def pipeline_sweep(  # ba-lint: donates(state)
     / ``"retire"``) instruments the schedule for the dispatch-count tests.
 
     DONATION: ``state`` is consumed by the first dispatch — use the
-    returned ``final_state``.  With ``mesh`` set the engine first lays the
-    batch out on the mesh's "data" axis (``sharded_sweep``'s placement,
-    multi-process safe via ``put_global``) and donation recycles the
-    sharded copies instead.
+    returned ``final_state``.
+
+    MESH MODE (ISSUE 8): with ``mesh`` set the engine lays the batch
+    out on the mesh's "data" axis (``sharded_sweep``'s placement,
+    multi-process safe via ``put_global``) and every dispatch runs the
+    ``shard_map`` megasteps from ``parallel/shard.py`` — the SAME scan
+    cores, batch-sharded, donation recycling the sharded copies, so
+    per-device peak carry/plane bytes are the single-device figure
+    divided by the device count.  Bit-exactness with the single-device
+    run at equal shapes is the contract (per-instance keys fold by
+    GLOBAL instance index; sharding is layout only).  Counter blocks
+    and per-round histogram contributions stay PER-SHARD on device and
+    the host tree-reduces them inside the existing depth-delayed
+    retire fetch — no new synchronization (the no-blocking
+    dispatch-count proof runs on a live mesh); the one in-scan
+    collective is a 3-int histogram psum per round for the global
+    unanimity verdict, and only when counters are on.  The batch must
+    divide the data-axis size (eagerly validated); ``final_counters``
+    comes back as the live per-shard ``[d, C]`` block (any later
+    resume/checkpoint collapses it — the sum is the invariant).
+    Checkpoints are DEVICE-COUNT-FREE: per-shard blocks gather at
+    write, ``shard_layout`` records provenance, and a campaign
+    checkpointed on d devices resumes bit-exactly on d' (pass the new
+    ``mesh=`` — or none — with ``resume=``).
 
     Returns a dict:
 
@@ -931,7 +1069,14 @@ def pipeline_sweep(  # ba-lint: donates(state)
             counters = scenario_counters_init()
         else:
             counters = agreement_counters_init() if with_counters else None
+    n_shards = 1
     if mesh is not None:
+        # The mesh scan core (ISSUE 8): shard_map over the "data" axis,
+        # per-shard counter blocks, retire-time host tree-reduction.
+        # Lazy import — shard.py imports this module's scan cores.
+        from ba_tpu.parallel import shard as _shard
+
+        n_shards = _shard.validate_mesh(mesh, state.faulty.shape[0])
         state = jax.tree.map(
             lambda x: put_global(
                 mesh, x, P("data", *([None] * (x.ndim - 1)))
@@ -942,13 +1087,18 @@ def pipeline_sweep(  # ba-lint: donates(state)
             lambda x: put_global(mesh, x, P(*([None] * x.ndim))), sched
         )
         if counters is not None:
-            # Replicated like the schedule: every shard folds the same
-            # global deltas (agreement_counter_delta reduces over the
-            # full batch, which XLA turns into the histogram's psum).
-            counters = put_global(mesh, counters, P(None))
+            # Per-shard blocks [d, C] (reshard-on-read when resuming a
+            # canonical checkpoint block): each shard folds only its
+            # local deltas and the host sums the fetched rows at retire
+            # — the counter thread never rides a collective.
+            counters = _shard.expand_counters(mesh, counters)
         if strategy is not None:
             # The strategy plane shards with the batch it describes.
             strategy = put_global(mesh, strategy, P("data", None))
+    elif counters is not None and counters.ndim == 2:
+        # A live per-shard block resumed WITHOUT a mesh (d -> 1):
+        # collapse to the canonical block — the sum is the invariant.
+        counters = counters.sum(axis=0)
 
     span = rounds - start
     chunks = [rounds_per_dispatch] * (span // rounds_per_dispatch)
@@ -1008,13 +1158,20 @@ def pipeline_sweep(  # ba-lint: donates(state)
         if staged is None:
             with tracer.span("stage_planes", lo=lo, hi=hi, empty=empty):
                 host = scenario.chunk(lo, hi)
-                # Host-array -> jnp.asarray is an ASYNC upload; it queues
-                # behind the in-flight dispatches without waiting on them.
-                staged = {k: jnp.asarray(v) for k, v in host.items()}
-                if mesh is not None:
+                if mesh is None:
+                    # Host-array -> jnp.asarray is an ASYNC upload; it
+                    # queues behind the in-flight dispatches without
+                    # waiting on them.
+                    staged = {k: jnp.asarray(v) for k, v in host.items()}
+                else:
+                    # put_global slices the HOST chunk straight onto the
+                    # mesh: each device receives only its [nr, B/d, n]
+                    # slice, so peak per-device plane bytes are the
+                    # single-device figure divided by the shard count —
+                    # the full chunk never lands on one device first.
                     staged = {
                         k: put_global(mesh, v, P(None, "data", None))
-                        for k, v in staged.items()
+                        for k, v in host.items()
                     }
                 nbytes = sum(v.nbytes for v in host.values())
             if empty:
@@ -1032,26 +1189,29 @@ def pipeline_sweep(  # ba-lint: donates(state)
 
     def write_checkpoint(round_cursor, carry):
         nonlocal n_checkpoints
-        host_state, host_sched, host_counters, host_strategy = (
-            jax.device_get(carry)
-        )
-        arrays = _carry_arrays(
-            host_state, host_sched, host_counters, host_strategy
-        )
+        carry_state, carry_sched, carry_counters, carry_strategy = carry
+        # Gather-on-write (ISSUE 8) — per-shard counter collapse and
+        # layout provenance — lives in ONE place: save_carry_checkpoint
+        # (its device_get is this retire's existing sync; the carry copy
+        # is necessarily ready here).
+        layout = _shard.shard_layout(mesh) if mesh is not None else None
         # checkpoint_path is always set here: the up-front validation
         # rejects checkpoint_every without it.
         written = checkpoint_path.replace("{round}", str(round_cursor))
-        _snapshot.write_carry_checkpoint(
+        nbytes = save_carry_checkpoint(
             written,
-            arrays,
-            _carry_meta(
-                round_cursor, host_counters, host_strategy,
-                rounds_total=rounds,
-                **(checkpoint_meta or {}),
+            CarryCheckpoint(
+                state=carry_state,
+                schedule=carry_sched,
+                counters=carry_counters,
+                strategy=carry_strategy,
+                round=round_cursor,
+                shard_layout=layout,
             ),
+            rounds_total=rounds,
+            **(checkpoint_meta or {}),
         )
         n_checkpoints += 1
-        nbytes = sum(v.nbytes for v in arrays.values())
         obs.instant("scenario_checkpoint", round=round_cursor, path=written)
         reg.counter("scenario_checkpoints_total").inc()
         _metrics.emit(
@@ -1063,6 +1223,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
                 "scenario": scenario is not None,
                 "path": written,
                 "bytes": nbytes,
+                "shard_layout": layout or {"data": 1},
             }
         )
         if checkpoint_keep_last is not None:
@@ -1119,6 +1280,20 @@ def pipeline_sweep(  # ba-lint: donates(state)
                 finally:
                     if watchdog is not None:
                         watchdog.cancel()
+                if mesh is not None:
+                    # Retire-time tree-reduction (ISSUE 8): sum the
+                    # fetched per-shard histogram/counter contributions
+                    # to the canonical single-device shapes — host
+                    # arithmetic on the fetch that just returned, never
+                    # a new sync.  on_rows/checkpoint consumers below
+                    # therefore see byte-identical blocks at any device
+                    # count.
+                    host_ys = _shard.reduce_host_ys(
+                        host_ys,
+                        scenario=scenario is not None,
+                        collect_decisions=collect_decisions,
+                        with_counters=with_counters,
+                    )
                 retired.append(host_ys)
         # Latency records BEFORE the checkpoint write: the histogram
         # measures submit->retire of the dispatch itself, and folding a
@@ -1158,9 +1333,12 @@ def pipeline_sweep(  # ba-lint: donates(state)
         # async dispatch; later ones are cached dispatches — the span is
         # named accordingly, and the NAMED axes signature feeds the
         # recompile explainer (a later re-specialization emits a
-        # `recompile` record diffing exactly these axes).  "meshed"
-        # rides the axes because sharded inputs force a fresh
-        # specialization even at equal shapes/statics.
+        # `recompile` record diffing exactly these axes).  The mesh
+        # data-axis SIZE rides the axes (ISSUE 8): a sharded input
+        # forces a fresh specialization even at equal shapes/statics,
+        # and a device-count change now reads as `"data": [1, 8]` in
+        # the recompile record — and in the cross-run compile ledger's
+        # signature — instead of an unexplained recompile.
         axes = {
             "batch": state.faulty.shape[0],
             "capacity": state.faulty.shape[1],
@@ -1170,7 +1348,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
             "unroll": min(unroll, nr),
             "collect_decisions": collect_decisions,
             "counters": with_counters,
-            "meshed": mesh is not None,
+            "data": n_shards,
             "scenario": scenario is not None,
         }
         if scenario is not None:
@@ -1193,15 +1371,23 @@ def pipeline_sweep(  # ba-lint: donates(state)
                     # functools.partial (not a lambda) binds the carry
                     # NOW: the seam may retry the zero-arg call, and the
                     # names `state`/`sched`/... rebind right below.
-                    call = functools.partial(
-                        scenario_megastep,
-                        state, sched, strategy, counters, ev, **kwargs,
-                    )
+                    if mesh is None:
+                        call = functools.partial(
+                            scenario_megastep,
+                            state, sched, strategy, counters, ev,
+                            **kwargs,
+                        )
+                    else:
+                        call = functools.partial(
+                            _shard.sharded_scenario_megastep,
+                            state, sched, strategy, counters, ev,
+                            mesh=mesh, **kwargs,
+                        )
                     if exec_seam is None:
                         out = call()
                     else:
                         out = exec_seam(call, "dispatch", d, lo, hi)
-            if phase == "compile" and obs.xla.enabled():
+            if phase == "compile" and obs.xla.enabled() and mesh is None:
                 # Donated args keep their shape/dtype metadata after the
                 # dispatch consumes them, which is all abstractify reads
                 # (same contract the plain path relies on for kwargs).
@@ -1227,14 +1413,20 @@ def pipeline_sweep(  # ba-lint: donates(state)
                 "pipeline_megastep", axes=axes, dispatch=d, rounds=nr
             ) as phase:
                 with obs.xla.annotate("megastep_dispatch", dispatch=d):
-                    call = functools.partial(
-                        pipeline_megastep, state, sched, **kwargs
-                    )
+                    if mesh is None:
+                        call = functools.partial(
+                            pipeline_megastep, state, sched, **kwargs
+                        )
+                    else:
+                        call = functools.partial(
+                            _shard.sharded_pipeline_megastep,
+                            state, sched, mesh=mesh, **kwargs,
+                        )
                     if exec_seam is None:
                         out = call()
                     else:
                         out = exec_seam(call, "dispatch", d, lo, hi)
-            if phase == "compile" and obs.xla.enabled():
+            if phase == "compile" and obs.xla.enabled() and mesh is None:
                 # Device-tier artifact: AOT-harvest this specialization's
                 # cost/memory analysis (flops, bytes, donation-alias
                 # evidence).  The abstract signature is read off the
@@ -1308,6 +1500,21 @@ def pipeline_sweep(  # ba-lint: donates(state)
     # concatenation, not a device sync.
     import numpy as _host_np
 
+    # Shard-labeled gauges (ISSUE 8): the per-device denominators the
+    # weak-scaling artifact reads — device count, one device's share of
+    # the live carry (addressable-shard bytes: sharded leaves by their
+    # local slice, replicated leaves in full).  In-memory scalar ops on
+    # live handles; no fetch, no sync.
+    carry = (state, sched, counters, strategy)
+    if mesh is not None:
+        carry_bytes_per_shard = _shard.per_shard_nbytes(carry)
+    else:
+        carry_bytes_per_shard = sum(
+            x.nbytes for x in jax.tree.leaves(carry)
+        )
+    reg.gauge("pipeline_shards").set(n_shards)
+    reg.gauge("pipeline_carry_bytes_per_shard").set(carry_bytes_per_shard)
+
     histograms = _host_np.concatenate([ys[0] for ys in retired])
     result = {
         "histograms": histograms,
@@ -1324,7 +1531,10 @@ def pipeline_sweep(  # ba-lint: donates(state)
             "checkpoints": n_checkpoints,
             "stalls": n_stalls,
             "plane_peak_bytes": plane_peak_bytes,
+            "plane_peak_bytes_per_shard": plane_peak_bytes // n_shards,
             "stage_s": round(stage_s, 6),
+            "shards": n_shards,
+            "carry_bytes_per_shard": carry_bytes_per_shard,
         },
     }
     if scenario is not None:
@@ -1332,6 +1542,9 @@ def pipeline_sweep(  # ba-lint: donates(state)
         # materialized (the O(chunk)-not-O(R) claim, as a number) and
         # the total wall time staging spent in the overlap slot.
         reg.gauge("scenario_plane_bytes").set(plane_peak_bytes)
+        reg.gauge("scenario_plane_bytes_per_shard").set(
+            plane_peak_bytes // n_shards
+        )
         reg.gauge("scenario_stage_overlap_s").set(round(stage_s, 6))
         # Everything below is host arithmetic over blocks the retire
         # fetches already brought back — the campaign "drain" adds no

@@ -1,0 +1,324 @@
+"""Mesh-sharded megasteps: the pipelined scan cores under ``shard_map``
+(ISSUE 8 tentpole) — one campaign drives every chip.
+
+``parallel/pipeline.py`` owns the scan cores (``_pipeline_scan`` /
+``_scenario_scan``); this module wraps them in ``shard_map`` over a
+mesh's "data" axis so the batch — and with it every steady-state carry
+buffer and every staged event plane — splits across devices:
+
+- **Sharding is layout-only.**  Instances are independent, and the
+  ``KeySchedule`` folds per-instance keys by GLOBAL instance index
+  (``round_keys(..., index_base=shard_base)``), so the sharded engine
+  draws bit-identical streams to the single-device run — decisions,
+  leaders, histograms and every counter match bit-for-bit at equal
+  shapes (the mesh parity tests pin it).
+- **Per-shard outputs, retire-time tree-reduction.**  Each shard folds
+  its own counter block ([d, C] global, ``P("data", None)``) and emits
+  its own per-round histogram contribution ([R, d, 3]); the host SUMS
+  them inside the engine's existing depth-delayed retire fetch
+  (:func:`reduce_host_ys`) — no collective rides the scan for them, and
+  no new synchronization point exists anywhere (the no-blocking
+  dispatch-count proof re-runs on a live mesh).  The ONE cross-shard
+  collective in the compiled program is a 3-int histogram psum per
+  round, and only when counters are on: global unanimity is a property
+  of the whole batch, not of any shard
+  (``pipeline.agreement_counter_delta``).
+- **Donation is unchanged.**  The sharded megasteps donate the same
+  carry slots as their single-device twins, so steady-state buffers
+  alias in place per device — peak per-device carry bytes are the
+  single-device figure divided by the shard count.
+
+Checkpoints stay device-count-free: the engine gathers per-shard
+counter blocks to the canonical single-device block at write time
+(gather-on-write) and re-splits on resume (:func:`expand_counters`,
+reshard-on-read), so a campaign checkpointed on d devices resumes
+bit-exactly on d' — subprocess-pinned in tests/test_scenario.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ba_tpu.core.state import SimState
+from ba_tpu.parallel import pipeline as _pipeline
+from ba_tpu.parallel.mesh import shard_map
+from ba_tpu.parallel.multihost import put_global
+
+# The engine's shard axis: batched consensus instances are independent,
+# so the batch dimension is the one that scales with chips ("data" in
+# every mesh this repo builds — sharded_sweep, make_global_mesh).
+DATA_AXIS = "data"
+
+# Spec pytrees for the carry (the dataclasses double as spec containers:
+# a registered-dataclass pytree of PartitionSpecs is a valid shard_map
+# spec tree).  State planes shard on the batch axis; the key schedule
+# replicates — it is 3 ints, and every shard derives its own slice of
+# the key stream from the global indices.
+STATE_SPECS = SimState(
+    order=P(DATA_AXIS),
+    leader=P(DATA_AXIS),
+    faulty=P(DATA_AXIS, None),
+    alive=P(DATA_AXIS, None),
+    ids=P(DATA_AXIS, None),
+)
+SCHED_SPECS = _pipeline.KeySchedule(key_data=P(None), counter=P())
+COUNTER_SPECS = P(DATA_AXIS, None)  # [d, C] per-shard blocks
+STRATEGY_SPECS = P(DATA_AXIS, None)
+EVENT_SPECS = P(None, DATA_AXIS, None)  # [R, B, n] planes
+# Stacked per-round outputs: per-shard contributions keep the shard
+# axis ([R, d, 3] histograms / [R, d, C] counter rows — host-reduced at
+# retire); per-instance rows ([R, B] decisions/leaders) gather to the
+# canonical global shape at the same fetch.
+ROWS_SPECS = P(None, DATA_AXIS, None)
+INSTANCE_SPECS = P(None, DATA_AXIS)
+
+
+def validate_mesh(mesh: Mesh, batch: int) -> int:
+    """The mesh's data-axis size, after the eager layout checks.
+
+    Raises ``ValueError`` naming the problem (missing "data" axis, or a
+    batch the axis cannot split evenly) BEFORE any buffer enters the
+    donation thread — a shape error surfacing from inside a donated
+    dispatch would leave the caller with consumed inputs and an opaque
+    XLA message.
+    """
+    if DATA_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh axes {tuple(mesh.shape)} carry no {DATA_AXIS!r} axis — "
+            f"the engine shards the batch on it (make_mesh's default "
+            f"layout)"
+        )
+    d = int(mesh.shape[DATA_AXIS])
+    if batch % d:
+        raise ValueError(
+            f"batch {batch} is not divisible by the mesh's {DATA_AXIS!r} "
+            f"axis ({d} device(s)) — pad the batch or shrink the mesh"
+        )
+    return d
+
+
+def shard_layout(mesh: Mesh) -> dict:
+    """The mesh's axis sizes as a JSON-able ``{axis: size}`` dict — the
+    layout provenance recorded in carry-checkpoint headers and
+    ``scenario_checkpoint`` records (the stored ARRAYS are canonical /
+    device-count-free; the layout says what wrote them)."""
+    return {name: int(size) for name, size in mesh.shape.items()}
+
+
+def expand_counters(mesh: Mesh, counters: jax.Array) -> jax.Array:
+    """A canonical counter block -> per-shard blocks on ``mesh``
+    (reshard-on-read).
+
+    Shard 0 receives the whole prior total and every other shard starts
+    at zero: only the SUM of the per-shard blocks is ever observed (the
+    retire-time reduction and the checkpoint gather both sum), so any
+    decomposition preserving it is bit-exact — this one needs no
+    arithmetic.  A 2-D block (a live per-shard carry resumed in memory,
+    possibly from a different device count) is collapsed to canonical
+    first.
+    """
+    if counters.ndim == 2:
+        counters = counters.sum(axis=0)
+    d = int(mesh.shape[DATA_AXIS])
+    block = jnp.zeros((d,) + counters.shape, counters.dtype)
+    block = block.at[0].set(counters)
+    return put_global(mesh, block, COUNTER_SPECS)
+
+
+def per_shard_nbytes(tree) -> int:
+    """Bytes ONE device holds for a pytree of (possibly sharded) arrays
+    — the per-device peak-memory denominator the weak-scaling artifact
+    reports (replicated leaves count in full, sharded leaves by their
+    local shard)."""
+    total = 0
+    for x in jax.tree.leaves(tree):
+        shards = getattr(x, "addressable_shards", None)
+        if shards:
+            total += shards[0].data.nbytes
+        else:
+            total += x.nbytes
+    return total
+
+
+def reduce_host_ys(
+    host_ys: tuple,
+    *,
+    scenario: bool,
+    collect_decisions: bool,
+    with_counters: bool,
+) -> tuple:
+    """One retire's fetched per-shard blocks -> canonical single-device
+    shapes (the retire-time tree-reduction).
+
+    Runs on HOST numpy the retire fetch already brought back — pure
+    arithmetic on an existing sync, never a new one.  Histograms
+    [R, d, 3] and cumulative counter rows [R, d, C] sum over the shard
+    axis (each shard's rows are cumulative for its partials, so the sum
+    is the cumulative global row); decisions/leaders arrive already
+    gathered to [R, B] by the fetch.  Downstream consumers — the result
+    assembly, ``on_rows`` history sidecars, checkpoint-adjacent row
+    delivery — therefore see byte-identical blocks at any device count.
+    """
+    ys = list(host_ys)
+    ys[0] = ys[0].sum(axis=1, dtype=ys[0].dtype)
+    if scenario:
+        ys[2] = ys[2].sum(axis=1, dtype=ys[2].dtype)
+    elif with_counters:
+        ys[-1] = ys[-1].sum(axis=1, dtype=ys[-1].dtype)
+    return tuple(ys)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "rounds", "m", "max_liars", "unroll", "collect_decisions",
+    ),
+    donate_argnums=(0, 1),
+)
+def sharded_pipeline_megastep(  # ba-lint: donates(state, sched)
+    state: SimState,
+    sched: _pipeline.KeySchedule,
+    *,
+    mesh: Mesh,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+    counters: jax.Array | None = None,
+):
+    """:func:`ba_tpu.parallel.pipeline.pipeline_megastep`, batch-sharded
+    over ``mesh``'s "data" axis via ``shard_map`` — same scan core, same
+    donation contract (``state``/``sched`` are CONSUMED), same return
+    tuple, except histograms come back per-shard ``[rounds, d, 3]`` and
+    counter rows ``[rounds, d, C]`` for the host to tree-reduce at
+    retire (``counters`` is a per-shard ``[d, C]`` block from
+    :func:`expand_counters`).
+    """
+    with_counters = counters is not None
+
+    def run(st, sc, *rest):
+        ctr = rest[0] if rest else None
+        base = jax.lax.axis_index(DATA_AXIS) * st.faulty.shape[0]
+        carry, ys = _pipeline._pipeline_scan(
+            st,
+            sc,
+            ctr,
+            rounds=rounds,
+            m=m,
+            max_liars=max_liars,
+            unroll=unroll,
+            collect_decisions=collect_decisions,
+            index_base=base,
+            axis_name=DATA_AXIS if with_counters else None,
+        )
+        # Local [rounds, 3] histogram -> [rounds, 1, 3]: the singleton
+        # axis is this shard's slot in the stacked [rounds, d, 3]
+        # contribution block (counter rows are [rounds, 1, C] already —
+        # the carried block's local view is [1, C]).
+        out_ys = (ys[0][:, None, :],) + ys[1:]
+        return (carry[0], carry[1], *out_ys)
+
+    in_specs = (STATE_SPECS, SCHED_SPECS)
+    out_specs = (STATE_SPECS, SCHED_SPECS, ROWS_SPECS)
+    if collect_decisions:
+        out_specs += (INSTANCE_SPECS,)
+    if with_counters:
+        in_specs += (COUNTER_SPECS,)
+        out_specs += (ROWS_SPECS,)
+    args = (state, sched) + ((counters,) if with_counters else ())
+    # check_vma=False: the replication checker predates axis_index-mixed
+    # scan carries; correctness is pinned by the bit-exact parity tests.
+    return shard_map(
+        run, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )(*args)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "rounds", "m", "max_liars", "unroll", "collect_decisions",
+    ),
+    donate_argnums=(0, 1, 2),
+)
+def sharded_scenario_megastep(  # ba-lint: donates(state, sched, strategy)
+    state: SimState,
+    sched: _pipeline.KeySchedule,
+    strategy: jax.Array,
+    counters: jax.Array,
+    events: dict,
+    *,
+    mesh: Mesh,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+):
+    """:func:`ba_tpu.parallel.pipeline.scenario_megastep`, batch-sharded
+    over ``mesh``'s "data" axis — the mutating scan core under
+    ``shard_map``.  Kills, revivals, strategy flips and lowest-alive-id
+    re-election are all per-instance, so every event plane slices on the
+    batch axis and the whole mutating round is shard-local; the one
+    collective is the counter delta's 3-int histogram psum.  Donation
+    contract as the single-device twin (``state``/``sched``/``strategy``
+    CONSUMED); histograms/counter rows return per-shard for the
+    retire-time reduction, leaders/decisions gather to ``[rounds, B]``.
+    """
+
+    def run(st, sc, strat, ctr, ev):
+        base = jax.lax.axis_index(DATA_AXIS) * st.faulty.shape[0]
+        carry, ys = _pipeline._scenario_scan(
+            st,
+            sc,
+            strat,
+            ctr,
+            ev,
+            rounds=rounds,
+            m=m,
+            max_liars=max_liars,
+            unroll=unroll,
+            collect_decisions=collect_decisions,
+            index_base=base,
+            axis_name=DATA_AXIS,
+        )
+        # ys = (histograms, leaders, counter_rows[, decisions]); the
+        # histogram gains its per-shard slot, the counter rows carry it
+        # already ([rounds, 1, C] — the carried block's local view).
+        out_ys = (ys[0][:, None, :],) + ys[1:]
+        return (carry[0], carry[1], carry[2], *out_ys)
+
+    event_specs = {k: EVENT_SPECS for k in events}
+    out_specs = (
+        STATE_SPECS, SCHED_SPECS, STRATEGY_SPECS,
+        ROWS_SPECS, INSTANCE_SPECS, ROWS_SPECS,
+    )
+    if collect_decisions:
+        out_specs += (INSTANCE_SPECS,)
+    return shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(
+            STATE_SPECS, SCHED_SPECS, STRATEGY_SPECS, COUNTER_SPECS,
+            event_specs,
+        ),
+        out_specs=out_specs,
+        check_vma=False,
+    )(state, sched, strategy, counters, events)
+
+
+__all__ = [
+    "DATA_AXIS",
+    "expand_counters",
+    "per_shard_nbytes",
+    "reduce_host_ys",
+    "shard_layout",
+    "sharded_pipeline_megastep",
+    "sharded_scenario_megastep",
+    "validate_mesh",
+]

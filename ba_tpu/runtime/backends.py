@@ -371,6 +371,7 @@ class JaxBackend:
         checkpoint_keep_last=None,
         supervise=False,
         fault_plan=None,
+        mesh=None,
     ):
         """A declarative scenario campaign on the B=1 interactive cluster.
 
@@ -402,6 +403,15 @@ class JaxBackend:
         deterministic faults for drills and tests; it requires
         ``supervise=True`` — injecting faults with nobody to catch them
         would just kill the campaign.
+
+        ``mesh`` (ISSUE 8) threads straight into the engine's
+        mesh-sharded scan core (``pipeline_sweep(mesh=)``).  NOTE the
+        interactive facade is B=1, so the mesh's "data" axis must be 1
+        — a larger axis raises the engine's clear divisibility error
+        (batched multi-chip campaigns call ``scenario_sweep(mesh=)``
+        directly); the parameter exists so the one campaign surface is
+        dial-for-dial complete and the REPL can exercise the sharded
+        path.
 
         Returns a dict: ``decisions`` (per-round quorum codes),
         ``leaders`` (per-round roster indices), ``counters``
@@ -453,6 +463,7 @@ class JaxBackend:
             checkpoint_every=checkpoint_every,
             checkpoint_path=checkpoint_path,
             checkpoint_keep_last=checkpoint_keep_last,
+            mesh=mesh,
         )
         if supervise:
             from ba_tpu.runtime.supervisor import supervised_sweep

@@ -303,6 +303,7 @@ class Cluster:
         checkpoint_keep_last=None,
         supervise=False,
         fault_plan=None,
+        mesh=None,
     ):
         """Run a declarative scenario campaign (ba_tpu.scenario) on this
         cluster: the whole ``g-kill``/``g-add``/``g-state`` REPL session
@@ -319,7 +320,11 @@ class Cluster:
         recovery, OOM degradation — and ``fault_plan`` injects
         deterministic chaos faults for drills (requires supervision);
         the supervisor's stats block lands in the ``scenario_campaign``
-        record.
+        record.  ``mesh`` (ISSUE 8) threads into the engine's sharded
+        scan core — the interactive batch is 1, so only a data-axis-1
+        mesh runs (a larger one raises the engine's clear divisibility
+        error; batched multi-chip campaigns call
+        ``parallel.pipeline.scenario_sweep(mesh=)`` directly).
 
         The backend (``run_scenario``) compiles the spec against the
         current roster and drives the mutating megastep; afterwards the
@@ -355,6 +360,7 @@ class Cluster:
                 checkpoint_keep_last=checkpoint_keep_last,
                 supervise=supervise,
                 fault_plan=fault_plan,
+                mesh=mesh,
             )
         if res is None:
             return None

@@ -29,7 +29,13 @@ stay byte-identical):
   run.  ``scenario <file> <ckpt-path> <every>`` checkpoints the carry;
   a trailing ``supervise`` token runs the campaign under the resilient
   execution supervisor (``runtime/supervisor.py``: watchdog, transient
-  retry, automatic checkpoint recovery) and prints its stats line.
+  retry, automatic checkpoint recovery) and prints its stats line.  A
+  ``mesh=N`` token (ISSUE 8) routes the campaign through the engine's
+  mesh-sharded scan core on an N×1 device mesh — the interactive batch
+  is 1, so only ``mesh=1`` runs (a larger N prints the engine's clear
+  one-line error naming the mismatch, as does asking for more devices
+  than exist); batched multi-chip campaigns use
+  ``parallel.pipeline.scenario_sweep(mesh=)`` from library code.
 - ``stats`` — dump the observability registry (``ba_tpu.obs``) as
   Prometheus-style text: round wall-time histogram, pipeline dispatch /
   retire latencies and depth occupancy, election and failover counters.
@@ -143,8 +149,25 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
         # path and abort the command — drop them here, locally.  A
         # trailing `supervise` token (ISSUE 7) runs the campaign under
         # the resilient execution supervisor (watchdog, transient retry,
-        # automatic checkpoint recovery).
+        # automatic checkpoint recovery).  A `mesh=N` token (ISSUE 8)
+        # routes through the mesh-sharded scan core; every mesh problem
+        # (more devices than exist, a data axis the B=1 batch cannot
+        # split) surfaces as one error line, never a traceback.
         args = [t for t in cmd[1:] if t]
+        mesh_n = None
+        for tok in list(args):
+            if tok.startswith("mesh="):
+                try:
+                    mesh_n = int(tok[len("mesh="):])
+                except ValueError:
+                    out(f"scenario error: mesh= wants a device count, "
+                        f"got {tok[len('mesh='):]!r}")
+                    return True
+                if mesh_n < 1:
+                    out(f"scenario error: mesh= must be >= 1, "
+                        f"got {mesh_n}")
+                    return True
+                args.remove(tok)
         supervise = False
         if args and args[-1] == "supervise":
             supervise = True
@@ -157,7 +180,7 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             # and the user would only find out at resume time.
             out("scenario error: checkpoint path given without <every> "
                 "(usage: scenario <file> [<ckpt-path> <every>] "
-                "[supervise])")
+                "[supervise] [mesh=N])")
             return True
         if len(args) > 3:
             # Like the path-without-<every> case: extra tokens mean the
@@ -165,7 +188,7 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             # loudly rather than silently dropping them.
             out("scenario error: too many arguments "
                 "(usage: scenario <file> [<ckpt-path> <every>] "
-                "[supervise])")
+                "[supervise] [mesh=N])")
             return True
         if len(args) == 3:
             ck_path = args[1]
@@ -184,11 +207,22 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             out(f"scenario error: {e}")
             return True
         try:
+            mesh = None
+            if mesh_n is not None:
+                # Lazy: make_mesh imports jax, and the PyBackend REPL
+                # must keep running without it; its clear oversized-
+                # request ValueError prints below as one line.
+                from ba_tpu.parallel.mesh import make_mesh
+
+                mesh = make_mesh((mesh_n, 1), ("data", "node"))
             ran = cluster.run_scenario(
                 spec, checkpoint_every=ck_every, checkpoint_path=ck_path,
-                supervise=supervise,
+                supervise=supervise, mesh=mesh,
             )
-        except (OSError, ValueError, SupervisorError) as e:
+        except (OSError, ValueError, ImportError, SupervisorError) as e:
+            # ImportError: `mesh=N` on a jax-less install (PyBackend
+            # REPL) — the lazy make_mesh import is the first jax touch,
+            # and it must cost one error line, not the REPL.
             # ValueError: e.g. the spec names ids not in the roster.
             # OSError: an unwritable checkpoint path surfaces from the
             # engine's mid-campaign write — one error line, not a dead

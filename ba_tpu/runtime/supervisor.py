@@ -434,6 +434,14 @@ def supervised_sweep(  # ba-lint: donates(state)
     block (attempts, retries, recoveries, degrades, stalls, lost
     rounds, injected faults, resolved timeout).
 
+    MESH (ISSUE 8): ``mesh=`` passes through to the engine like any
+    other dial, and recovery works unchanged — checkpoints are
+    device-count-free (gather-on-write), every resume re-splits the
+    carry for the attempt's mesh (reshard-on-read), and the rows
+    history the supervisor persists is already host-tree-reduced to
+    canonical shapes, so the stitched result is bit-identical at any
+    device count (pinned by the mesh fatal-recovery test).
+
     DONATION: ``state`` is copied up front (the supervisor may need to
     restart from round 0), so unlike the raw engine the caller's state
     stays live — but callers should not rely on that divergence.

@@ -316,6 +316,22 @@ def read_carry_checkpoint(path: str):
             f"{path!r}: state planes malformed "
             f"(faulty {fields['faulty'].shape}, alive {fields['alive'].shape})"
         )
+    layout = meta.get("shard_layout")
+    if layout is not None and (
+        not isinstance(layout, dict)
+        or not layout
+        or not all(
+            isinstance(k, str) and isinstance(v, int) and v >= 1
+            for k, v in layout.items()
+        )
+    ):
+        # Provenance only (the arrays are canonical / device-count-free,
+        # ISSUE 8), but a malformed layout means a corrupted or
+        # hand-edited header — refuse like any other schema break.
+        raise ValueError(
+            f"{path!r}: malformed shard_layout {layout!r} (want "
+            f"{{axis: devices >= 1}})"
+        )
     names = meta.get("counter_names")
     if "counters" in fields:
         if not isinstance(names, list) or len(names) != fields[

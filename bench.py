@@ -1490,6 +1490,266 @@ raise SystemExit("unreachable: the kill fault must have fired")
     }
 
 
+_MULTICHIP_CHILD = r'''
+import dataclasses, hashlib, json, sys, time
+
+import numpy as np
+
+import jax
+import jax.random as jr
+
+from ba_tpu.parallel import fresh_copy, make_mesh, make_sweep_state
+from ba_tpu.parallel.pipeline import scenario_sweep
+from ba_tpu.scenario.compile import block_from_kills
+
+cfg = json.loads(sys.argv[1])
+b0, cap, rounds, kpd = cfg["b0"], cfg["cap"], cfg["rounds"], cfg["kpd"]
+
+
+def digest(*arrays):
+    h = hashlib.sha256()
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a))
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def campaign(batch):
+    rng = np.random.default_rng(41)
+    kills = rng.random((rounds, batch, cap)) < 0.02
+    state = make_sweep_state(jr.key(40), batch, cap)
+    return state, block_from_kills(kills)
+
+
+def run(batch, mesh, state, block, **kw):
+    return scenario_sweep(
+        jr.key(42), fresh_copy(state), block,
+        rounds_per_dispatch=kpd, collect_decisions=True, mesh=mesh,
+        **kw,
+    )
+
+
+try:
+    if cfg["role"] == "resume":
+        # Reshard-on-read leg: resume the d=8 checkpoint on a (d',1)
+        # mesh in THIS process (device count forced smaller via
+        # XLA_FLAGS by the parent) and report the tail digest.
+        d = cfg["d"]
+        mesh = make_mesh((d, 1), ("data", "node")) if d > 1 else None
+        state, block = campaign(cfg["batch"])
+        tail = scenario_sweep(
+            None, None, block, rounds_per_dispatch=kpd,
+            collect_decisions=True, mesh=mesh, resume=cfg["ckpt"],
+        )
+        print(json.dumps({
+            "devices": len(jax.devices()),
+            "tail_digest": digest(
+                tail["decisions"], tail["leaders"],
+                tail["counters_per_round"],
+            ),
+            "counters": tail["counters"],
+        }))
+        sys.exit(0)
+
+    result = {"devices": len(jax.devices())}
+
+    # -- bit-exactness at EQUAL shapes: d=1 vs d=8, same key/campaign --
+    state, block = campaign(b0)
+    mesh8 = make_mesh((8, 1), ("data", "node"))
+    plain = run(b0, None, state, block)
+    sharded = run(b0, mesh8, state, block)
+    same = (
+        (plain["decisions"] == sharded["decisions"]).all()
+        and (plain["leaders"] == sharded["leaders"]).all()
+        and (plain["counters_per_round"]
+             == sharded["counters_per_round"]).all()
+        and (plain["histograms"] == sharded["histograms"]).all()
+    )
+    result["parity"] = {
+        "bit_exact": bool(same),
+        "batch": b0,
+        "counters": plain["counters"],
+    }
+
+    # -- weak scaling: B grows with d; per-device bytes must not -------
+    legs = []
+    for d in cfg["scaling_d"]:
+        batch = b0 * d
+        mesh = make_mesh((d, 1), ("data", "node")) if d > 1 else None
+        state, block = campaign(batch)
+        states = [fresh_copy(state) for _ in range(3)]
+        run(batch, mesh, states[0], block)  # warm/compile off the clock
+        t_best = float("inf")
+        for r in range(2):
+            t0 = time.perf_counter()
+            out = run(batch, mesh, states[1 + r], block)
+            t_best = min(t_best, time.perf_counter() - t0)
+        st = out["stats"]
+        legs.append({
+            "d": d, "batch": batch,
+            "elapsed_s": round(t_best, 4),
+            "rounds_per_sec": round(batch * rounds / t_best, 1),
+            "plane_peak_bytes": st["plane_peak_bytes"],
+            "plane_peak_bytes_per_shard": st["plane_peak_bytes_per_shard"],
+            "carry_bytes_per_shard": st["carry_bytes_per_shard"],
+        })
+    result["weak_scaling"] = legs
+
+    # -- checkpoint on d=8 for the parent's d' resume leg --------------
+    state, block = campaign(cfg["batch_ckpt"])
+    full = run(cfg["batch_ckpt"], mesh8, state, block)
+    ck_round = (rounds // 2) // kpd * kpd
+    state, block = campaign(cfg["batch_ckpt"])
+    run(
+        cfg["batch_ckpt"], mesh8, state, block,
+        checkpoint_every=ck_round, checkpoint_path=cfg["ckpt"],
+    )
+    result["checkpoint"] = {
+        "written_on_d": 8,
+        "round": ck_round,
+        "tail_digest": digest(
+            full["decisions"][ck_round:], full["leaders"][ck_round:],
+            full["counters_per_round"][ck_round:],
+        ),
+        "counters": full["counters"],
+    }
+    print(json.dumps(result))
+except ValueError as e:
+    # One line, never a traceback: the parent surfaces mesh/layout
+    # errors (e.g. an oversized make_mesh request) as a skip reason.
+    print(json.dumps({"error": str(e)}))
+    sys.exit(3)
+'''
+
+
+def bench_multichip(jax, jnp, jr):
+    """Mesh-sharded engine A/B on a forced 8-device CPU mesh (ISSUE 8
+    acceptance; the committed artifact is MULTICHIP_r06.json).  Three
+    pins:
+
+    1. **Bit-exactness at equal shapes** — the same campaign (key,
+       states, kill schedule) through the single-device engine and the
+       8×1 ``shard_map`` engine: decisions, leaders, histograms and
+       every counter row must match bit-for-bit.
+    2. **Weak scaling** — B grows with the device count (d in {1, 2, 8},
+       B = B0·d) while per-device peak plane/carry bytes stay bounded by
+       the B0 figure (the 1/d memory claim — deterministic, asserted);
+       wall time is reported per leg with the host's physical core count
+       attached, because 8 VIRTUAL cpu devices cannot beat the machine's
+       real parallelism (the flat-wall-time reading needs >= d cores —
+       on TPU, d chips).
+    3. **Checkpoint reshard** — a campaign checkpointed mid-flight on
+       d=8 resumes on d'=2 in a separate 2-device process
+       (gather-on-write / reshard-on-read), tail bit-identical to the
+       uninterrupted run.
+
+    Every leg runs in a child process: the device count
+    (``--xla_force_host_platform_device_count``, the exact layout
+    tests/multihost_worker.py uses) must be fixed before jax
+    initializes.
+    """
+    import subprocess
+    import tempfile
+
+    b0 = int(os.environ.get("BA_TPU_BENCH_MC_BATCH", 256))
+    cap = int(os.environ.get("BA_TPU_BENCH_MC_CAP", 16))
+    rounds = int(os.environ.get("BA_TPU_BENCH_MC_ROUNDS", 64))
+    kpd = int(os.environ.get("BA_TPU_BENCH_MC_KPD", 8))
+    batch_ckpt = b0 // 4 * 8  # d=8-divisible, small enough for d'=2 leg
+
+    def child(n_devices, cfg, timeout):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={n_devices}",
+        )
+        # The virtual-device flag must not collide with an inherited one.
+        proc = subprocess.run(
+            [sys.executable, "-c", _MULTICHIP_CHILD, json.dumps(cfg)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            tail = (proc.stdout + proc.stderr).strip().splitlines()
+            line = tail[-1] if tail else "no output"
+            try:
+                line = json.loads(line).get("error", line)
+            except ValueError:
+                pass
+            # One line, never a traceback (ISSUE 8 satellite).
+            print(f"bench: multichip leg failed: {line}", file=sys.stderr)
+            return None
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = os.path.join(tmp, "mc_{round}.npz")
+        base = {
+            "b0": b0, "cap": cap, "rounds": rounds, "kpd": kpd,
+            "ckpt": ckpt, "batch_ckpt": batch_ckpt,
+        }
+        main = child(
+            8, dict(base, role="main", scaling_d=[1, 2, 8]), timeout=1800
+        )
+        if main is None:
+            return {"skipped": "multichip main leg failed (see stderr)"}
+        resume = child(
+            2,
+            dict(base, role="resume", d=2, batch=batch_ckpt,
+                 ckpt=ckpt.replace(
+                     "{round}", str(main["checkpoint"]["round"]))),
+            timeout=900,
+        )
+    legs = {leg["d"]: leg for leg in main["weak_scaling"]}
+    reshard_exact = (
+        resume is not None
+        and resume["tail_digest"] == main["checkpoint"]["tail_digest"]
+    )
+    return {
+        # Headline rate = the full-mesh leg (bench.py's primary-config
+        # contract expects one).
+        "rounds_per_sec": legs[8]["rounds_per_sec"],
+        "devices": main["devices"],
+        "host_cpus": os.cpu_count(),
+        "bit_exact_d1_vs_d8": main["parity"]["bit_exact"],
+        "weak_scaling": main["weak_scaling"],
+        "wall_ratio_d8_vs_d1_at_8x_batch": round(
+            legs[8]["elapsed_s"] / legs[1]["elapsed_s"], 3
+        ),
+        "wall_ratio_d2_vs_d1_at_2x_batch": round(
+            legs[2]["elapsed_s"] / legs[1]["elapsed_s"], 3
+        ),
+        "plane_bytes_per_shard_bounded_by_B_over_d": all(
+            leg["plane_peak_bytes_per_shard"] <= legs[1]["plane_peak_bytes"]
+            for leg in main["weak_scaling"]
+        ),
+        "carry_bytes_per_shard_bounded_by_B_over_d": all(
+            leg["carry_bytes_per_shard"] <= legs[1]["carry_bytes_per_shard"]
+            + 64  # replicated 12-byte schedule + [d,C] counter rows
+            for leg in main["weak_scaling"]
+        ),
+        "checkpoint_reshard_d8_to_d2_bit_exact": bool(reshard_exact),
+        "checkpoint_round": main["checkpoint"]["round"],
+        "rounds": rounds, "b0": b0, "n_max": cap,
+        "rounds_per_dispatch": kpd,
+        "scenario_counters_d1": main["parity"]["counters"],
+        "bound": "per-device memory: staged event planes and the donated "
+                 "carry split B/d per chip (asserted); wall time: weak "
+                 "scaling is flat only up to the host's REAL parallelism "
+                 "— 8 virtual CPU devices share host_cpus cores here, so "
+                 "the d=8 leg measures sharding overhead at core "
+                 "saturation, not chip scaling (the TPU reading is d "
+                 "real chips)",
+        "note": "all legs in child processes (the forced device count "
+                "must precede jax init); bit-exactness = decisions + "
+                "leaders + histograms + all counter rows compared "
+                "elementwise at equal shapes; reshard = sha256 over the "
+                "resumed tail's decisions/leaders/counter rows vs the "
+                "uninterrupted d=8 run",
+    }
+
+
 def bench_failover_sweep(jax, jnp, jr):
     """On-device failure detection + re-election throughput (VERDICT r3
     weak #6: the subsystem was tested and dry-run but never measured).
@@ -1975,16 +2235,19 @@ CONFIGS = {
     "scenario_sweep": bench_scenario_sweep,
     "scenario_long": bench_scenario_long,
     "resilience": bench_resilience,
+    "multichip": bench_multichip,
     "sweep10k_signed": bench_sweep10k_signed,
     "sm1_n64_signed": bench_sm1_n64_signed,
 }
 
 # scenario_long runs a quarter-million-round campaign (minutes of wall
-# clock by design), and resilience SIGKILLs a child process that pays a
-# fresh jax import + compile — both opt in explicitly:
-# `--configs scenario_long` / `--configs resilience`.
+# clock by design), resilience SIGKILLs a child process that pays a
+# fresh jax import + compile, and multichip spawns forced-8-device
+# children (the device count must precede jax init) — all opt in
+# explicitly: `--configs scenario_long` / `resilience` / `multichip`.
 DEFAULT_CONFIGS = [
-    n for n in CONFIGS if n not in ("scenario_long", "resilience")
+    n for n in CONFIGS
+    if n not in ("scenario_long", "resilience", "multichip")
 ]
 
 

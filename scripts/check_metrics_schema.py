@@ -75,6 +75,20 @@ def main() -> int:
             rounds_per_dispatch=2, checkpoint_every=2,
             checkpoint_path=ck_path,
         )
+        # Mesh-engine records (ISSUE 8): one campaign through the
+        # sharded scan core (a 1x1 mesh — the sharded CODE PATH, no
+        # device-count assumption on this host) drives the shard_layout
+        # field on scenario_checkpoint and the per-shard gauges the
+        # final metrics_snapshot must carry.
+        from ba_tpu.parallel import make_mesh
+
+        pipeline_sweep(
+            jr.key(8), make_sweep_state(jr.key(9), 4, 4), 4,
+            scenario=compile_scenario(spec, 4, 4, sparse=True),
+            rounds_per_dispatch=2, checkpoint_every=2,
+            checkpoint_path=path + ".mesh_carry.npz",
+            mesh=make_mesh((1, 1), ("data", "node")),
+        )
         # Resilience records (ISSUE 7): a tiny supervised run with a
         # chaos plan drives the real fault_injected (chaos.py) and
         # recovery (supervisor.py) emitters — one in-place transient
@@ -192,12 +206,21 @@ def main() -> int:
                     )
                     bad += 1
             elif rec.get("event") == "scenario_checkpoint":
+                layout = rec.get("shard_layout")
                 if not (
                     isinstance(rec.get("round"), int)
                     and isinstance(rec.get("rounds"), int)
                     and isinstance(rec.get("bytes"), int)
                     and isinstance(rec.get("scenario"), bool)
                     and isinstance(rec.get("path"), str)
+                    and isinstance(layout, dict)
+                    and layout
+                    and all(
+                        isinstance(k, str)
+                        and isinstance(v, int)
+                        and v >= 1
+                        for k, v in layout.items()
+                    )
                 ):
                     print(
                         f"schema check: line {i} malformed "
@@ -205,6 +228,27 @@ def main() -> int:
                         file=sys.stderr,
                     )
                     bad += 1
+            elif rec.get("event") == "metrics_snapshot":
+                # Shard-labeled gauges (ISSUE 8): the engine stamps the
+                # device count and per-device carry/plane byte shares
+                # after every sweep — the weak-scaling denominators.
+                metrics_blk = rec.get("metrics", {})
+                for g in (
+                    "pipeline_shards",
+                    "pipeline_carry_bytes_per_shard",
+                    "scenario_plane_bytes_per_shard",
+                ):
+                    snap = metrics_blk.get(g)
+                    if not (
+                        isinstance(snap, dict)
+                        and isinstance(snap.get("value"), (int, float))
+                    ):
+                        print(
+                            f"schema check: line {i} metrics_snapshot "
+                            f"missing/malformed gauge {g}: {line[:160]}",
+                            file=sys.stderr,
+                        )
+                        bad += 1
         want = {
             "agreement_round",
             "metrics_snapshot",
@@ -227,8 +271,9 @@ def main() -> int:
         return 0
     finally:
         os.unlink(path)
-        if os.path.exists(path + ".carry.npz"):
-            os.unlink(path + ".carry.npz")
+        for ck in (".carry.npz", ".mesh_carry.npz"):
+            if os.path.exists(path + ck):
+                os.unlink(path + ck)
         import glob
 
         for stray in glob.glob(path + ".sup_*"):

@@ -133,6 +133,11 @@ def _mut102(key):
     return _mut_jr.split(key)' || exit 1
 mutate_and_expect BA301 core/om.py \
     'from ba_tpu import obs as _mut_obs' || exit 1
+# ISSUE 8: the mesh scan core (parallel/shard.py) joined the BA101
+# hot-path scope — prove the extension is live, not just declared.
+mutate_and_expect BA101 parallel/shard.py \
+    'def _mut101_shard(x):
+    return x.block_until_ready()' || exit 1
 
 echo "== scenario spec round-trip =="
 # ISSUE 5: the committed campaign specs must load, validate, round-trip
@@ -162,6 +167,20 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q \
         -k "classify or backoff or derive_timeout or fault_plan or chaos_cli" \
         -p no:cacheprovider; then
     echo "chaos smoke tests failed" >&2
+    exit 1
+fi
+
+echo "== mesh parity (forced 8-device host platform) =="
+# ISSUE 8: the sharded engine's bit-exactness, counter tree-reduction
+# and no-blocking proofs on a live 8x1 mesh, pinned under the exact XLA
+# flag tests/multihost_worker.py uses.  tests/conftest.py forces 8
+# virtual devices for tier-1 anyway; this stage keeps the mesh contract
+# pinned even if that default ever moves.
+if ! XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_pipeline.py tests/test_scenario.py tests/test_parallel.py \
+        -q -k "mesh" -p no:cacheprovider; then
+    echo "mesh parity tests failed" >&2
     exit 1
 fi
 

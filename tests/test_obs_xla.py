@@ -192,6 +192,33 @@ def test_backend_capacity_recompile_is_attributed(fresh_obs):
     assert caps == [4, 8]
 
 
+def test_mesh_device_count_recompile_is_attributed(fresh_obs, eight_devices):
+    # ISSUE 8 satellite: the mesh data-axis SIZE rides the engine's
+    # named-axes signature, so moving a sweep from one device to an 8x1
+    # mesh at EQUAL shapes reads as `"data": [1, 8]` in the recompile
+    # record instead of an unexplained re-specialization.  (The same
+    # axes feed the cross-run compile ledger's signatures.)
+    import jax.random as jr
+
+    from ba_tpu.parallel import make_mesh, make_sweep_state, pipeline_sweep
+    from ba_tpu.parallel.pipeline import fresh_copy
+
+    state = make_sweep_state(jr.key(1), 16, 8)
+    pipeline_sweep(jr.key(0), fresh_copy(state), 2, rounds_per_dispatch=2)
+    mesh = make_mesh((8, 1), ("data", "node"))
+    pipeline_sweep(
+        jr.key(0), state, 2, rounds_per_dispatch=2, mesh=mesh
+    )
+    metrics.default_sink().close()
+    recs = [
+        r for r in _records(fresh_obs, "recompile")
+        if r["fn"] == "pipeline_megastep"
+    ]
+    assert len(recs) == 1
+    assert recs[0]["changed"] == {"data": [1, 8]}
+    assert recs[0]["axes"]["data"] == 8
+
+
 # -- 2b. cross-run recompile ledger (ISSUE 6) ---------------------------------
 
 

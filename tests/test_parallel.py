@@ -271,6 +271,21 @@ def test_eig_node_sharded_dead_general(mesh42):
     assert (np.asarray(out["decision"]) == ATTACK).all()
 
 
+def test_make_mesh_oversized_request_names_counts(eight_devices):
+    # ISSUE 8 satellite: an oversized mesh request used to die inside
+    # jax.sharding.Mesh with an opaque reshape error; now the error
+    # names available vs requested so REPL/bench can print one line.
+    import jax
+
+    n_avail = len(jax.devices())
+    with pytest.raises(ValueError, match=rf"needs 999 .* {n_avail}"):
+        make_mesh((999, 1), ("data", "node"))
+    with pytest.raises(ValueError, match="all-positive"):
+        make_mesh((0, 1), ("data", "node"))
+    with pytest.raises(ValueError, match="axis"):
+        make_mesh((2, 2, 2), ("data", "node"))
+
+
 # -- multi-host mesh helpers (single-process degenerate form) -----------------
 
 

@@ -304,20 +304,85 @@ def test_pipeline_host_work_overlaps_dispatches():
 
 
 def test_pipeline_mesh_composes_bit_exact(eight_devices):
-    # sharded_sweep's data-axis layout applies unchanged, and sharding
-    # must not change a single bit of the results.
+    # ISSUE 8: the shard_map scan core must not change a single bit of
+    # the results at equal shapes — decisions, histograms, AND the
+    # counter block (per-shard on device, tree-reduced at retire; the
+    # unanimity verdict crosses shards via the in-scan psum).
     mesh = make_mesh((8, 1), ("data", "node"))
     key = jr.key(31)
     state = make_sweep_state(jr.key(7), 64, 16, order=ATTACK)
     plain = pipeline_sweep(
-        key, _fresh(state), 6, rounds_per_dispatch=3, collect_decisions=True
+        key, _fresh(state), 6, rounds_per_dispatch=3,
+        collect_decisions=True, with_counters=True,
     )
     sharded = pipeline_sweep(
         key, state, 6, rounds_per_dispatch=3, collect_decisions=True,
-        mesh=mesh,
+        with_counters=True, mesh=mesh,
     )
     np.testing.assert_array_equal(plain["decisions"], sharded["decisions"])
     np.testing.assert_array_equal(plain["histograms"], sharded["histograms"])
+    np.testing.assert_array_equal(
+        plain["counters_per_round"], sharded["counters_per_round"]
+    )
+    assert plain["counters"] == sharded["counters"]
+    assert sharded["stats"]["shards"] == 8
+    # The live continuation block is per-shard [d, C]; its shard sum is
+    # the canonical block.
+    assert sharded["final_counters"].shape == (8, len(COUNTER_NAMES))
+    np.testing.assert_array_equal(
+        np.asarray(sharded["final_counters"]).sum(axis=0),
+        np.array([plain["counters"][n] for n in COUNTER_NAMES]),
+    )
+    # Per-device carry bytes genuinely shrink: the sharded carry's
+    # per-device share is well under the whole single-device carry.
+    assert (
+        sharded["stats"]["carry_bytes_per_shard"]
+        < plain["stats"]["carry_bytes_per_shard"]
+    )
+
+
+def test_pipeline_mesh_no_blocking_dispatch_count(eight_devices, monkeypatch):
+    # ISSUE 8: the no-blocking dispatch-count proof re-run on a LIVE
+    # 8x1 mesh with counters on — sharding must not introduce a host
+    # sync anywhere (the per-shard blocks reduce inside the existing
+    # retire fetch; the only in-scan collective is the device-side
+    # histogram psum, invisible to the host schedule).
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    mesh = make_mesh((8, 1), ("data", "node"))
+    B, cap, R, depth = 16, 8, 7, 3
+    state = make_sweep_state(jr.key(5), B, cap)
+    events = []
+    out = pipeline_sweep(
+        jr.key(23), state, R,
+        depth=depth, rounds_per_dispatch=1, with_counters=True, mesh=mesh,
+        on_event=lambda kind, i: events.append((kind, i)),
+    )
+    assert [i for kind, i in events if kind == "dispatch"] == list(range(R))
+    assert [i for kind, i in events if kind == "retire"] == list(range(R))
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [("dispatch", i) for i in range(depth + 1)]
+    for r in range(R - depth):
+        assert events.index(("retire", r)) > events.index(("dispatch", r + depth))
+    assert out["stats"]["max_in_flight"] == depth + 1
+    assert out["stats"]["retires_before_drain"] == R - depth
+    assert out["stats"]["shards"] == 8
+
+
+def test_pipeline_mesh_validation_errors(eight_devices):
+    mesh = make_mesh((8, 1), ("data", "node"))
+    # Batch 12 cannot split 8 ways: eager, named error — never an XLA
+    # shape failure after the carry entered the donation thread.
+    state = make_sweep_state(jr.key(9), 12, 8)
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_sweep(jr.key(0), state, 2, mesh=mesh)
+    # A mesh without the engine's "data" axis names the problem too.
+    odd = make_mesh((8,), ("model",))
+    state = make_sweep_state(jr.key(9), 16, 8)
+    with pytest.raises(ValueError, match="no 'data' axis"):
+        pipeline_sweep(jr.key(0), state, 2, mesh=odd)
 
 
 def test_pipeline_validates_arguments():

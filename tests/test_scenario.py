@@ -603,13 +603,21 @@ def test_sm_strategies_incompatible_modes_raise():
 # -- counters: device fold bit-matches host derivation ------------------------
 
 
-def test_scenario_counters_bit_match_host_derivation_kill_mid_campaign():
+@pytest.mark.parametrize("shards", [1, 8])
+def test_scenario_counters_bit_match_host_derivation_kill_mid_campaign(
+    shards,
+):
     # ISSUE 5 satellite (extends PR 4's bit-match): the 5-entry scenario
     # block folded in-scan — agreement counters AND IC1/IC2 verdicts —
     # must bit-match the same counts derived on host from the blocking
     # reference driver, across a campaign that kills a leader and flips
     # strategies mid-flight.  The first three entries ARE the PR 4
     # block (protocol-agnostic: everything reads step outputs + state).
+    # shards=8 (ISSUE 8) re-runs the proof through the mesh scan core:
+    # the per-shard blocks tree-reduced at retire must bit-match the
+    # same host derivation.
+    if shards > 1 and len(jax.devices()) < shards:
+        pytest.skip(f"needs {shards} virtual devices")
     B, cap, R = 16, 8, 6
     key = jr.key(37)
     state = make_sweep_state(jr.key(36), B, cap, order=ATTACK)
@@ -688,6 +696,9 @@ def test_scenario_counters_bit_match_host_derivation_kill_mid_campaign():
     got = scenario_sweep(
         key, _fresh(state), block,
         depth=2, rounds_per_dispatch=2, collect_decisions=True,
+        mesh=(
+            make_mesh((shards, 1), ("data", "node")) if shards > 1 else None
+        ),
     )
     np.testing.assert_array_equal(got["decisions"], np.stack(ref_decisions))
     np.testing.assert_array_equal(got["leaders"], np.stack(ref_leaders))
@@ -923,6 +934,185 @@ def test_sparse_depth_k_no_blocking_with_staging_and_checkpoints(
     assert (tmp_path / "nb_6.npz").exists()
 
 
+def test_mesh_depth_k_no_blocking_with_staging_and_checkpoints(
+    monkeypatch, tmp_path
+):
+    # ISSUE 8: the dispatch-count proof on a LIVE 8x1 MESH with the full
+    # streaming stack armed — sparse block, per-shard double-buffered
+    # staging, carry checkpoints (gather-on-write) — and still no host
+    # sync beyond the depth-delayed retires: the per-shard counter/
+    # histogram reduction is host arithmetic inside the existing fetch.
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    mesh = make_mesh((8, 1), ("data", "node"))
+    B, cap, R, depth = 16, 8, 7, 3
+    state = make_sweep_state(jr.key(55), B, cap)
+    spec = from_dict(
+        {
+            "name": "mesh-noblock",
+            "rounds": R,
+            "events": [
+                {"round": 2, "kill": [1]},
+                {"round": 4, "kill": [2]},
+            ],
+        }
+    )
+    sparse = compile_scenario(spec, B, cap, sparse=True)
+    events = []
+    out = scenario_sweep(
+        jr.key(56), state, sparse,
+        depth=depth, rounds_per_dispatch=1, mesh=mesh,
+        on_event=lambda kind, i: events.append((kind, i)),
+        checkpoint_every=3,
+        checkpoint_path=str(tmp_path / "mnb_{round}.npz"),
+    )
+    assert [i for kind, i in events if kind == "dispatch"] == list(range(R))
+    assert [i for kind, i in events if kind == "retire"] == list(range(R))
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [("dispatch", i) for i in range(depth + 1)]
+    for r in range(R - depth):
+        assert events.index(("retire", r)) > events.index(("dispatch", r + depth))
+    assert out["stats"]["max_in_flight"] == depth + 1
+    assert out["stats"]["shards"] == 8
+    # Per-shard staging: one device holds 1/8 of each staged chunk.
+    assert out["stats"]["plane_peak_bytes_per_shard"] == (
+        out["stats"]["plane_peak_bytes"] // 8
+    )
+    assert (tmp_path / "mnb_3.npz").exists()
+    # Gather-on-write: the checkpoint's counter block is canonical (1-D)
+    # and the layout header records the writing mesh.
+    ck = load_carry_checkpoint(str(tmp_path / "mnb_3.npz"))
+    assert ck.counters.ndim == 1
+    assert ck.shard_layout == {"data": 8, "node": 1}
+
+
+def test_checkpoint_reshard_d8_to_d2_subprocess_bit_exact(tmp_path):
+    # ISSUE 8 acceptance: a campaign checkpointed on EIGHT devices in a
+    # separate process resumes HERE on a 2x1 mesh (and the same carry on
+    # a single device), every tail bit-identical to the uninterrupted
+    # run — gather-on-write / reshard-on-read, across a process
+    # boundary.
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    R = 8
+    key, state, block = _mid_campaign_setup(R)
+    full = scenario_sweep(
+        key, _fresh(state), block, rounds_per_dispatch=2,
+        collect_decisions=True,
+    )
+    ck_path = tmp_path / "reshard_{round}.npz"
+    child = f'''
+import dataclasses, jax.random as jr
+from ba_tpu.parallel import make_mesh, make_sweep_state, pipeline_sweep
+from ba_tpu.scenario import compile_scenario, from_dict
+
+key = jr.key(91)
+state = make_sweep_state(jr.key(90), 16, 8, order=1)
+state = dataclasses.replace(
+    state, faulty=state.faulty.at[:8, 0].set(True)
+)
+spec = from_dict({{
+    "name": "ckpt-campaign", "rounds": {R}, "order": "attack",
+    "events": [
+        {{"round": 2, "kill": [1]}},
+        {{"round": 5, "set_faulty": [3], "value": True}},
+        {{"round": 6, "set_strategy": [3], "value": "adaptive_split"}},
+    ],
+}})
+block = compile_scenario(spec, 16, 8, sparse=True)
+mesh = make_mesh((8, 1), ("data", "node"))
+out = pipeline_sweep(
+    key, state, {R}, scenario=block, rounds_per_dispatch=2, mesh=mesh,
+    checkpoint_every=4, checkpoint_path={str(ck_path)!r},
+)
+assert out["stats"]["shards"] == 8
+'''
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", child],
+        capture_output=True, text=True, cwd=str(repo), timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    mid = tmp_path / "reshard_4.npz"
+    assert mid.exists()
+    ck = load_carry_checkpoint(str(mid))
+    assert ck.shard_layout == {"data": 8, "node": 1}
+    for mesh in (make_mesh((2, 1), ("data", "node")), None):
+        tail = pipeline_sweep(
+            None, None, R, scenario=block, rounds_per_dispatch=2,
+            collect_decisions=True, resume=str(mid), mesh=mesh,
+        )
+        np.testing.assert_array_equal(
+            tail["decisions"], full["decisions"][4:]
+        )
+        np.testing.assert_array_equal(tail["leaders"], full["leaders"][4:])
+        np.testing.assert_array_equal(
+            tail["counters_per_round"], full["counters_per_round"][4:]
+        )
+        assert tail["counters"] == full["counters"]
+
+
+def test_mesh_resume_of_in_memory_per_shard_carry(tmp_path):
+    # The in-memory path of the same invariant: final_counters from a
+    # mesh run is per-shard [d, C]; resuming it — via a saved
+    # checkpoint on a DIFFERENT mesh size, or collapsing to a single
+    # device — keeps totals bit-exact (the sum is the invariant).
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    R = 8
+    key, state, block = _mid_campaign_setup(R)
+    full = scenario_sweep(
+        key, _fresh(state), block, rounds_per_dispatch=2,
+        collect_decisions=True,
+    )
+    mesh8 = make_mesh((8, 1), ("data", "node"))
+    head_ck = str(tmp_path / "head_{round}.npz")
+    scenario_sweep(
+        key, _fresh(state), block, rounds_per_dispatch=2, mesh=mesh8,
+        checkpoint_every=4, checkpoint_path=head_ck,
+    )
+    ck = load_carry_checkpoint(str(tmp_path / "head_4.npz"))
+    # save_carry_checkpoint round-trips a carry whose counters were
+    # expanded per-shard in memory: seed one by hand.
+    from ba_tpu.parallel import CarryCheckpoint
+    from ba_tpu.parallel.shard import expand_counters
+
+    per_shard = CarryCheckpoint(
+        state=ck.state, schedule=ck.schedule,
+        counters=expand_counters(mesh8, ck.counters),
+        strategy=ck.strategy, round=ck.round,
+        shard_layout={"data": 8, "node": 1},
+    )
+    path2 = str(tmp_path / "pershard.npz")
+    save_carry_checkpoint(path2, per_shard)
+    re = load_carry_checkpoint(path2)
+    assert re.counters.ndim == 1
+    np.testing.assert_array_equal(
+        np.asarray(re.counters), np.asarray(ck.counters)
+    )
+    tail = scenario_sweep(
+        None, None, block, rounds_per_dispatch=2,
+        collect_decisions=True, resume=per_shard,
+        mesh=make_mesh((4, 1), ("data", "node")),
+    )
+    np.testing.assert_array_equal(tail["decisions"], full["decisions"][4:])
+    assert tail["counters"] == full["counters"]
+
+
 # -- checkpointed carries (ISSUE 6 tentpole piece 3) --------------------------
 
 
@@ -1117,6 +1307,20 @@ def test_checkpoint_schema_rejects_corruption(tmp_path):
         fh.write(head)
     with pytest.raises(ValueError, match="not a readable"):
         read_carry_checkpoint(bad)
+    # A malformed shard-layout header (ISSUE 8) is a schema break like
+    # any other; absence stays tolerated (pre-mesh checkpoints).
+    assert meta["shard_layout"] == {"data": 1}
+    write_carry_checkpoint(
+        bad, arrays, dict(meta, shard_layout={"data": 0})
+    )
+    with pytest.raises(ValueError, match="shard_layout"):
+        read_carry_checkpoint(bad)
+    write_carry_checkpoint(bad, arrays, dict(meta, shard_layout="8x1"))
+    with pytest.raises(ValueError, match="shard_layout"):
+        read_carry_checkpoint(bad)
+    legacy = {k: v for k, v in meta.items() if k != "shard_layout"}
+    write_carry_checkpoint(bad, arrays, legacy)
+    read_carry_checkpoint(bad)  # no layout: reads fine
 
 
 def test_checkpoint_emits_jsonl_record(tmp_path):
@@ -1344,7 +1548,23 @@ def test_repl_scenario_command_guards(tmp_path):
     )
     assert out == ["scenario error: too many arguments "
                    "(usage: scenario <file> [<ckpt-path> <every>] "
-                   "[supervise])"]
+                   "[supervise] [mesh=N])"]
+    # mesh=1 (ISSUE 8) routes the B=1 campaign through the sharded scan
+    # core and still prints the normal result lines.
+    out = []
+    assert handle_command(jx, f"scenario {path} mesh=1", out.append)
+    assert out and out[0].startswith("Scenario s:")
+    # Oversized meshes surface the engine's/make_mesh's clear message as
+    # ONE line — the interactive batch is 1, so mesh=8 cannot split it
+    # (and mesh=9999 cannot even build on this host).
+    for bad_tok in ("mesh=8", "mesh=9999"):
+        out = []
+        assert handle_command(jx, f"scenario {path} {bad_tok}", out.append)
+        assert len(out) == 1 and out[0].startswith("scenario error:")
+    out = []
+    assert handle_command(jx, f"scenario {path} mesh=zero", out.append)
+    assert out == ["scenario error: mesh= wants a device count, "
+                   "got 'zero'"]
 
 
 def test_cluster_scenario_emits_campaign_record(tmp_path):

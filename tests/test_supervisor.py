@@ -499,6 +499,41 @@ def test_supervised_fatal_recovers_from_checkpoint_bit_exact(tmp_path):
     )
 
 
+def test_supervised_fatal_recovery_on_mesh_bit_exact(tmp_path):
+    # ISSUE 8: the supervisor's auto-resume works UNCHANGED on a mesh —
+    # a fatal mid-campaign fault on an 8x1 sharded campaign recovers
+    # from the (canonical, gather-on-write) checkpoint, re-splits the
+    # carry on read, and completes bit-identical to the uninterrupted
+    # SINGLE-DEVICE run (the strongest form: recovery + resharding +
+    # sharded re-execution, one assertion).
+    import jax
+
+    from ba_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    R = 12
+    key, state, block = _campaign_setup(R)
+    want = _baseline(key, state, block, R)
+    plan = chaos.from_dict(
+        {"name": "mesh-fatal", "faults": [
+            {"round": 8, "kind": "fatal"},
+        ]}
+    )
+    got = supervised_sweep(
+        key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+        collect_decisions=True, chaos=plan,
+        mesh=make_mesh((8, 1), ("data", "node")),
+        checkpoint_every=4,
+        checkpoint_path=str(tmp_path / "mf_{round}.npz"),
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    _assert_bit_identical(got, want)
+    sup = got["supervisor"]
+    assert sup["attempts"] == 2 and sup["recoveries"] == 1
+    assert got["stats"]["shards"] == 8
+
+
 def test_supervised_corrupt_checkpoint_falls_back(tmp_path):
     # The round-4 checkpoint is chaos-corrupted as it is written; the
     # round-8 fatal then forces recovery: the scan quarantines the
