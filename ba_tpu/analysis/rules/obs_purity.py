@@ -27,6 +27,16 @@ paths — e.g. ``crypto/sha512`` -> ``utils/platform`` ->
 ``obs.instrument``) is not an obs reference from the jitted tree, and
 treating it as one would indict every kernel that consults
 ``use_pallas`` at trace time.
+
+The SYMMETRIC direction (ISSUE 9): ``ba_tpu.obs`` modules are
+HOST-TIER by contract — the flight recorder and health sampler must
+stay importable jax-free and must never pull the jitted trees in (an
+obs module importing ``ba_tpu.core``/``ba_tpu.ops`` would make every
+``import ba_tpu.obs`` pay a core import, and tempt device values into
+assembly/sampling paths that run from watchdog threads and atexit
+hooks).  Importing through another obs module whose through-obs
+closure reaches core/ops is flagged at the edge that lets it in, same
+as the forward direction.
 """
 
 from __future__ import annotations
@@ -48,6 +58,14 @@ def _is_obs(target: str) -> bool:
     return target == OBS or target.startswith(OBS + ".")
 
 
+def _is_jit_tree(target: str) -> bool:
+    return _in_scope(target)
+
+
+def _in_obs_scope(modname: str) -> bool:
+    return modname == OBS or modname.startswith(OBS + ".")
+
+
 @register
 class ObsPurity(Rule):
     code = "BA301"
@@ -55,6 +73,9 @@ class ObsPurity(Rule):
     severity = "error"
 
     def check_module(self, mod, project):
+        if _in_obs_scope(mod.modname):
+            yield from self._check_host_tier(mod, project)
+            return
         if not _in_scope(mod.modname):
             return
         # Memoized per Project (rule instances are registry singletons
@@ -105,4 +126,41 @@ class ObsPurity(Rule):
                     "metrics sink emit inside a jitted-tree module — "
                     "the JSONL sink is host-only; emit from the loop "
                     "driver",
+                )
+
+    def _check_host_tier(self, mod, project):
+        """The reverse scope (ISSUE 9): obs modules never import the
+        jitted trees — directly, or through ANY intermediary (unlike
+        the forward rule, the closure here is unfiltered: an obs module
+        pulling ``ba_tpu.parallel`` in would make ``import ba_tpu.obs``
+        pay the core/jax import chain, which is exactly the host-tier
+        breach, whoever sits in the middle)."""
+        seen_lines: set = set()
+
+        def once(node, message):
+            if node.lineno not in seen_lines:
+                seen_lines.add(node.lineno)
+                yield self.finding(mod, node, message)
+
+        for node, target in mod.import_records:
+            if _is_jit_tree(target):
+                yield from once(
+                    node,
+                    f"host-tier obs module imports '{target}' — "
+                    f"ba_tpu.obs must stay importable without the "
+                    f"jitted trees (ba_tpu.core/ba_tpu.ops); observe "
+                    f"their drivers from runtime/ or parallel/ instead",
+                )
+                continue
+            nxt = project.resolve_target_module(target)
+            if (
+                nxt
+                and nxt != mod.modname
+                and any(project.reaches(nxt, scope) for scope in SCOPES)
+            ):
+                yield from once(
+                    node,
+                    f"host-tier obs module imports '{target}', whose "
+                    f"import closure reaches the jitted trees "
+                    f"(ba_tpu.core/ba_tpu.ops) — obs is host-tier",
                 )

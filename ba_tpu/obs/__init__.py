@@ -16,6 +16,16 @@ Three parts, layered bottom-up (docs/DESIGN.md §8):
   donation-alias evidence, ``BA_TPU_HLO`` dumps), the recompile
   explainer (``obs.instrument.classify_compile`` → ``recompile``
   records), and the ``jax.profiler`` capture hook (``BA_TPU_XPROF``).
+- **flight recorder** (``obs.flight``, ISSUE 9): one ``run_id`` per
+  campaign run (``BA_TPU_RUN_ID`` pins; derivation is deterministic)
+  threaded through every record/span/checkpoint-header/ledger-row,
+  and the ``flight_summary`` assembler joining them into one
+  correlated timeline.
+- **health sampler** (``obs.health``, ISSUE 9): lock-free periodic
+  sampling of the registry into a ``health_*`` gauge family, derived
+  live metrics (rounds/s, retire-lag p50/p99, watchdog margin,
+  per-shard imbalance) and ``health_snapshot`` records
+  (``pipeline_sweep(health_every=)``; REPL ``stats --live``).
 
 Everything MODULE-LEVEL here is HOST-side and jax-free (``obs.xla``
 imports jax only inside its opt-in functions): spans and emissions must
@@ -26,7 +36,7 @@ buffers, and triggers no extra compiles — the overhead-guard tests in
 tests/test_obs.py and tests/test_obs_xla.py pin it.
 """
 
-from ba_tpu.obs import instrument, registry, trace, xla
+from ba_tpu.obs import flight, health, instrument, registry, trace, xla
 from ba_tpu.obs.instrument import (
     classify_compile,
     compile_or_dispatch_span,
@@ -47,6 +57,8 @@ __all__ = [
     "default_registry",
     "default_tracer",
     "first_call",
+    "flight",
+    "health",
     "instant",
     "instrument",
     "registry",

@@ -1,0 +1,409 @@
+"""Flight recorder: one correlated ledger per campaign run (ISSUE 9).
+
+After PRs 7+8 a supervised, mesh-sharded campaign tells its story across
+FIVE uncorrelated artifacts — the span trace, the metrics JSONL stream,
+the supervisor's ``recovery``/``fault_injected`` records, the carry
+checkpoints (+ rows sidecars), and the recompile ledger — and an
+operator joining them by hand has nothing to join ON.  This module adds
+the join key and the join:
+
+- **run_id** — every campaign run gets one: ``BA_TPU_RUN_ID`` pins it
+  (deterministic by fiat — CI and chaos drills set it), otherwise it is
+  DERIVED (sha256 over the campaign's key material/rounds/scenario — the
+  same identity the supervisor fingerprints), so a killed process's
+  successor re-derives the SAME id and the two processes' records read
+  as one run.  While a run scope is active the JSONL sink stamps
+  ``run_id`` on every record (``utils/metrics.py``), the tracer stamps
+  it on every span/instant, the engine writes it into checkpoint
+  ``__meta__`` headers, and the cross-run compile ledger rides it on its
+  stored rows.
+- **run_scope** — the ownership discipline: ``pipeline_sweep`` and
+  ``supervised_sweep`` both open a scope, but scopes NEST (the
+  supervisor's attempts inherit its id), and only the OUTERMOST owner
+  assembles and emits the ``flight_summary`` record at the end.
+- **FlightLog / assemble_flight** — the post-hoc join: parse the JSONL
+  stream, select one run's records, dedup replayed dispatch windows
+  (recoveries re-dispatch from the resume point — the assembled
+  timeline must cover every round exactly once), and emit ONE versioned
+  ``{"event": "flight_summary", "v": 1}`` record: dispatch→retire→
+  checkpoint→recovery causality, per-shard byte/layout provenance
+  (ISSUE 8's ``shard_layout``), and recompile attribution by named
+  axis.  ``scripts/obs_report.py --flight`` renders it.
+
+Pure stdlib, jax-free, numpy-free: the assembler must run anywhere the
+JSONL was copied to (checkpoint/sidecar CONTENT never enters the
+summary — their ``scenario_checkpoint`` records carry path, bytes and
+shard_layout, which is the provenance an operator correlates on).
+Host-tier by lint contract: ba-lint BA301 proves ``obs/flight.py``
+never imports through ``ba_tpu.core``/``ba_tpu.ops``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import re
+
+from ba_tpu.utils import metrics as _metrics
+
+RUN_ID_ENV = "BA_TPU_RUN_ID"
+# Conservative shape so run ids survive filenames, Prometheus labels and
+# shell quoting: leading alnum, then alnum/._:- up to 64 chars total.
+# NOTE a pinned BA_TPU_RUN_ID applies to EVERY campaign in the process:
+# the assembler dedups dispatch windows by round grid, so two different
+# campaigns sharing one pinned id overlay each other's windows — pin
+# per campaign (a chaos drill, a CI leg), let derivation handle
+# sessions that run several.
+RUN_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:-]{0,63}$")
+
+FLIGHT_SUMMARY_VERSION = 1
+
+# Record families that carry a run_id whenever a scope is active — the
+# families `scripts/check_metrics_schema.py` validates the key's
+# presence/shape on.  (`metrics_snapshot` and friends are stamped too
+# when in scope, but only these are BY CONSTRUCTION always emitted from
+# inside a campaign's run scope.)
+RUN_SCOPED_EVENTS = frozenset(
+    {
+        "flight_span",
+        "scenario_checkpoint",
+        "recovery",
+        "fault_injected",
+        "health_snapshot",
+        "flight_summary",
+    }
+)
+
+
+def valid_run_id(run_id) -> bool:
+    return isinstance(run_id, str) and bool(RUN_ID_RE.match(run_id))
+
+
+def derive_run_id(*material) -> str:
+    """``run-<sha256[:16]>`` over the campaign identity material.
+
+    Deterministic: the same (key bytes, rounds, scenario content) —
+    whatever the caller feeds — derives the same id in every process,
+    which is what lets a killed campaign's auto-resumed successor join
+    its predecessor's ledger without any handshake.  ``bytes`` material
+    hashes raw; everything else hashes its ``str()``.
+    """
+    h = hashlib.sha256()
+    for m in material:
+        h.update(m if isinstance(m, bytes) else str(m).encode())
+        h.update(b"\x00")
+    return "run-" + h.hexdigest()[:16]
+
+
+def resolve_run_id(
+    *material, inherited: str | None = None, material_fn=None
+) -> str:
+    """The run id a campaign should use, by precedence:
+
+    1. ``BA_TPU_RUN_ID`` (validated; a malformed value is refused loudly
+       — a silently sanitized id would break the operator's own joins);
+    2. an already-active scope's id (nested campaigns inherit);
+    3. ``inherited`` — the id a resume checkpoint's header carries
+       (continuity across a process boundary even when the successor
+       cannot re-derive, e.g. an explicit ``resume=path`` entry);
+    4. :func:`derive_run_id` over ``material`` plus ``material_fn()``.
+
+    ``material_fn`` (a zero-arg callable returning an iterable) defers
+    EXPENSIVE identity material — key fetches, scenario plane hashing —
+    to the one precedence branch that needs it: a supervised retry
+    attempt (whose derivation always loses to the supervisor's active
+    scope) must not re-hash megabytes of event planes per recovery.
+    """
+    env = os.environ.get(RUN_ID_ENV)
+    if env:
+        if not valid_run_id(env):
+            raise ValueError(
+                f"{RUN_ID_ENV}={env!r} is not a valid run id "
+                f"(want {RUN_ID_RE.pattern})"
+            )
+        return env
+    active = _metrics.active_run_id()
+    if active is not None:
+        return active
+    if inherited is not None and valid_run_id(inherited):
+        return inherited
+    if material_fn is not None:
+        material = material + tuple(material_fn())
+    return derive_run_id(*material)
+
+
+class RunScope:
+    """What :func:`run_scope` yields: the effective ``run_id`` and
+    whether THIS scope owns it (``owner`` — the outermost scope; owners
+    emit the flight summary, inheritors must not)."""
+
+    __slots__ = ("run_id", "owner")
+
+    def __init__(self, run_id: str, owner: bool):
+        self.run_id = run_id
+        self.owner = owner
+
+
+@contextlib.contextmanager
+def run_scope(run_id: str):
+    """Activate ``run_id`` for the dynamic extent of the block.
+
+    Nesting inherits: when a scope is already active the inner block
+    keeps the OUTER id (the supervisor's id wins over its attempts'),
+    and ``owner`` is False so exactly one ``flight_summary`` is emitted
+    per run.  Always restores on exit, exception or not — a leaked run
+    id would stamp unrelated later records.
+    """
+    active = _metrics.active_run_id()
+    if active is not None:
+        yield RunScope(active, owner=False)
+        return
+    _metrics.set_run_id(run_id)
+    try:
+        yield RunScope(run_id, owner=True)
+    finally:
+        _metrics.set_run_id(None)
+
+
+# -- the assembler ------------------------------------------------------------
+
+
+def _parse_jsonl(path: str, needle: str | None = None):
+    """Parsed records, optionally pre-filtered by a raw substring test
+    BEFORE json.loads — a shared long-session stream is re-read at the
+    end of every owner-scoped campaign, and skipping other runs' lines
+    at string speed keeps that linear scan cheap (matched lines still
+    go through the real parser and the run-id field check)."""
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or (needle is not None and needle not in line):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a torn tail line from a killed writer
+            if isinstance(rec, dict):
+                yield rec
+
+
+class FlightLog:
+    """One run's records, joined.
+
+    Feed records via :meth:`add` (or let :func:`assemble_flight` read a
+    JSONL file), then :meth:`summary` builds the versioned
+    ``flight_summary``.  Joining rules:
+
+    - **dispatch windows** (``flight_span`` records, one per retire)
+      key by their round window's ``lo``; a replayed window after a
+      recovery (same lo grid — resume points are dispatch boundaries)
+      REPLACES the original, and an OOM-degraded replay's finer grid
+      simply chains, so the assembled timeline covers every round
+      exactly once (``contiguous`` says whether it does);
+    - **checkpoints** key by round cursor (a re-written checkpoint after
+      a replay is the same durable point — last write wins);
+    - **recompiles** dedup by (fn, changed-axes) — the attribution, not
+      the repetition, is the signal;
+    - **recoveries** and **faults** are each distinct events and are
+      kept in order.
+    """
+
+    def __init__(self, run_id: str | None = None):
+        self.run_id = run_id
+        self._windows: dict = {}  # lo -> window dict (last wins)
+        self._checkpoints: dict = {}  # round -> record (last wins)
+        self._recoveries: list = []
+        self._faults: list = []
+        self._recompiles: dict = {}  # (fn, changed json) -> record
+        self._health: list = []
+        self._events: dict = {}  # event name -> count (this run's records)
+        self._last_per_shard: dict = {}
+
+    def add(self, rec: dict) -> bool:
+        """Fold one record in.  Returns True when the record belonged to
+        this run (matching — or, for a log holding one anonymous run,
+        missing — run_id); summaries themselves are never folded."""
+        event = rec.get("event")
+        if event == "flight_summary":
+            return False
+        rid = rec.get("run_id")
+        if self.run_id is not None and rid is not None and rid != self.run_id:
+            return False
+        if self.run_id is None and rid is not None:
+            self.run_id = rid
+        self._events[event] = self._events.get(event, 0) + 1
+        if event == "flight_span":
+            lo = rec.get("lo")
+            if isinstance(lo, int):
+                self._windows[lo] = {
+                    "lo": lo,
+                    "hi": rec.get("hi"),
+                    "dispatch": rec.get("dispatch"),
+                    "latency_s": rec.get("latency_s"),
+                    "lag_s": rec.get("lag_s"),
+                    "ts": rec.get("ts"),
+                }
+        elif event == "scenario_checkpoint":
+            rnd = rec.get("round")
+            if isinstance(rnd, int):
+                self._checkpoints[rnd] = {
+                    "round": rnd,
+                    "path": rec.get("path"),
+                    "bytes": rec.get("bytes"),
+                    "shard_layout": rec.get("shard_layout"),
+                    "ts": rec.get("ts"),
+                }
+        elif event == "recovery":
+            self._recoveries.append(
+                {
+                    k: rec.get(k)
+                    for k in (
+                        "fault", "action", "attempt", "from_round",
+                        "lost_rounds", "error", "ts",
+                    )
+                }
+            )
+        elif event == "fault_injected":
+            self._faults.append(
+                {
+                    k: rec.get(k)
+                    for k in ("plan", "kind", "phase", "round", "ts")
+                }
+            )
+        elif event == "recompile":
+            changed = rec.get("changed")
+            key = (rec.get("fn"), json.dumps(changed, sort_keys=True))
+            self._recompiles.setdefault(
+                key,
+                {
+                    "fn": rec.get("fn"),
+                    "changed": changed,
+                    "cross_process": rec.get("cross_process"),
+                    "ts": rec.get("ts"),
+                },
+            )
+        elif event == "health_snapshot":
+            self._health.append(rec)
+        elif event == "metrics_snapshot":
+            shards = rec.get("metrics", {})
+            for g in (
+                "pipeline_shards",
+                "pipeline_carry_bytes_per_shard",
+                "scenario_plane_bytes_per_shard",
+            ):
+                snap = shards.get(g)
+                if isinstance(snap, dict) and "value" in snap:
+                    self._last_per_shard[g] = snap["value"]
+        return True
+
+    def _chain(self):
+        """Sorted window chain + the contiguity verdict: the chained
+        windows must cover [first lo, last hi) without a gap."""
+        windows = sorted(self._windows.values(), key=lambda w: w["lo"])
+        contiguous = bool(windows)
+        pos = windows[0]["lo"] if windows else 0
+        for w in windows:
+            if w["lo"] != pos or not isinstance(w["hi"], int):
+                contiguous = False
+                break
+            pos = w["hi"]
+        return windows, contiguous, pos
+
+    def summary(self) -> dict:
+        windows, contiguous, end = self._chain()
+        checkpoints = [
+            self._checkpoints[r] for r in sorted(self._checkpoints)
+        ]
+        # Shard provenance: the newest checkpoint's layout is the
+        # authoritative writing layout; the per-shard byte gauges ride
+        # from the last metrics/health snapshot seen.
+        layout = checkpoints[-1]["shard_layout"] if checkpoints else None
+        lat = [
+            w["latency_s"] for w in windows
+            if isinstance(w.get("latency_s"), (int, float))
+        ]
+        timeline = sorted(
+            [{"kind": "dispatch_window", **w} for w in windows]
+            + [{"kind": "checkpoint", **c} for c in checkpoints]
+            + [{"kind": "recovery", **r} for r in self._recoveries]
+            + [
+                # The injected fault's own "kind" (transient/fatal/...)
+                # must not clobber the timeline entry kind.
+                {
+                    "kind": "fault",
+                    "injected": f.get("kind"),
+                    "phase": f.get("phase"),
+                    "round": f.get("round"),
+                    "plan": f.get("plan"),
+                    "ts": f.get("ts"),
+                }
+                for f in self._faults
+            ]
+            + [{"kind": "recompile", **r} for r in self._recompiles.values()],
+            key=lambda e: (
+                e["ts"] if isinstance(e.get("ts"), (int, float)) else 0.0
+            ),
+        )
+        return {
+            "event": "flight_summary",
+            "v": FLIGHT_SUMMARY_VERSION,
+            "run_id": self.run_id,
+            "rounds": [windows[0]["lo"], end] if windows else None,
+            "contiguous": contiguous,
+            "windows": len(windows),
+            "checkpoints": checkpoints,
+            "recoveries": self._recoveries,
+            "faults": self._faults,
+            "recompiles": list(self._recompiles.values()),
+            "health_snapshots": len(self._health),
+            "last_health": self._health[-1] if self._health else None,
+            "shard_layout": layout,
+            "per_shard": self._last_per_shard or None,
+            "dispatch_latency_max_s": max(lat) if lat else None,
+            "events": dict(sorted(self._events.items())),
+            "timeline": timeline,
+        }
+
+
+def assemble_flight(jsonl_path: str, run_id: str | None = None):
+    """Join one run's records out of a JSONL stream into a
+    ``flight_summary`` dict (None when the file holds nothing for the
+    run).  ``run_id=None`` selects the stream's LAST-seen run id — the
+    run an operator tailing the file is looking at."""
+    if run_id is None:
+        for rec in _parse_jsonl(jsonl_path, needle='"run_id"'):
+            rid = rec.get("run_id")
+            if rid is not None and rec.get("event") != "flight_summary":
+                run_id = rid  # keep scanning: last wins
+    log = FlightLog(run_id)
+    matched = 0
+    # With a known run id, only that run's lines pay a json parse (the
+    # id is a quoted value on every stamped record); an anonymous log
+    # (no stamped records anywhere) parses in full.
+    needle = f'"{run_id}"' if run_id is not None else None
+    for rec in _parse_jsonl(jsonl_path, needle=needle):
+        if log.add(rec):
+            matched += 1
+    if not matched:
+        return None
+    return log.summary()
+
+
+def emit_flight_summary(sink=None, run_id: str | None = None):
+    """Assemble the active sink's file-backed stream and append the
+    ``flight_summary`` record to it — the scope OWNER's end-of-run
+    duty.  A disabled or stderr-backed sink has no stream to join
+    (nothing to read back), so this quietly returns None; recording a
+    flight means pointing ``BA_TPU_METRICS`` (or ``bench --obs``) at a
+    file.
+    """
+    sink = sink or _metrics.default_sink()
+    target = getattr(sink, "target", None)
+    if not target or target == "-" or not os.path.exists(target):
+        return None
+    summary = assemble_flight(target, run_id=run_id)
+    if summary is not None:
+        sink.emit(summary)
+    return summary

@@ -152,6 +152,20 @@ def _read_ledger_file(path: str) -> dict:
     return {}
 
 
+def _sig_core(sig: dict) -> dict:
+    """A ledger row minus its ``run_id`` rider — the comparable compile
+    signature.  The rider is provenance (which campaign's first compile
+    stored the row, ISSUE 9), never identity: comparing WITH it would
+    make every new run re-store — and mis-diff — rows whose axes never
+    changed."""
+    return {k: v for k, v in sig.items() if k != "run_id"}
+
+
+def _sig_in(sig: dict, rows) -> bool:
+    core = _sig_core(sig)
+    return any(_sig_core(r) == core for r in rows)
+
+
 def _ledger_store_locked(fn: str, signature: dict) -> None:
     """Append ``signature`` to the fn's session list and write through
     (atomic rewrite; one small JSON per compile — compiles are rare and
@@ -176,16 +190,25 @@ def _ledger_store_locked(fn: str, signature: dict) -> None:
     recompiles.  The read→replace window is still racy, but it is
     microseconds per rare compile, not the life of the session."""
     global _ledger_path
+    from ba_tpu.utils import metrics as _metrics
+
+    row_sig = dict(signature)
+    rid = _metrics.active_run_id()
+    if rid is not None:
+        # Run provenance (ISSUE 9): the campaign whose first compile of
+        # this signature stored the row.  A rider, not an axis — every
+        # membership/diff comparison strips it (_sig_core).
+        row_sig["run_id"] = rid
     sigs = _ledger_cur.setdefault(fn, [])
-    if signature not in sigs:
-        sigs.append(signature)
+    if not _sig_in(row_sig, sigs):
+        sigs.append(row_sig)
     fns = {f: list(s) for f, s in _ledger_prev.items()}
     for f, disk in _read_ledger_file(_ledger_path).items():
         row = fns.setdefault(f, [])
-        row.extend(s for s in disk if s not in row)
+        row.extend(s for s in disk if not _sig_in(s, row))
     for f, cur in _ledger_cur.items():
         row = fns.setdefault(f, [])
-        row.extend(s for s in cur if s not in row)
+        row.extend(s for s in cur if not _sig_in(s, row))
     doc = {"v": 1, "fns": fns}
     tmp = f"{_ledger_path}.tmp.{os.getpid()}"
     try:
@@ -239,7 +262,7 @@ def classify_compile(fn: str, axes: dict):
             signature = {**axes, **_ledger_env}
             _ledger_store_locked(fn, signature)
     if prev is None:
-        if ledgered and prior and signature not in prior:
+        if ledgered and prior and not _sig_in(signature, prior):
             # Diff against the CLOSEST prior signature (fewest differing
             # axes; most recent wins ties), not blindly prior[-1]: a fn
             # the previous process compiled at capacities 4 and 8 that
@@ -255,7 +278,7 @@ def classify_compile(fn: str, axes: dict):
                 }
 
             changed = min(  # reversed: min keeps the first, i.e. newest
-                (diff_against(b) for b in reversed(prior)),
+                (diff_against(_sig_core(b)) for b in reversed(prior)),
                 key=len,
             )
             if changed:

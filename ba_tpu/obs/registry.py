@@ -114,6 +114,21 @@ class Histogram:
         """Upper boundary of bucket ``i`` (inclusive)."""
         return self.base * self.factor**i
 
+    def peek(self) -> dict:
+        """A lock-free read of the histogram's state (ISSUE 9 health
+        sampling): every field is a GIL-atomic attribute read and the
+        bucket list copies element-by-element under the GIL, so a
+        concurrent ``record`` can at worst make the copy off by the
+        in-flight sample — monitoring-grade consistency without ever
+        contending with the engine's hot-loop updates."""
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "counts": list(self._counts),
+        }
+
     def snapshot(self) -> dict:
         with self._lock:
             counts = list(self._counts)
@@ -147,12 +162,33 @@ class MetricsRegistry:
         self._instruments: dict = {}
 
     def _get(self, name: str, factory):
+        # Naming contract (ISSUE 9 satellite; docs/DESIGN.md §8): a
+        # metric whose value is ONE device's share of something spells
+        # it with the `_per_shard` SUFFIX — `scenario_plane_bytes` vs
+        # `scenario_plane_bytes_per_shard`.  Enforced at instrument
+        # creation so a future mesh gauge cannot drift to
+        # `per_shard_plane_bytes` / `plane_per_shard_bytes` and split
+        # dashboards across two spellings of the same denominator.
+        if "per_shard" in name and not name.endswith("_per_shard"):
+            raise ValueError(
+                f"metric name {name!r} mentions per_shard but does not "
+                f"END with '_per_shard' — the per-device-share suffix "
+                f"rule (DESIGN §8) keeps mesh gauge names joinable"
+            )
         with self._lock:
             inst = self._instruments.get(name)
             if inst is None:
                 inst = factory()
                 self._instruments[name] = inst
         return inst
+
+    def get(self, name: str):
+        """The existing instrument named ``name``, or None — WITHOUT
+        creating one and WITHOUT taking the registry lock (a dict read
+        is atomic under the GIL).  The health sampler's lock-free read
+        path: sampling must never contend with the engine's hot-loop
+        updates."""
+        return self._instruments.get(name)
 
     def counter(self, name: str) -> Counter:
         inst = self._get(name, lambda: Counter(self._lock))

@@ -35,6 +35,8 @@ import os
 import threading
 import time
 
+from ba_tpu.utils import metrics as _metrics
+
 # One span record: (name, start perf_counter_ns, duration ns, thread id,
 # attrs dict | None).  Instant events use duration -1.
 _INSTANT = -1
@@ -75,6 +77,13 @@ class Tracer:
         if not self.enabled:
             yield
             return
+        # Run correlation (ISSUE 9): spans recorded inside a flight-
+        # recorder run scope carry the campaign's run_id, so the Chrome
+        # trace joins the JSONL ledger on the same key.  One global read
+        # when enabled; explicit run_id attrs win.
+        rid = _metrics.active_run_id()
+        if rid is not None:
+            attrs.setdefault("run_id", rid)
         t0 = time.perf_counter_ns()
         try:
             yield
@@ -89,6 +98,9 @@ class Tracer:
         """A zero-duration marker (election flips, cache enablement...)."""
         if not self.enabled:
             return
+        rid = _metrics.active_run_id()
+        if rid is not None:
+            attrs.setdefault("run_id", rid)
         with self._lock:
             self._buf.append(
                 (

@@ -55,6 +55,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import json
 import os.path
 import threading
 import time
@@ -565,6 +566,11 @@ class CarryCheckpoint:
     strategy: jax.Array | None
     round: int
     shard_layout: dict | None = None
+    # Flight-recorder correlation (ISSUE 9): the run_id of the campaign
+    # that wrote this checkpoint, so a resume CONTINUES the same run's
+    # ledger (a killed process's successor joins its predecessor's
+    # records).  None on pre-recorder checkpoints.
+    run_id: str | None = None
 
 
 def _carry_arrays(host_state, host_sched, host_counters, host_strategy):
@@ -595,12 +601,13 @@ def _carry_arrays(host_state, host_sched, host_counters, host_strategy):
 # hazard both checks exist to prevent).
 RESERVED_CARRY_META_KEYS = frozenset(
     {"format", "v", "round", "scenario", "counter_names", "sha256",
-     "rounds_total", "shard_layout"}
+     "rounds_total", "shard_layout", "run_id"}
 )
 
 
 def _carry_meta(
-    round_cursor: int, counters, strategy, shard_layout=None, **extra
+    round_cursor: int, counters, strategy, shard_layout=None, run_id=None,
+    **extra
 ) -> dict:
     clash = (RESERVED_CARRY_META_KEYS - {"rounds_total"}) & set(extra)
     if clash:
@@ -626,6 +633,9 @@ def _carry_meta(
         # Provenance, not a resume constraint: the stored arrays are
         # canonical (gather-on-write), so any device count reads them.
         "shard_layout": shard_layout or {"data": 1},
+        # Run correlation (ISSUE 9): which campaign run wrote this
+        # carry; a resume adopts it so the ledger stays one run.
+        "run_id": run_id,
         **extra,
     }
 
@@ -662,7 +672,8 @@ def save_carry_checkpoint(path: str, ckpt: CarryCheckpoint, **extra) -> int:
         path,
         arrays,
         _carry_meta(
-            ckpt.round, host[2], host[3], shard_layout=layout, **extra
+            ckpt.round, host[2], host[3], shard_layout=layout,
+            run_id=ckpt.run_id or _metrics.active_run_id(), **extra
         ),
     )
     return sum(v.nbytes for v in arrays.values())
@@ -716,10 +727,100 @@ def load_carry_checkpoint(path: str) -> CarryCheckpoint:
         strategy=strategy,
         round=meta["round"],
         shard_layout=meta.get("shard_layout"),
+        run_id=meta.get("run_id"),
     )
 
 
 def pipeline_sweep(  # ba-lint: donates(state)
+    key: jax.Array,
+    state: SimState,
+    rounds: int,
+    *,
+    scenario=None,
+    resume=None,
+    **engine_kwargs,
+):
+    """Run ``rounds`` sweep rounds through the depth-k pipelined engine,
+    inside a flight-recorder run scope (ISSUE 9).
+
+    The thin public layer over :func:`_pipeline_sweep_impl` (which
+    documents every engine dial — depth, rounds_per_dispatch, scenario
+    mode, mesh sharding, checkpointing, the resilience seams, and the
+    new ``health_every``): before the first dispatch it resolves the
+    campaign's **run_id** (``BA_TPU_RUN_ID`` > an already-active scope >
+    the resume checkpoint's stored id > a sha256 derived from the key
+    material/rounds/scenario — deterministic, so a killed process's
+    successor joins the same ledger) and activates it for the whole
+    sweep.  While active, every JSONL record, span, checkpoint header
+    and compile-ledger row carries the id; the scope OWNER (the
+    outermost caller — a supervised campaign's id wins over its
+    attempts') assembles the sink's stream into ONE versioned
+    ``flight_summary`` record at the end (``obs/flight.py``).  The
+    resolved id also lands in ``stats["run_id"]``.
+
+    Recording costs clock reads and (when the sink is live) one small
+    JSONL line per retire — never a device synchronization: the
+    no-blocking dispatch-count proof re-runs with the recorder and the
+    health sampler live (tests/test_flight.py).
+
+    DONATION: ``state`` is consumed exactly as the engine documents —
+    thread the returned ``final_state``.
+    """
+    if isinstance(resume, str):
+        # Load here (not in the impl) so the run_id the checkpoint
+        # header carries can seed the scope the impl runs under.
+        resume = load_carry_checkpoint(resume)
+    def _identity_material():
+        # Deferred (flight.resolve_run_id calls this only when env /
+        # active scope / resume header yield nothing): the key fetch
+        # and scenario-content hashing are wasted work on every
+        # supervised retry attempt, whose derivation always loses to
+        # the supervisor's active scope.
+        material = [rounds]
+        if key is not None:
+            material.append(jax.device_get(jr.key_data(key)).tobytes())
+        elif resume is not None:
+            material.append(
+                jax.device_get(resume.schedule.key_data).tobytes()
+            )
+        if scenario is not None:
+            doc = getattr(scenario, "to_doc", None)
+            if doc is not None:
+                material.append(json.dumps(doc(), sort_keys=True))
+            else:
+                # Dense blocks have no document form: hash the event
+                # plane CONTENT (same identity the supervisor's
+                # campaign fingerprint uses) — two campaigns differing
+                # only in events must not share a run_id, or the
+                # assembler would silently merge their flights on the
+                # round grid.
+                for name in (
+                    "kill", "revive", "set_faulty", "set_strategy"
+                ):
+                    material.append(
+                        jax.device_get(getattr(scenario, name)).tobytes()
+                    )
+        return material
+
+    rid = obs.flight.resolve_run_id(
+        inherited=resume.run_id if resume is not None else None,
+        material_fn=_identity_material,
+    )
+    with obs.flight.run_scope(rid) as scope:
+        out = _pipeline_sweep_impl(
+            key, state, rounds, scenario=scenario, resume=resume,
+            **engine_kwargs,
+        )
+        out["stats"]["run_id"] = scope.run_id
+        if scope.owner:
+            # One flight_summary per run, appended to the sink's own
+            # stream (a disabled / stderr sink has nothing to join and
+            # costs nothing).
+            obs.flight.emit_flight_summary(run_id=scope.run_id)
+    return out
+
+
+def _pipeline_sweep_impl(  # ba-lint: donates(state)
     key: jax.Array,
     state: SimState,
     rounds: int,
@@ -746,6 +847,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
     retire_timeout_s: float | None = None,
     on_stall=None,
     on_rows=None,
+    health_every: int | None = None,
 ):
     """Run ``rounds`` sweep rounds through the depth-k pipelined engine.
 
@@ -893,6 +995,15 @@ def pipeline_sweep(  # ba-lint: donates(state)
       same retire: a supervisor can persist campaign history alongside
       each checkpoint and stitch a full bit-exact result across
       recoveries.
+
+    HEALTH SAMPLING (ISSUE 9): ``health_every=N`` takes one
+    ``obs.health.HealthSampler`` sample every N dispatches, from the
+    SAME host-side slot ``host_work`` runs in (between a dispatch and
+    its retire check, overlapping device compute).  A sample is
+    lock-free registry reads + a ``health_*`` gauge write-back + (with
+    a live sink) one ``health_snapshot`` JSONL record — zero added
+    device synchronization, pinned by the no-blocking proof running
+    with the sampler live.  ``stats["health_samples"]`` counts them.
     """
     if rounds < 1:
         raise ValueError(f"rounds={rounds} must be >= 1")
@@ -960,6 +1071,8 @@ def pipeline_sweep(  # ba-lint: donates(state)
         raise ValueError(f"retire_timeout_s={retire_timeout_s} must be > 0")
     if on_stall is not None and retire_timeout_s is None:
         raise ValueError("on_stall needs retire_timeout_s")
+    if health_every is not None and health_every < 1:
+        raise ValueError(f"health_every={health_every} must be >= 1")
 
     if resume is not None:
         if isinstance(resume, str):
@@ -1112,6 +1225,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
     n_checkpoints = 0
     n_stalls = 0
     plane_peak_bytes = 0
+    plane_shard_peak = 0
     stage_s = 0.0
 
     # Observability (ISSUE 2): spans + registry feed off the engine's
@@ -1126,6 +1240,43 @@ def pipeline_sweep(  # ba-lint: donates(state)
     occ_h = reg.histogram("pipeline_depth_occupancy", base=1.0, n_buckets=16)
     disp_c = reg.counter("pipeline_dispatches_total")
     ret_c = reg.counter("pipeline_retires_total")
+    # Retired-round counter (ISSUE 9): the health sampler's rounds/s
+    # numerator — deltas between samples are exact, not inferred from
+    # retire counts times a dial that may degrade mid-campaign.
+    rounds_c = reg.counter("pipeline_rounds_total")
+    sampler = (
+        obs.health.HealthSampler(reg, timeout_s=retire_timeout_s)
+        if health_every is not None
+        else None
+    )
+    if sampler is not None:
+        # Baseline the window on THIS campaign's start: the registry is
+        # process-global, and an unprimed first sample would read every
+        # earlier sweep's lifetime totals as one giant first window.
+        sampler.prime()
+    # Shard gauges set UP FRONT, not only at drain (ISSUE 9): a live
+    # health sample taken mid-campaign must read THIS sweep's device
+    # count and per-device carry share, not the previous sweep's.  The
+    # carry's shapes are constant for the whole sweep, so the staged
+    # buffers already carry the steady-state figures; the drain-time
+    # set below recomputes on the final carry (same values).
+    reg.gauge("pipeline_shards").set(n_shards)
+    carry0 = (state, sched, counters, strategy)
+    if mesh is not None:
+        reg.gauge("pipeline_carry_bytes_per_shard").set(
+            _shard.per_shard_nbytes(carry0)
+        )
+        shares0 = _shard.per_shard_nbytes_all(carry0)
+        if shares0:
+            mean0 = sum(shares0) / len(shares0)
+            reg.gauge("pipeline_carry_imbalance").set(
+                round(shares0[0] / mean0, 4) if mean0 else 1.0
+            )
+    else:
+        reg.gauge("pipeline_carry_bytes_per_shard").set(
+            sum(x.nbytes for x in jax.tree.leaves(carry0))
+        )
+    del carry0
     if scenario is not None:
         # Scenario-phase instants + scenario_* counters (ISSUE 5 obs
         # wiring): clock reads and in-memory scalar ops only — the
@@ -1149,7 +1300,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
     zero_staged: dict = {}  # chunk length -> staged device event dict
 
     def stage_chunk(lo, hi):
-        nonlocal plane_peak_bytes, stage_s
+        nonlocal plane_peak_bytes, plane_shard_peak, stage_s
         t0 = time.perf_counter()
         nr = hi - lo
         empty = scenario.chunk_is_empty(lo, hi)
@@ -1177,6 +1328,33 @@ def pipeline_sweep(  # ba-lint: donates(state)
             if empty:
                 zero_staged[nr] = staged
         plane_peak_bytes = max(plane_peak_bytes, nbytes)
+        # Live plane gauges (ISSUE 9): update per STAGE, not only at
+        # drain, so a mid-campaign health sample reads THIS sweep's
+        # staging — and the imbalance is MEASURED per-device shares of
+        # the staged chunk (max/mean via addressable-shard metadata),
+        # not a total/shards identity that could never read skewed.
+        # In-memory scalar ops + metadata walks; no fetch, no sync.
+        reg.gauge("scenario_plane_bytes").set(plane_peak_bytes)
+        if mesh is not None:
+            shares = _shard.per_shard_nbytes_all(staged)
+            if shares:
+                # PEAK share, like the non-mesh reading and the drain
+                # set: one gauge name must mean one thing at any point
+                # in the campaign (a current-chunk reading would make
+                # the live value incomparable with the drain value).
+                plane_shard_peak = max(plane_shard_peak, shares[0])
+                reg.gauge("scenario_plane_bytes_per_shard").set(
+                    plane_shard_peak
+                )
+                mean = sum(shares) / len(shares)
+                reg.gauge("scenario_plane_imbalance").set(
+                    round(shares[0] / mean, 4) if mean else 1.0
+                )
+        else:
+            reg.gauge("scenario_plane_bytes_per_shard").set(
+                plane_peak_bytes
+            )
+            reg.gauge("scenario_plane_imbalance").set(1.0)
         stage_s += time.perf_counter() - t0
         return staged
 
@@ -1257,7 +1435,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
     def retire():
         # t_sub rides the in-flight tuple (perf_counter_ns at submit).
         d, ys, t_sub, pending, lo, hi = inflight.popleft()
-        with obs.timed_span("retire", lag_h, dispatch=d):
+        with obs.timed_span("retire", lag_h, dispatch=d) as lag_box:
             # The ONLY blocking operation in the engine: fetch dispatch
             # d's outputs, which waits on a dispatch `depth` behind the
             # queue head while later rounds keep the device busy.  (The
@@ -1299,8 +1477,28 @@ def pipeline_sweep(  # ba-lint: donates(state)
         # measures submit->retire of the dispatch itself, and folding a
         # slow disk target's serialization time in would skew the
         # distribution the engine's overlap analysis is built on.
-        lat_h.record((time.perf_counter_ns() - t_sub) / 1e9)
+        latency_s = (time.perf_counter_ns() - t_sub) / 1e9
+        lat_h.record(latency_s)
         ret_c.inc()
+        rounds_c.inc(hi - lo)
+        if _metrics.default_sink().enabled:
+            # Flight recorder (ISSUE 9): one line per retired round
+            # window — the dispatch→retire leg of the run's timeline,
+            # keyed by ROUNDS so replayed windows after a recovery land
+            # on the same grid and the assembler dedups them.  A host
+            # emit on the fetch that just returned, never a new sync.
+            _metrics.emit(
+                {
+                    "event": "flight_span",
+                    "v": _metrics.SCHEMA_VERSION,
+                    "phase": "retire",
+                    "dispatch": d,
+                    "lo": lo,
+                    "hi": hi,
+                    "latency_s": round(latency_s, 6),
+                    "lag_s": round(lag_box.elapsed_s or 0.0, 6),
+                }
+            )
         if on_rows is not None:
             # Before the checkpoint write on purpose: a supervisor
             # persisting campaign history next to each checkpoint needs
@@ -1489,6 +1687,13 @@ def pipeline_sweep(  # ba-lint: donates(state)
         if host_work is not None:
             with tracer.span("host_work", dispatch=d):
                 host_work(d)  # overlaps the rounds still executing on device
+        if sampler is not None and (d + 1) % health_every == 0:
+            # Health sampling (ISSUE 9): same overlap slot as host_work
+            # — the device is busy with dispatches d-depth..d while the
+            # host takes lock-free registry reads, writes the health_*
+            # gauges and (sink live) emits one health_snapshot record.
+            with tracer.span("health_sample", dispatch=d):
+                sampler.sample(emit=True, dispatch=d)
         while len(inflight) > depth:
             retire()
             retires_before_drain += 1
@@ -1508,6 +1713,15 @@ def pipeline_sweep(  # ba-lint: donates(state)
     carry = (state, sched, counters, strategy)
     if mesh is not None:
         carry_bytes_per_shard = _shard.per_shard_nbytes(carry)
+        # Per-device imbalance (ISSUE 9 health view): max device share
+        # over the mean — 1.0 when the batch split is even; a skewed
+        # mesh layout reads > 1.0.  Metadata walk only, no fetch.
+        shares = _shard.per_shard_nbytes_all(carry)
+        if shares:
+            mean = sum(shares) / len(shares)
+            reg.gauge("pipeline_carry_imbalance").set(
+                round(shares[0] / mean, 4) if mean else 1.0
+            )
     else:
         carry_bytes_per_shard = sum(
             x.nbytes for x in jax.tree.leaves(carry)
@@ -1535,6 +1749,7 @@ def pipeline_sweep(  # ba-lint: donates(state)
             "stage_s": round(stage_s, 6),
             "shards": n_shards,
             "carry_bytes_per_shard": carry_bytes_per_shard,
+            "health_samples": sampler.samples if sampler is not None else 0,
         },
     }
     if scenario is not None:

@@ -144,6 +144,23 @@ def per_shard_nbytes(tree) -> int:
     return total
 
 
+def per_shard_nbytes_all(tree) -> list:
+    """Per-DEVICE byte totals for a pytree of (possibly sharded) arrays,
+    sorted descending — the health sampler's imbalance numerator/mean
+    (ISSUE 9): ``max / mean`` is 1.0 when every device holds the same
+    share and grows as one device holds more than its split.  Replicated
+    leaves count in full on every device (they really are resident
+    everywhere); host-side leaves count nowhere.  In-memory metadata
+    walks only — no fetch, no sync."""
+    per: dict = {}
+    for x in jax.tree.leaves(tree):
+        shards = getattr(x, "addressable_shards", None)
+        if shards:
+            for s in shards:
+                per[s.device] = per.get(s.device, 0) + s.data.nbytes
+    return sorted(per.values(), reverse=True)
+
+
 def reduce_host_ys(
     host_ys: tuple,
     *,

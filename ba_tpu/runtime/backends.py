@@ -372,6 +372,7 @@ class JaxBackend:
         supervise=False,
         fault_plan=None,
         mesh=None,
+        health_every=None,
     ):
         """A declarative scenario campaign on the B=1 interactive cluster.
 
@@ -464,6 +465,7 @@ class JaxBackend:
             checkpoint_path=checkpoint_path,
             checkpoint_keep_last=checkpoint_keep_last,
             mesh=mesh,
+            health_every=health_every,
         )
         if supervise:
             from ba_tpu.runtime.supervisor import supervised_sweep

@@ -278,6 +278,16 @@ class Cluster:
         metrics.emit(
             {
                 "event": "agreement_rounds_pipelined",
+                # The engine's run scope closed when run_rounds
+                # returned; re-attach its id so the summary record
+                # joins the same flight (ISSUE 9).  Conditional: a
+                # present-but-None key would defeat the sink's own
+                # setdefault stamping.
+                **(
+                    {"run_id": stats["run_id"]}
+                    if stats.get("run_id")
+                    else {}
+                ),
                 "round_base": round_base,
                 "rounds": rounds,
                 "n": len(self.generals),
@@ -304,6 +314,7 @@ class Cluster:
         supervise=False,
         fault_plan=None,
         mesh=None,
+        health_every=None,
     ):
         """Run a declarative scenario campaign (ba_tpu.scenario) on this
         cluster: the whole ``g-kill``/``g-add``/``g-state`` REPL session
@@ -325,6 +336,9 @@ class Cluster:
         mesh runs (a larger one raises the engine's clear divisibility
         error; batched multi-chip campaigns call
         ``parallel.pipeline.scenario_sweep(mesh=)`` directly).
+        ``health_every`` (ISSUE 9) threads into the engine's live
+        health sampler: one ``health_snapshot`` per N dispatches from
+        the host_work overlap slot, zero added synchronization.
 
         The backend (``run_scenario``) compiles the spec against the
         current roster and drives the mutating megastep; afterwards the
@@ -361,6 +375,7 @@ class Cluster:
                 supervise=supervise,
                 fault_plan=fault_plan,
                 mesh=mesh,
+                health_every=health_every,
             )
         if res is None:
             return None
@@ -394,6 +409,15 @@ class Cluster:
         metrics.emit(
             {
                 "event": "scenario_campaign",
+                # Re-attach the campaign's run id (the engine's scope
+                # closed when the backend returned) so this summary
+                # record joins the same flight (ISSUE 9); conditional
+                # so a backend without one never emits run_id: null.
+                **(
+                    {"run_id": res["stats"]["run_id"]}
+                    if res["stats"].get("run_id")
+                    else {}
+                ),
                 "name": spec.name,
                 "rounds": spec.rounds,
                 "order": spec.order,

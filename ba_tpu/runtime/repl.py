@@ -40,6 +40,10 @@ stay byte-identical):
   Prometheus-style text: round wall-time histogram, pipeline dispatch /
   retire latencies and depth occupancy, election and failover counters.
   Prints nothing before the first instrumented operation.
+  ``stats --live`` (ISSUE 9) renders one health sample instead
+  (``obs/health.py``): rounds/s, depth occupancy, retire-lag p50/p99,
+  watchdog margin, per-shard imbalance — rates measured since the
+  previous ``stats --live`` call, lock-free reads only.
 
 Divergences (all guarded crashes in the reference, documented in SURVEY.md
 section 3.3): unknown ids and an empty cluster are ignored instead of
@@ -298,6 +302,19 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
         # Framework extension (additive, like run-rounds): the obs
         # registry as Prometheus-style text exposition.  Empty registry
         # prints nothing — the reference command surface is untouched.
+        # `stats --live` (ISSUE 9) renders one health sample instead:
+        # the derived live view (rounds/s, depth occupancy, retire-lag
+        # p50/p99, watchdog margin, per-shard imbalance) from the
+        # process-wide sampler — rates are measured since the PREVIOUS
+        # `stats --live` call.  Lock-free reads; also writes the
+        # health_* gauges, so plain `stats` carries the family too.
+        if "--live" in cmd[1:]:
+            snap = obs.health.default_sampler().sample()
+            for k, v in snap.items():
+                if v is None:
+                    continue
+                out(f"{k} {'+Inf' if v == float('inf') else v}")
+            return True
         for ln in obs.default_registry().prometheus_text().splitlines():
             out(ln)
 

@@ -398,6 +398,73 @@ def supervised_sweep(  # ba-lint: donates(state)
     rounds: int | None = None,
     *,
     scenario=None,
+    resume="auto",
+    **kwargs,
+):
+    """Run a campaign under supervision, inside ONE flight-recorder run
+    scope (ISSUE 9).
+
+    The thin public layer over :func:`_supervised_sweep_impl` (which
+    documents the supervision surface — chaos plans, SupervisorConfig,
+    resume="auto", recovery/degrade/poison semantics): it resolves the
+    campaign's run_id BEFORE the first attempt — ``BA_TPU_RUN_ID`` >
+    an active scope > the resume checkpoint's stored id > a sha256
+    over the same (key, rounds, scenario) identity the campaign
+    fingerprint hashes — and holds the scope across EVERY attempt, so
+    retries, recoveries and the records they emit all correlate to one
+    run (and a killed process's successor, re-deriving the same id,
+    joins its predecessor's ledger).  The scope owner emits the
+    assembled ``flight_summary`` at the end; the id rides
+    ``result["supervisor"]["run_id"]``.
+    """
+    n_rounds = rounds
+    if n_rounds is None and scenario is not None:
+        n_rounds = scenario.rounds
+    inherited = None
+    if key is None and resume is not None and resume != "auto":
+        # Explicit-resume entry: the checkpoint header is the only
+        # identity we have — adopt its run_id (an unreadable/pre-
+        # recorder checkpoint just falls through to derivation; the
+        # impl will surface the real error).
+        if isinstance(resume, str):
+            try:
+                inherited = _snapshot.validate_carry_checkpoint(
+                    resume
+                ).get("run_id")
+            except (OSError, ValueError):
+                inherited = None
+        else:
+            inherited = getattr(resume, "run_id", None)
+
+    def _identity_material():
+        # Deferred: the fingerprint hashes the full scenario content —
+        # wasted when BA_TPU_RUN_ID / an outer scope decides the id.
+        fingerprint = (
+            _campaign_fingerprint(key, n_rounds, scenario)
+            if key is not None and n_rounds is not None
+            else None
+        )
+        return ("supervised", fingerprint or "", n_rounds)
+
+    rid = obs.flight.resolve_run_id(
+        inherited=inherited, material_fn=_identity_material
+    )
+    with obs.flight.run_scope(rid) as scope:
+        result = _supervised_sweep_impl(
+            key, state, rounds, scenario=scenario, resume=resume, **kwargs
+        )
+        result["supervisor"]["run_id"] = scope.run_id
+        if scope.owner:
+            obs.flight.emit_flight_summary(run_id=scope.run_id)
+    return result
+
+
+def _supervised_sweep_impl(  # ba-lint: donates(state)
+    key,
+    state,
+    rounds: int | None = None,
+    *,
+    scenario=None,
     chaos=None,
     config: SupervisorConfig | None = None,
     collect_decisions: bool = False,
@@ -588,6 +655,33 @@ def supervised_sweep(  # ba-lint: donates(state)
                     # exact regardless.
                     history_start = r0
                 sidecar_upto = r0
+                # Flight-recorder edge (ISSUE 9): an auto-resume entry
+                # IS a recovery — the predecessor process died between
+                # this checkpoint and campaign end (or completed, and
+                # the rerun replays the final window), and nobody else
+                # records the cross-process seam.  One `recovery`
+                # record stitches the two processes' ledgers; the
+                # supervisor's recovery BUDGET is untouched (nothing
+                # failed in THIS process).
+                obs.instant(
+                    "recovery", fault=FATAL, action="resume", attempt=0,
+                    from_round=r0, lost_rounds=0,
+                )
+                _metrics.emit(
+                    {
+                        "event": "recovery",
+                        "v": _metrics.SCHEMA_VERSION,
+                        "fault": FATAL,
+                        "action": "resume",
+                        "attempt": 0,
+                        "from_round": r0,
+                        "lost_rounds": 0,
+                        "error": (
+                            "auto-resume: prior process left a valid "
+                            "checkpoint family"
+                        ),
+                    }
+                )
     elif resume is not None:
         resume_arg = resume
         r0 = (

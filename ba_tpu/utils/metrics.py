@@ -46,6 +46,34 @@ import time
 
 SCHEMA_VERSION = 1
 
+# -- run correlation (ISSUE 9) ------------------------------------------------
+#
+# One campaign run = one run_id.  The flight recorder (``obs/flight.py``)
+# owns derivation and scoping; the primitive lives HERE — the bottom of
+# the obs stack — so the sink can stamp every record emitted while a run
+# scope is active and the tracer can ride the same state without a
+# layering inversion (obs.trace must never import obs.flight, which
+# imports the registry, which imports this module).  A single module
+# global, written only by the scope owner on the driving thread; reader
+# threads (the retire watchdog timer, host_work lanes) see either the
+# current id or None, both correct.
+
+_run_id: str | None = None
+
+
+def set_run_id(run_id: str | None) -> str | None:
+    """Install ``run_id`` as the active run (None clears).  Returns the
+    PREVIOUS value so scopes can nest/restore — use
+    ``obs.flight.run_scope`` rather than calling this directly."""
+    global _run_id
+    prev = _run_id
+    _run_id = run_id
+    return prev
+
+
+def active_run_id() -> str | None:
+    return _run_id
+
 
 class MetricsSink:
     """Append-mode JSON-lines emitter; a falsy target disables it."""
@@ -67,6 +95,12 @@ class MetricsSink:
             return
         record.setdefault("v", SCHEMA_VERSION)
         record.setdefault("ts", round(time.time(), 3))
+        if _run_id is not None:
+            # Run correlation (ISSUE 9): every record emitted while a
+            # flight-recorder run scope is active carries the run_id, so
+            # the FlightLog assembler can join span/checkpoint/recovery/
+            # recompile records of ONE campaign out of a shared stream.
+            record.setdefault("run_id", _run_id)
         line = json.dumps(record)
         # Telemetry must never kill the agreement path: ANY OSError —
         # failed open, ENOSPC mid-write, EPIPE on a closed stderr —

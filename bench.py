@@ -2216,6 +2216,13 @@ def _obs_finalize(obs_dir: str, platform: str) -> None:
 
     reg = obs.default_registry()
     reg.emit_snapshot(platform=platform)
+    # Flight summary (ISSUE 9): join the run's JSONL stream — every
+    # config's dispatch windows, checkpoints, recompiles — into one
+    # flight_summary record appended to metrics.jsonl (the whole bench
+    # invocation is ONE run scope, established at --obs setup, so
+    # per-config sweeps inherit instead of emitting per-sweep
+    # summaries).  Render with scripts/obs_report.py DIR --flight.
+    obs.flight.emit_flight_summary()
     obs.default_tracer().export_chrome(os.path.join(obs_dir, "trace.json"))
     with open(os.path.join(obs_dir, "metrics.prom"), "w") as f:
         f.write(reg.prometheus_text())
@@ -2320,6 +2327,13 @@ def main() -> None:
                 file=sys.stderr,
             )
         _metrics.configure(os.path.join(args.obs, "metrics.jsonl"))
+        # One run scope for the whole bench invocation (ISSUE 9):
+        # BA_TPU_RUN_ID pins it, else it derives from the config list —
+        # every record/span below carries the id, inner sweeps inherit,
+        # and _obs_finalize assembles ONE flight summary at exit.
+        _metrics.set_run_id(
+            _obs.flight.resolve_run_id("bench", args.configs)
+        )
     # Persistent XLA cache: repeat bench invocations (bench_refresh.sh
     # attempts, A/B scripts) stop re-paying unchanged programs' compiles.
     # Compile time was never inside the timed loops, so cached-vs-fresh

@@ -60,6 +60,24 @@ def interleaved_ab(steps: dict, iters: int, reps: int) -> dict:
     return best
 
 
+def paired_best(reps: list) -> dict:
+    """Per-key best over repetition dicts — the same discipline
+    ``interleaved_ab`` applies to live timings, lifted to any
+    ``[{key: value}]`` series: each key's best (max) value across reps,
+    so run-to-run service drift folds OUT of a comparison instead of
+    into it.  ``scripts/bench_sentinel.py`` pairs fresh bench reps per
+    config with this before diffing against the committed baseline
+    (rates: higher is better; reps missing a key skip it)."""
+    best: dict = {}
+    for rep in reps:
+        for k, v in rep.items():
+            if v is None:
+                continue
+            if k not in best or v > best[k]:
+                best[k] = v
+    return best
+
+
 def emit(metric: str, batch: int, iters: int, variants: dict, **extra):
     print(json.dumps({"metric": metric, "batch": batch, "iters": iters,
                       **extra, "variants": variants}))

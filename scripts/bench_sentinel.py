@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over the committed bench trajectory
+(ISSUE 9).
+
+The repo carries its performance history as committed artifacts —
+``BENCH_*.json`` / ``MULTICHIP_*.json``, one or more per PR round, in
+several generations of shape (driver-wrapped ``{"parsed": ...}`` heads,
+per-config ``{"configs": {...}}`` lines, special-purpose span-budget and
+multichip A/Bs) — but until now no machine read them: a PR that halved
+``pipeline_sweep`` throughput would land silently.  This script is that
+machine:
+
+- **index**: every committed artifact normalizes into one trajectory
+  table — ``{source, round, config, platform, rounds_per_sec,
+  elapsed_s, ratios, acceptance}`` rows — and ``--index-only`` validates
+  that every artifact still parses into it (a jax-free CI stage;
+  ``--write BENCH_trajectory.json`` commits the table so future PRs
+  diff a machine-readable perf history instead of re-reading prose).
+- **compare**: ``--fresh DETAIL.json`` (repeatable) or ``--run`` (which
+  invokes ``bench.py`` ``--reps`` times) compares a fresh run against
+  the NEWEST committed baseline per (config, platform).  Fresh reps are
+  paired per config with ``scripts/ab_common.py``'s ``paired_best`` —
+  the same best-of-reps discipline the live A/B harness uses — and a
+  config regresses when ``fresh/baseline < 1/threshold``.  The default
+  threshold 2.0 matches the artifacts' own documented run-to-run noise
+  ("shared TPU service: ~2x"); tighten with ``--threshold`` on quiet
+  hosts.  Fresh acceptance booleans (``*_within_*``, ``bit_exact_*``,
+  ``*bounded*``...) that read False are regressions regardless of rate.
+- exits 0 green, 1 on regression, 2 on usage/parse errors — the shape a
+  CI stage or a serving SLO check wants (ROADMAP direction 2: this is
+  the template — swap committed artifacts for SLO targets).
+
+Stdlib only except the optional ``ab_common`` import (same directory);
+never imports jax or ba_tpu, so the index stages run anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+TRAJECTORY_FORMAT = "ba_tpu.bench_trajectory"
+TRAJECTORY_VERSION = 1
+
+# Not part of the committed trajectory: the table itself, and the
+# transient full-detail file bench.py rewrites on every invocation.
+EXCLUDE = {"BENCH_trajectory.json", "BENCH_detail.json"}
+
+_RATIO_KEY = re.compile(r"(speedup|_ratio|ratio_|overhead_frac|overhead_pct)")
+_ACCEPT_KEY = re.compile(
+    r"(within|bounded|bit_exact|_ok$|^ok$|recovery_within)"
+)
+
+
+def _round_of(path: str):
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _numeric(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _split_fields(blob: dict):
+    """(ratios, acceptance) out of one config/artifact dict: numeric
+    fields whose NAME declares a comparison, boolean fields whose name
+    declares a bound."""
+    ratios = {
+        k: v for k, v in blob.items() if _numeric(v) and _RATIO_KEY.search(k)
+    }
+    acceptance = {
+        k: v
+        for k, v in blob.items()
+        if isinstance(v, bool) and _ACCEPT_KEY.search(k)
+    }
+    return ratios, acceptance
+
+
+def _row(source, rnd, config, platform, blob: dict) -> dict:
+    ratios, acceptance = _split_fields(blob)
+    return {
+        "source": source,
+        "round": rnd,
+        "config": config,
+        "platform": platform,
+        "rounds_per_sec": (
+            blob.get("rounds_per_sec")
+            if _numeric(blob.get("rounds_per_sec"))
+            else None
+        ),
+        "elapsed_s": (
+            blob.get("elapsed_s") if _numeric(blob.get("elapsed_s")) else None
+        ),
+        "ratios": ratios,
+        "acceptance": acceptance,
+    }
+
+
+def normalize_doc(path: str, doc: dict) -> list:
+    """One artifact -> trajectory rows.  Raises ValueError on a shape no
+    rule covers — the ``--index-only`` CI stage turns that into a red
+    build instead of a silently unindexed artifact."""
+    source = os.path.basename(path)
+    rnd = _round_of(path)
+
+    # Driver-wrapped heads ({"n": ..., "cmd": ..., "parsed": {...}}) and
+    # driver multichip probes ({"n_devices": ..., "rc": ..., "ok": ...}).
+    if "parsed" in doc:
+        parsed = doc["parsed"]
+        if isinstance(parsed, dict):
+            return normalize_doc(path, parsed)
+        return [
+            _row(source, rnd, "driver", None, {"ok": doc.get("rc") == 0})
+        ]
+    if "n_devices" in doc and "rc" in doc:
+        blob = {
+            "ok": bool(doc.get("ok")),
+            "skipped": bool(doc.get("skipped")),
+        }
+        # A skipped probe asserts nothing; a run one asserts its rc.
+        acceptance = {} if blob["skipped"] else {"ok": blob["ok"]}
+        row = _row(source, rnd, "multichip_driver", None, {})
+        row["acceptance"] = acceptance
+        return [row]
+
+    metric = doc.get("metric")
+    if metric is None:
+        raise ValueError(f"{source}: no 'metric'/'parsed' key — unknown shape")
+
+    platform = doc.get("platform")
+    configs = doc.get("configs")
+    if isinstance(configs, dict) and configs:
+        rows = []
+        for name, blob in sorted(configs.items()):
+            if isinstance(blob, dict):
+                rows.append(_row(source, rnd, name, platform, blob))
+        if rows:
+            return rows
+
+    if metric == "span-budget":
+        blob = dict(doc)
+        # overhead_pct is the artifact's verdict; keep it as a ratio.
+        return [_row(source, rnd, "span_budget", platform, blob)]
+    if metric == "multichip-scenario-engine-ab":
+        return [_row(source, rnd, "multichip", platform, dict(doc))]
+
+    # Headline-only lines (the early BENCH_r0N heads): one row carrying
+    # the primary metric value.
+    blob = dict(doc)
+    if _numeric(doc.get("value")) and "rounds_per_sec" not in blob:
+        blob["rounds_per_sec"] = doc["value"]
+    return [_row(source, rnd, "headline", platform, blob)]
+
+
+def committed_artifacts(root: str) -> list:
+    out = []
+    for pattern in ("BENCH_*.json", "MULTICHIP_*.json"):
+        out.extend(glob.glob(os.path.join(root, pattern)))
+    return sorted(
+        p for p in out if os.path.basename(p) not in EXCLUDE
+    )
+
+
+def build_index(paths: list) -> dict:
+    rows, errors = [], []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+            got = normalize_doc(path, doc)
+            if not got:
+                raise ValueError(f"{path}: produced no rows")
+            rows.extend(got)
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: {e}")
+    rows.sort(
+        key=lambda r: (
+            r["config"], r["round"] if r["round"] is not None else -1,
+            r["source"],
+        )
+    )
+    return {
+        "format": TRAJECTORY_FORMAT,
+        "v": TRAJECTORY_VERSION,
+        "artifacts": len(paths),
+        "rows": rows,
+        "errors": errors,
+    }
+
+
+def newest_baselines(rows: list) -> dict:
+    """{(config, platform): row} — the newest committed rate per config,
+    keyed exactly as compare() looks them up.  ``round=None`` rows rank
+    oldest (they predate the rN convention)."""
+    best: dict = {}
+    for row in rows:
+        if row["rounds_per_sec"] is None:
+            continue
+        key = (row["config"], row["platform"])
+        rnd = row["round"] if row["round"] is not None else -1
+        cur = best.get(key)
+        if cur is None or rnd >= (
+            cur["round"] if cur["round"] is not None else -1
+        ):
+            best[key] = row
+    return best
+
+
+def compare(fresh_docs: list, baselines: dict, threshold: float):
+    """Fresh bench docs vs the committed trajectory.  Returns
+    ``(regressions, checked)``: how many configs regressed (rate below
+    baseline/threshold, or a fresh acceptance boolean reading False)
+    and how many were actually comparable — the caller must treat
+    ``checked == 0`` as a configuration failure, never a pass (a
+    platform or config-name drift would otherwise disable the gate
+    silently, green forever)."""
+    try:
+        from ab_common import paired_best
+    except ImportError:  # pragma: no cover - scripts/ not on sys.path
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ab_common import paired_best
+
+    reps, accept_fails = [], []
+    platforms: dict = {}
+    for i, doc in enumerate(fresh_docs):
+        rows = normalize_doc(f"fresh#{i}", doc)
+        rep = {}
+        for row in rows:
+            rep[row["config"]] = row["rounds_per_sec"]
+            platforms[row["config"]] = row["platform"]
+            for name, v in row["acceptance"].items():
+                if v is False:
+                    accept_fails.append((row["config"], name))
+        reps.append(rep)
+    best = paired_best(reps)
+
+    regressions = len(accept_fails)
+    for config, name in accept_fails:
+        print(f"RED  {config}: acceptance flag {name} is False")
+    checked = 0
+    for config, rate in sorted(best.items()):
+        base = baselines.get((config, platforms.get(config)))
+        if base is None or base["rounds_per_sec"] in (None, 0):
+            print(f"new  {config}: {rate:.1f} rounds/s (no committed "
+                  f"baseline at platform={platforms.get(config)})")
+            continue
+        checked += 1
+        ratio = rate / base["rounds_per_sec"]
+        verdict = "ok  "
+        if ratio < 1.0 / threshold:
+            verdict = "RED "
+            regressions += 1
+        print(
+            f"{verdict} {config}: fresh {rate:.1f} vs baseline "
+            f"{base['rounds_per_sec']:.1f} rounds/s "
+            f"({base['source']}, r{base['round']}) ratio {ratio:.3f} "
+            f"(threshold {1.0 / threshold:.3f})"
+        )
+    return regressions, checked
+
+
+def run_fresh(repo: str, configs: str | None, reps: int) -> list:
+    """Invoke ``bench.py`` ``reps`` times, collecting the full detail
+    doc of each (BA_TPU_BENCH_DETAIL routed to a temp file).  Reps are
+    whole-process so every rep pays the same setup — the per-config
+    pairing happens in compare() via ``paired_best``."""
+    docs = []
+    for rep in range(reps):
+        with tempfile.NamedTemporaryFile(
+            suffix=".json", delete=False
+        ) as tmp:
+            detail = tmp.name
+        try:
+            cmd = [sys.executable, os.path.join(repo, "bench.py")]
+            if configs:
+                cmd += ["--configs", configs]
+            env = dict(os.environ, BA_TPU_BENCH_DETAIL=detail)
+            proc = subprocess.run(
+                cmd, cwd=repo, env=env, capture_output=True, text=True
+            )
+            if proc.returncode != 0:
+                print(
+                    f"sentinel: bench rep {rep} failed rc="
+                    f"{proc.returncode}\n{proc.stderr[-2000:]}",
+                    file=sys.stderr,
+                )
+                raise SystemExit(2)
+            with open(detail) as fh:
+                docs.append(json.load(fh))
+        finally:
+            if os.path.exists(detail):
+                os.unlink(detail)
+    return docs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--index-only", action="store_true",
+                    help="build + validate the trajectory table and stop")
+    ap.add_argument("--write", metavar="PATH",
+                    help="write the trajectory table JSON to PATH")
+    ap.add_argument("--fresh", action="append", default=[],
+                    help="a fresh bench detail JSON to compare "
+                         "(repeatable; reps pair per config)")
+    ap.add_argument("--run", action="store_true",
+                    help="invoke bench.py to produce the fresh side")
+    ap.add_argument("--configs", default=None,
+                    help="bench.py --configs for --run")
+    ap.add_argument("--reps", type=int, default=1,
+                    help="bench.py invocations for --run (best-of pairs "
+                         "per config)")
+    ap.add_argument("--threshold", type=float, default=2.0,
+                    help="regression threshold: red when fresh < "
+                         "baseline/threshold (default 2.0 — the "
+                         "artifacts' documented run-to-run noise)")
+    args = ap.parse_args()
+    if args.threshold <= 1.0:
+        ap.error(f"--threshold {args.threshold} must be > 1.0")
+
+    paths = committed_artifacts(args.repo)
+    if not paths:
+        print(f"sentinel: no committed artifacts under {args.repo}",
+              file=sys.stderr)
+        return 2
+    index = build_index(paths)
+    if index["errors"]:
+        for err in index["errors"]:
+            print(f"sentinel: {err}", file=sys.stderr)
+        return 2
+    if args.write:
+        with open(args.write, "w") as fh:
+            json.dump(index, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"sentinel: wrote {len(index['rows'])} rows -> {args.write}")
+    if args.index_only:
+        print(
+            f"sentinel: indexed {index['artifacts']} artifacts -> "
+            f"{len(index['rows'])} trajectory rows, "
+            f"{len(newest_baselines(index['rows']))} baselines"
+        )
+        return 0
+
+    if args.run:
+        fresh = run_fresh(args.repo, args.configs, args.reps)
+    elif args.fresh:
+        fresh = []
+        for path in args.fresh:
+            try:
+                with open(path) as fh:
+                    fresh.append(json.load(fh))
+            except (OSError, ValueError) as e:
+                print(f"sentinel: --fresh {path}: {e}", file=sys.stderr)
+                return 2
+    else:
+        ap.error("give --index-only, --fresh FILE, or --run")
+        return 2  # unreachable
+
+    regressions, checked = compare(
+        fresh, newest_baselines(index["rows"]), args.threshold
+    )
+    if regressions:
+        print(f"sentinel: {regressions} regression(s)", file=sys.stderr)
+        return 1
+    if not checked:
+        # Comparing NOTHING is not green: a platform string or config
+        # name that drifted out of the baseline key set would otherwise
+        # turn the gate off silently, on every future run.
+        print(
+            "sentinel: no comparable configs between the fresh run and "
+            "the committed baselines — the gate compared nothing "
+            "(platform/config drift?); refusing to report green",
+            file=sys.stderr,
+        )
+        return 2
+    print(f"sentinel: green ({checked} config(s) within threshold)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

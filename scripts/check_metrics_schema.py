@@ -9,10 +9,13 @@ records), a registry ``metrics_snapshot``, and (ISSUE 4) the DEVICE
 tier: two tiny ``pipeline_sweep`` runs on the CPU backend at different
 capacities drive the real ``compiled_artifact`` (obs/xla.py AOT
 introspection) and ``recompile`` (obs/instrument.py explainer) emitters
-— plus (ISSUE 7) a tiny supervised run with a chaos plan driving the
-real ``fault_injected`` and ``recovery`` emitters — into a temp sink,
-then validates every line, including the typed shape of the
-device-tier and resilience records.  Run by ``scripts/ci.sh`` before
+— plus (ISSUES 7+9) a tiny SUPERVISED MESH campaign with a chaos plan
+and the flight recorder + health sampler live, driving the real
+``fault_injected``, ``recovery``, ``flight_span``, ``health_snapshot``
+and assembled ``flight_summary`` emitters — into a temp sink, then
+validates every line, including the typed shape of the device-tier,
+resilience and flight records, and the presence/shape of ``run_id`` on
+every record family that carries it.  Run by ``scripts/ci.sh`` before
 the tier-1 suite; standalone: ``JAX_PLATFORMS=cpu python
 scripts/check_metrics_schema.py``.
 """
@@ -89,10 +92,15 @@ def main() -> int:
             checkpoint_path=path + ".mesh_carry.npz",
             mesh=make_mesh((1, 1), ("data", "node")),
         )
-        # Resilience records (ISSUE 7): a tiny supervised run with a
-        # chaos plan drives the real fault_injected (chaos.py) and
-        # recovery (supervisor.py) emitters — one in-place transient
-        # retry, one fatal -> checkpoint resume.
+        # Resilience + flight-recorder records (ISSUES 7+9): a tiny
+        # SUPERVISED MESH campaign with a chaos plan and the recorder
+        # on (the sink is live, so every record carries the run's
+        # run_id) drives the real fault_injected (chaos.py), recovery
+        # (supervisor.py), flight_span (pipeline retire), and
+        # health_snapshot (obs/health.py, health_every=1) emitters —
+        # one in-place transient retry, one fatal -> checkpoint resume
+        # — and the scope owner assembles the flight_summary at the
+        # end.
         from ba_tpu.runtime import chaos
         from ba_tpu.runtime.supervisor import (
             SupervisorConfig, supervised_sweep,
@@ -108,6 +116,8 @@ def main() -> int:
             jr.key(6), make_sweep_state(jr.key(7), 4, 4), 4,
             rounds_per_dispatch=2, chaos=plan,
             checkpoint_every=2, checkpoint_path=path + ".sup_{round}.npz",
+            mesh=make_mesh((1, 1), ("data", "node")),
+            health_every=1,
             config=SupervisorConfig(timeout_s=60.0, backoff_base_s=0.0),
         )
         obs.default_registry().emit_snapshot(sink=sink, source="ci-check")
@@ -119,6 +129,10 @@ def main() -> int:
             return 1
         bad = 0
         events = set()
+        from ba_tpu.obs import flight as _flight
+
+        def _num_or_null(v):
+            return v is None or isinstance(v, (int, float))
 
         def _no_const(tok):  # strict JSON: Python json tolerates
             raise ValueError(f"non-strict JSON constant {tok!r}")  # Infinity/NaN
@@ -137,6 +151,25 @@ def main() -> int:
                 )
                 bad += 1
             events.add(rec.get("event"))
+            # Run correlation (ISSUE 9): every record family that is by
+            # construction emitted from inside a campaign's run scope
+            # must carry a well-formed run_id — and ANY record carrying
+            # one must match the documented shape.
+            rid = rec.get("run_id")
+            if rec.get("event") in _flight.RUN_SCOPED_EVENTS and rid is None:
+                print(
+                    f"schema check: line {i} {rec.get('event')} record "
+                    f"missing run_id: {line[:160]}",
+                    file=sys.stderr,
+                )
+                bad += 1
+            if rid is not None and not _flight.valid_run_id(rid):
+                print(
+                    f"schema check: line {i} malformed run_id {rid!r}: "
+                    f"{line[:160]}",
+                    file=sys.stderr,
+                )
+                bad += 1
             # Device-tier records carry a typed shape beyond event/v.
             if rec.get("event") == "compiled_artifact":
                 numeric = (
@@ -228,6 +261,65 @@ def main() -> int:
                         file=sys.stderr,
                     )
                     bad += 1
+            elif rec.get("event") == "flight_span":
+                if not (
+                    rec.get("phase") == "retire"
+                    and isinstance(rec.get("dispatch"), int)
+                    and isinstance(rec.get("lo"), int)
+                    and isinstance(rec.get("hi"), int)
+                    and rec.get("lo") < rec.get("hi")
+                    and isinstance(rec.get("latency_s"), (int, float))
+                    and isinstance(rec.get("lag_s"), (int, float))
+                ):
+                    print(
+                        f"schema check: line {i} malformed flight_span: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "health_snapshot":
+                ints = ("rounds_total", "retires_total", "stalls_total")
+                nums = (
+                    "interval_s", "rounds_per_s", "depth_occupancy",
+                    "retire_lag_p50_s", "retire_lag_p99_s",
+                    "dispatch_latency_max_s", "watchdog_margin_s",
+                    "plane_imbalance", "carry_imbalance",
+                )
+                if not (
+                    all(isinstance(rec.get(f), int) for f in ints)
+                    and all(_num_or_null(rec.get(f)) for f in nums)
+                ):
+                    print(
+                        f"schema check: line {i} malformed "
+                        f"health_snapshot: {line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "flight_summary":
+                ckpts = rec.get("checkpoints")
+                if not (
+                    isinstance(rec.get("contiguous"), bool)
+                    and isinstance(rec.get("windows"), int)
+                    and isinstance(ckpts, list)
+                    and all(
+                        isinstance(c, dict)
+                        and isinstance(c.get("round"), int)
+                        and isinstance(c.get("path"), str)
+                        and isinstance(c.get("shard_layout"), dict)
+                        for c in ckpts
+                    )
+                    and isinstance(rec.get("recoveries"), list)
+                    and isinstance(rec.get("faults"), list)
+                    and isinstance(rec.get("recompiles"), list)
+                    and isinstance(rec.get("timeline"), list)
+                    and isinstance(rec.get("events"), dict)
+                ):
+                    print(
+                        f"schema check: line {i} malformed "
+                        f"flight_summary: {line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
             elif rec.get("event") == "metrics_snapshot":
                 # Shard-labeled gauges (ISSUE 8): the engine stamps the
                 # device count and per-device carry/plane byte shares
@@ -257,6 +349,9 @@ def main() -> int:
             "scenario_checkpoint",
             "recovery",
             "fault_injected",
+            "flight_span",
+            "health_snapshot",
+            "flight_summary",
         }
         if not want <= events:
             print(

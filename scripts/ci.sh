@@ -138,6 +138,18 @@ mutate_and_expect BA301 core/om.py \
 mutate_and_expect BA101 parallel/shard.py \
     'def _mut101_shard(x):
     return x.block_until_ready()' || exit 1
+# ISSUE 9: BA301 grew the symmetric host-tier scope — obs modules
+# (the flight recorder and health sampler in particular) must never
+# import through ba_tpu.core/ba_tpu.ops.  Prove the direction is live.
+mutate_and_expect BA301 obs/flight.py \
+    'from ba_tpu.core import om as _mut_core' || exit 1
+mutate_and_expect BA301 obs/health.py \
+    'from ba_tpu.ops import sweep_step as _mut_ops' || exit 1
+# ...including INDIRECTLY: an obs module pulling a host-layer module
+# whose own closure reaches core (parallel.sweep -> core.*) is the
+# likelier real-world breach.
+mutate_and_expect BA301 obs/health.py \
+    'from ba_tpu.parallel import sweep as _mut_indirect' || exit 1
 
 echo "== scenario spec round-trip =="
 # ISSUE 5: the committed campaign specs must load, validate, round-trip
@@ -182,6 +194,29 @@ if ! XLA_FLAGS="--xla_force_host_platform_device_count=8" \
         -q -k "mesh" -p no:cacheprovider; then
     echo "mesh parity tests failed" >&2
     exit 1
+fi
+
+echo "== bench trajectory index (jax-free) =="
+# ISSUE 9: every committed BENCH_*/MULTICHIP_* artifact must still
+# normalize into the sentinel's trajectory table (an artifact whose
+# shape drifted out of the indexer would silently fall out of the
+# regression baseline set).  Stdlib-only — sub-second, any host.
+if ! python scripts/bench_sentinel.py --index-only; then
+    echo "bench trajectory index failed" >&2
+    exit 1
+fi
+# The full perf-regression sentinel runs a REAL bench.py rep and
+# compares against the newest committed baseline per config — minutes
+# of wall clock, so it is opt-in like the resilience/multichip bench
+# configs: BA_TPU_CI_SENTINEL=1 (optionally BA_TPU_CI_SENTINEL_CONFIGS
+# to narrow the config list).
+if [ "${BA_TPU_CI_SENTINEL:-0}" = "1" ]; then
+    echo "== perf-regression sentinel (opt-in) =="
+    if ! python scripts/bench_sentinel.py --run \
+            --configs "${BA_TPU_CI_SENTINEL_CONFIGS:-pipeline_sweep,scenario_sweep}"; then
+        echo "perf-regression sentinel failed" >&2
+        exit 1
+    fi
 fi
 
 echo "== metrics JSONL schema check =="

@@ -4,12 +4,17 @@
 Usage:
     python scripts/obs_report.py DIR                 # bench.py --obs DIR
     python scripts/obs_report.py --trace trace.json --metrics metrics.jsonl
+    python scripts/obs_report.py DIR --flight [--run-id ID]   # ISSUE 9
 
 Reads the Chrome trace-event JSON written by ``obs.trace`` (span
 durations grouped by name) and/or the JSONL sink stream (event counts
 plus the last ``metrics_snapshot``'s counters, gauges, and histogram
 buckets) and prints aligned tables — the zero-dependency way to answer
-"where did the time go" without opening Perfetto.
+"where did the time go" without opening Perfetto.  ``--flight`` renders
+the assembled ``flight_summary`` record instead: one campaign run's
+correlated dispatch→retire→checkpoint→recovery timeline, shard
+provenance and recompile attribution (``obs/flight.py`` assembles at
+end-of-run; this renders what the stream carries).
 
 Stdlib only; never imports jax or ba_tpu (it must run anywhere the
 artifacts were copied to).
@@ -129,6 +134,124 @@ def report_device(artifacts: list, recompiles: list) -> None:
             print(f"  {r.get('fn', '?'):<24} {changes}")
 
 
+def report_flight(path: str, run_id: str | None = None) -> int:
+    """Render a run's assembled ``flight_summary`` (ISSUE 9) from the
+    JSONL stream: the correlated dispatch→retire→checkpoint→recovery
+    timeline, shard provenance, and recompile attribution.  Reads the
+    summary RECORD the scope owner appended at end-of-run (the engine
+    assembles; this renders) — ``run_id=None`` takes the stream's last.
+    """
+    summary = None
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("event") != "flight_summary":
+                continue
+            if run_id is None or rec.get("run_id") == run_id:
+                summary = rec  # last wins: the freshest assembly
+    if summary is None:
+        which = f" for run {run_id!r}" if run_id else ""
+        print(f"(no flight_summary record{which} in {path} — was the "
+              f"campaign run with a file-backed metrics sink?)",
+              file=sys.stderr)
+        return 1
+    rounds = summary.get("rounds")
+    print(f"== flight {summary.get('run_id')} ==")
+    print(
+        f"  rounds     {rounds[0]}..{rounds[1]}" if rounds
+        else "  rounds     (none retired)"
+    )
+    print(f"  contiguous {summary.get('contiguous')}")
+    print(f"  windows    {summary.get('windows')}")
+    lat = summary.get("dispatch_latency_max_s")
+    if lat is not None:
+        print(f"  worst dispatch latency {_fmt_s(lat)}")
+    layout = summary.get("shard_layout")
+    if layout:
+        print("  shard layout " + ", ".join(
+            f"{k}={v}" for k, v in sorted(layout.items())
+        ))
+    per_shard = summary.get("per_shard")
+    if per_shard:
+        for k, v in sorted(per_shard.items()):
+            print(f"  {k:<34} {v}")
+    ckpts = summary.get("checkpoints") or []
+    if ckpts:
+        print("== checkpoints ==")
+        for c in ckpts:
+            extra = ""
+            if c.get("shard_layout"):
+                extra = "  layout " + ",".join(
+                    f"{k}={v}" for k, v in sorted(c["shard_layout"].items())
+                )
+            print(f"  round {c.get('round'):>8}  "
+                  f"{_fmt_count(c.get('bytes') or 0)}B  "
+                  f"{c.get('path')}{extra}")
+    recoveries = summary.get("recoveries") or []
+    if recoveries:
+        print("== recoveries ==")
+        for r in recoveries:
+            print(f"  {r.get('fault'):<10} {r.get('action'):<10} "
+                  f"from round {r.get('from_round')} "
+                  f"(lost {r.get('lost_rounds')}): {r.get('error', '')}")
+    faults = summary.get("faults") or []
+    if faults:
+        print("== injected faults ==")
+        for f in faults:
+            print(f"  {f.get('kind'):<10} {f.get('phase'):<10} "
+                  f"round {f.get('round')} (plan {f.get('plan')})")
+    recompiles = summary.get("recompiles") or []
+    if recompiles:
+        print("== recompiles ==")
+        for r in recompiles:
+            changes = ", ".join(
+                f"{axis}: {old!r} -> {new!r}"
+                for axis, (old, new) in sorted(
+                    (r.get("changed") or {}).items()
+                )
+            )
+            cross = " [cross-process]" if r.get("cross_process") else ""
+            print(f"  {r.get('fn', '?'):<24} {changes}{cross}")
+    health = summary.get("last_health")
+    if health:
+        print("== last health sample ==")
+        for k in (
+            "rounds_per_s", "depth_occupancy", "retire_lag_p50_s",
+            "retire_lag_p99_s", "watchdog_margin_s", "plane_imbalance",
+            "carry_imbalance",
+        ):
+            v = health.get(k)
+            if v is not None:
+                time_like = k.endswith("_s") and not k.endswith("_per_s")
+                print(f"  {k:<24} {_fmt_s(v) if time_like else v}")
+    timeline = summary.get("timeline") or []
+    if timeline:
+        print(f"== timeline ({len(timeline)} events) ==")
+        for e in timeline:
+            kind = e.get("kind")
+            if kind == "dispatch_window":
+                desc = (f"rounds [{e.get('lo')}, {e.get('hi')}) "
+                        f"dispatch {e.get('dispatch')}")
+            elif kind == "checkpoint":
+                desc = f"round {e.get('round')} -> {e.get('path')}"
+            elif kind == "recovery":
+                desc = (f"{e.get('fault')}/{e.get('action')} from round "
+                        f"{e.get('from_round')}")
+            elif kind == "fault":
+                desc = (f"{e.get('injected')} injected at round "
+                        f"{e.get('round')} ({e.get('phase')})")
+            else:
+                desc = e.get("fn", "")
+            print(f"  {kind:<16} {desc}")
+    return 0
+
+
 def report_metrics(path: str) -> None:
     events: dict = {}
     snapshot = None
@@ -190,6 +313,13 @@ def main() -> int:
     ap.add_argument("dir", nargs="?", help="bench.py --obs output directory")
     ap.add_argument("--trace", help="Chrome trace-event JSON path")
     ap.add_argument("--metrics", help="metrics JSONL path")
+    ap.add_argument("--flight", action="store_true",
+                    help="render the assembled flight_summary (ISSUE 9) "
+                         "from the metrics JSONL instead of the span/"
+                         "metrics tables")
+    ap.add_argument("--run-id", default=None,
+                    help="which run's flight to render (default: the "
+                         "stream's last flight_summary)")
     args = ap.parse_args()
     trace, metrics = args.trace, args.metrics
     if args.dir:
@@ -197,6 +327,11 @@ def main() -> int:
         metrics = metrics or os.path.join(args.dir, "metrics.jsonl")
     if not trace and not metrics:
         ap.error("give DIR or --trace/--metrics")
+    if args.flight:
+        if not metrics or not os.path.exists(metrics):
+            print(f"(missing: {metrics})", file=sys.stderr)
+            return 1
+        return report_flight(metrics, run_id=args.run_id)
     found = False
     for path, render in ((trace, report_trace), (metrics, report_metrics)):
         if path and os.path.exists(path):

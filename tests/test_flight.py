@@ -1,0 +1,539 @@
+"""Flight recorder, live health view and perf sentinel tests (ISSUE 9:
+obs/flight.py, obs/health.py, scripts/bench_sentinel.py, and the
+run-id threading through the engine/supervisor/sink/checkpoints).
+
+The contracts, each pinned independently:
+
+1. **Run-id threading** — ``BA_TPU_RUN_ID`` pins, derivation is
+   deterministic, scopes nest with one owner, checkpoints carry the id
+   and resumes adopt it, and every JSONL record emitted inside a scope
+   is stamped.
+2. **Zero added sync** — the no-blocking dispatch-count proof re-runs
+   with the flight recorder AND the health sampler live, on an
+   8-device forced-host mesh, under full supervision, with
+   ``jax.block_until_ready`` monkeypatched to raise (the ISSUE 9
+   acceptance schedule proof).
+3. **Crash-consistent flight logs** — a recorded campaign SIGKILLed
+   mid-retire (subprocess, real signal) auto-resumes in a successor,
+   and the assembled timeline is contiguous across the process
+   boundary with ONE recovery edge, no duplicated dispatch windows,
+   and every checkpoint/recovery event exactly once under one run_id.
+4. **Sentinel flips** — green against the committed baselines, red on
+   a synthetically >=2x-degraded artifact for an existing config
+   (jax-free subprocess).
+"""
+
+import dataclasses
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.random as jr
+import pytest
+
+from ba_tpu import obs
+from ba_tpu.obs import flight, health
+from ba_tpu.parallel import make_mesh, make_sweep_state, pipeline_sweep
+from ba_tpu.parallel.pipeline import (
+    fresh_copy as _fresh,
+    load_carry_checkpoint,
+)
+from ba_tpu.runtime.backends import PyBackend
+from ba_tpu.runtime.cluster import Cluster
+from ba_tpu.runtime.repl import handle_command
+from ba_tpu.runtime.supervisor import SupervisorConfig, supervised_sweep
+from ba_tpu.scenario import compile_scenario, from_dict
+from ba_tpu.utils import metrics
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _campaign(R=12, B=16, cap=8):
+    key = jr.key(91)
+    state = make_sweep_state(jr.key(90), B, cap, order=1)
+    state = dataclasses.replace(
+        state, faulty=state.faulty.at[: B // 2, 0].set(True)
+    )
+    spec = from_dict(
+        {
+            "name": "flight-campaign",
+            "rounds": R,
+            "order": "attack",
+            "events": [
+                e
+                for e in [
+                    {"round": 2, "kill": [1]},
+                    {"round": 5, "set_faulty": [3], "value": True},
+                    {"round": 9, "revive": [1]},
+                ]
+                if e["round"] < R
+            ],
+        }
+    )
+    return key, state, compile_scenario(spec, B, cap, sparse=True)
+
+
+@pytest.fixture
+def sink_path(tmp_path):
+    """Route the process-wide JSONL sink to a temp file for one test,
+    restoring the (disabled-in-tests) default afterwards."""
+    path = tmp_path / "metrics.jsonl"
+    metrics.configure(str(path))
+    try:
+        yield path
+    finally:
+        metrics.configure(None)
+        metrics.set_run_id(None)
+
+
+def _records(path):
+    return [json.loads(l) for l in open(path) if l.strip()]
+
+
+# -- run-id derivation + scoping ----------------------------------------------
+
+
+def test_run_id_env_pins_and_validates(monkeypatch):
+    monkeypatch.setenv("BA_TPU_RUN_ID", "drill-42")
+    assert flight.resolve_run_id("anything") == "drill-42"
+    monkeypatch.setenv("BA_TPU_RUN_ID", "bad id with spaces")
+    with pytest.raises(ValueError, match="BA_TPU_RUN_ID"):
+        flight.resolve_run_id("anything")
+
+
+def test_derive_run_id_deterministic():
+    a = flight.derive_run_id(b"key", 64, "scenario")
+    assert a == flight.derive_run_id(b"key", 64, "scenario")
+    assert a != flight.derive_run_id(b"key", 65, "scenario")
+    assert flight.valid_run_id(a) and a.startswith("run-")
+    # Material boundaries matter: ("ab", "c") != ("a", "bc").
+    assert flight.derive_run_id("ab", "c") != flight.derive_run_id("a", "bc")
+
+
+def test_run_scope_nests_with_one_owner():
+    with flight.run_scope("outer-1") as outer:
+        assert outer.owner and outer.run_id == "outer-1"
+        assert metrics.active_run_id() == "outer-1"
+        with flight.run_scope("inner-2") as inner:
+            # The outer id wins; the inner scope is not the owner.
+            assert not inner.owner and inner.run_id == "outer-1"
+            assert metrics.active_run_id() == "outer-1"
+        assert metrics.active_run_id() == "outer-1"
+    assert metrics.active_run_id() is None
+    # Exception-safe restore.
+    with pytest.raises(RuntimeError):
+        with flight.run_scope("boom"):
+            raise RuntimeError("x")
+    assert metrics.active_run_id() is None
+
+
+# -- engine recording ---------------------------------------------------------
+
+
+def test_engine_records_one_correlated_run(sink_path, tmp_path):
+    R = 8
+    key, state, block = _campaign(R)
+    ck = tmp_path / "fl_{round}.npz"
+    out = pipeline_sweep(
+        key, state, R, scenario=block, rounds_per_dispatch=2,
+        checkpoint_every=4, checkpoint_path=str(ck), health_every=1,
+    )
+    rid = out["stats"]["run_id"]
+    assert flight.valid_run_id(rid)
+    metrics.default_sink().close()
+    recs = _records(sink_path)
+    # Every record of the run carries the one id.
+    assert {r.get("run_id") for r in recs} == {rid}
+    spans = [r for r in recs if r["event"] == "flight_span"]
+    assert [(s["lo"], s["hi"]) for s in spans] == [
+        (lo, lo + 2) for lo in range(0, R, 2)
+    ]
+    assert sum(r["event"] == "health_snapshot" for r in recs) == R // 2
+    assert out["stats"]["health_samples"] == R // 2
+    # The checkpoint header carries the id; a resume adopts it.
+    ckpt = load_carry_checkpoint(str(tmp_path / "fl_4.npz"))
+    assert ckpt.run_id == rid
+    resumed = pipeline_sweep(
+        None, None, R, scenario=block, resume=str(tmp_path / "fl_4.npz"),
+        rounds_per_dispatch=2,
+    )
+    assert resumed["stats"]["run_id"] == rid
+    # The owner appended one assembled summary per run (initial +
+    # resumed), both contiguous under the same id.
+    metrics.default_sink().close()
+    summaries = [
+        r for r in _records(sink_path) if r["event"] == "flight_summary"
+    ]
+    assert len(summaries) == 2
+    assert all(s["run_id"] == rid for s in summaries)
+    final = summaries[-1]
+    assert final["contiguous"] and final["rounds"] == [0, R]
+    assert [c["round"] for c in final["checkpoints"]] == [4, 8]
+    assert final["shard_layout"] == {"data": 1}
+
+
+def test_supervised_mesh_no_blocking_with_recorder_and_sampler(
+    eight_devices, monkeypatch, sink_path, tmp_path
+):
+    # THE ISSUE 9 schedule acceptance: recorder + sampler live, on an
+    # 8-device forced-host mesh, under full supervision — and the
+    # engine's only sync stays the depth-delayed retire fetch.
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    R, depth = 8, 3
+    key, state, block = _campaign(R)
+    mesh = make_mesh((8, 1), ("data", "node"))
+    events = []
+    out = supervised_sweep(
+        key, state, scenario=block, mesh=mesh,
+        depth=depth, rounds_per_dispatch=1, health_every=2,
+        checkpoint_every=4,
+        checkpoint_path=str(tmp_path / "mesh_{round}.npz"),
+        config=SupervisorConfig(timeout_s=60.0),
+        on_event=lambda kind, i: events.append((kind, i)),
+    )
+    dispatches = [i for kind, i in events if kind == "dispatch"]
+    retires = [i for kind, i in events if kind == "retire"]
+    assert dispatches == list(range(R))
+    assert retires == list(range(R))
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [
+        ("dispatch", i) for i in range(depth + 1)
+    ]
+    assert out["stats"]["max_in_flight"] == depth + 1
+    assert out["stats"]["health_samples"] == R // 2
+    rid = out["supervisor"]["run_id"]
+    metrics.default_sink().close()
+    recs = _records(sink_path)
+    assert {r.get("run_id") for r in recs} == {rid}
+    summary = [r for r in recs if r["event"] == "flight_summary"][-1]
+    assert summary["contiguous"] and summary["rounds"] == [0, R]
+    assert summary["shard_layout"] == {"data": 8, "node": 1}
+    healths = [r for r in recs if r["event"] == "health_snapshot"]
+    assert healths and healths[-1]["shards"] == 8
+    # Watchdog margin is live: timeout was pinned at 60 s.
+    assert 0 < healths[-1]["watchdog_margin_s"] < 60.0
+    # Imbalance gauges are MEASURED per-device shares, live: an even
+    # 16/8 split reads 1.0 on both the carry and the staged planes.
+    assert healths[-1]["carry_imbalance"] == pytest.approx(1.0)
+    assert healths[-1]["plane_imbalance"] == pytest.approx(1.0)
+    assert healths[-1]["plane_bytes_per_shard"] > 0
+
+
+def test_kill_mid_retire_then_resume_assembles_contiguous_flight(tmp_path):
+    # ISSUE 9 satellite: SIGKILL a RECORDED campaign mid-retire (real
+    # signal, subprocess), auto-resume the same call, and the assembled
+    # flight log is contiguous across the process boundary — one
+    # recovery edge, no duplicated dispatch windows, every checkpoint
+    # exactly once, one run_id.
+    R = 12
+    jsonl = tmp_path / "flight.jsonl"
+    ck = tmp_path / "kill_{round}.npz"
+    child = f'''
+import dataclasses, jax.random as jr
+from ba_tpu.parallel import make_sweep_state
+from ba_tpu.runtime import chaos
+from ba_tpu.runtime.supervisor import SupervisorConfig, supervised_sweep
+from ba_tpu.scenario import compile_scenario, from_dict
+
+key = jr.key(91)
+state = make_sweep_state(jr.key(90), 16, 8, order=1)
+state = dataclasses.replace(
+    state, faulty=state.faulty.at[:8, 0].set(True)
+)
+spec = from_dict({{
+    "name": "flight-campaign", "rounds": {R}, "order": "attack",
+    "events": [
+        {{"round": 2, "kill": [1]}},
+        {{"round": 5, "set_faulty": [3], "value": True}},
+        {{"round": 9, "revive": [1]}},
+    ],
+}})
+block = compile_scenario(spec, 16, 8, sparse=True)
+plan = chaos.from_dict({{
+    "name": "mid-retire-kill",
+    "faults": [{{"round": 10, "kind": "kill", "phase": "retire"}}],
+}})
+supervised_sweep(
+    key, state, scenario=block, rounds_per_dispatch=2,
+    checkpoint_every=4, checkpoint_path={str(ck)!r},
+    health_every=2, chaos=plan,
+    config=SupervisorConfig(timeout_s=60.0),
+)
+raise SystemExit("unreachable: the kill fault must have fired")
+'''
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", BA_TPU_METRICS=str(jsonl),
+        BA_TPU_COMPILE_LEDGER="0",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", child],
+        capture_output=True, text=True, cwd=str(REPO), timeout=600, env=env,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stdout + proc.stderr
+    # The successor: the SAME call (fingerprint-derived run id — no
+    # env, no handshake), recording into the SAME stream.
+    key, state, block = _campaign(R)
+    metrics.configure(str(jsonl))
+    try:
+        got = supervised_sweep(
+            key, _fresh(state), scenario=block, rounds_per_dispatch=2,
+            checkpoint_every=4, checkpoint_path=str(ck), health_every=2,
+            config=SupervisorConfig(timeout_s=60.0),
+        )
+        rid = got["supervisor"]["run_id"]
+        metrics.default_sink().close()
+    finally:
+        metrics.configure(None)
+        metrics.set_run_id(None)
+    recs = _records(jsonl)
+    # One run id across BOTH processes' records (the successor
+    # re-derived it from the campaign identity).
+    assert {r.get("run_id") for r in recs} == {rid}
+    summary = [r for r in recs if r["event"] == "flight_summary"][-1]
+    # Contiguous across the process boundary...
+    assert summary["contiguous"] and summary["rounds"] == [0, R]
+    # ...no duplicated dispatch windows (replayed windows dedup on the
+    # round grid)...
+    los = [w["lo"] for w in (
+        e for e in summary["timeline"] if e["kind"] == "dispatch_window"
+    )]
+    assert los == list(range(0, R, 2))
+    # ...exactly ONE recovery edge (the successor's auto-resume)...
+    assert len(summary["recoveries"]) == 1
+    assert summary["recoveries"][0]["action"] == "resume"
+    assert sum(r["event"] == "recovery" for r in recs) == 1
+    # ...and every checkpoint exactly once, bit-consistent with the
+    # raw records (the child got round 4 and 8 out; the successor
+    # re-wrote from its resume point onward).
+    ck_rounds = [c["round"] for c in summary["checkpoints"]]
+    assert ck_rounds == sorted(set(ck_rounds))
+    assert ck_rounds[-1] == R
+    raw_rounds = {
+        r["round"] for r in recs if r["event"] == "scenario_checkpoint"
+    }
+    assert set(ck_rounds) == raw_rounds
+    # The surviving checkpoint headers carry the same run id.
+    for rnd in ck_rounds:
+        assert load_carry_checkpoint(
+            str(tmp_path / f"kill_{rnd}.npz")
+        ).run_id == rid
+
+
+# -- health sampler -----------------------------------------------------------
+
+
+def test_health_sampler_windows_and_gauges():
+    reg = obs.registry.MetricsRegistry()
+    sampler = health.HealthSampler(reg, timeout_s=30.0)
+    rounds_c = reg.counter("pipeline_rounds_total")
+    reg.counter("pipeline_retires_total")
+    occ = reg.histogram(
+        "pipeline_depth_occupancy", base=1.0, n_buckets=16
+    )
+    lag = reg.histogram("pipeline_retire_lag_s")
+    lat = reg.histogram("pipeline_dispatch_latency_s")
+    # Pre-window sample: every windowed field is None — never a fake
+    # zero or a lifetime blend.
+    first = sampler.sample()
+    assert first["rounds_per_s"] is None
+    assert first["depth_occupancy"] is None
+    assert first["retire_lag_p50_s"] is None
+    assert first["watchdog_margin_s"] is None
+    rounds_c.inc(100)
+    reg.counter("pipeline_retires_total").inc(10)
+    for _ in range(10):
+        occ.record(3)
+    for _ in range(9):
+        lag.record(0.001)
+    lag.record(0.5)
+    lat.record(0.25)
+    snap = sampler.sample()
+    assert snap["rounds_per_s"] > 0
+    assert snap["rounds_total"] == 100
+    assert snap["depth_occupancy"] == 3.0
+    # p50 sits in the ~1ms bucket, p99 reaches the 0.5 s outlier.
+    assert snap["retire_lag_p50_s"] < 0.01
+    assert snap["retire_lag_p99_s"] >= 0.5
+    # The window's worst latency reads as its bucket's UPPER edge (the
+    # histogram's .max is lifetime-scoped — deliberately unused), so
+    # the margin errs conservative by at most one bucket factor.
+    assert 0.25 <= snap["dispatch_latency_max_s"] <= 0.5
+    assert snap["watchdog_margin_s"] == pytest.approx(
+        30.0 - snap["dispatch_latency_max_s"]
+    )
+    # The gauge family landed in the registry.
+    text = reg.prometheus_text()
+    assert "health_rounds_per_s" in text
+    assert "health_watchdog_margin_s" in text
+    # Second window with no new rounds: rate drops to 0 — and the
+    # latency window is EMPTY, so the margin reports None instead of
+    # replaying the last window's (or a lifetime) max forever.
+    snap2 = sampler.sample()
+    assert snap2["rounds_per_s"] == 0.0
+    assert snap2["watchdog_margin_s"] is None
+
+
+def test_health_sampler_prime_isolates_prior_campaigns():
+    # The registry outlives campaigns: a primed sampler must not read
+    # an earlier sweep's totals as its first window (the engine primes
+    # its per-sweep sampler before the first dispatch).
+    reg = obs.registry.MetricsRegistry()
+    occ = reg.histogram(
+        "pipeline_depth_occupancy", base=1.0, n_buckets=16
+    )
+    for _ in range(10):
+        occ.record(4)  # a previous depth-4 campaign's lifetime record
+    reg.counter("pipeline_rounds_total").inc(1000)
+    sampler = health.HealthSampler(reg)
+    sampler.prime()
+    occ.record(1)
+    occ.record(1)
+    reg.counter("pipeline_rounds_total").inc(2)
+    snap = sampler.sample()
+    assert snap["depth_occupancy"] == 1.0  # not (40 + 2) / 12
+    assert snap["rounds_total"] == 1002
+    assert snap["rounds_per_s"] is not None  # prime opened the window
+
+
+def test_health_snapshot_record_carries_run_id(sink_path):
+    reg = obs.registry.MetricsRegistry()
+    sampler = health.HealthSampler(reg)
+    with flight.run_scope("health-run-1"):
+        sampler.sample(emit=True, dispatch=3)
+    metrics.default_sink().close()
+    recs = _records(sink_path)
+    assert recs and recs[-1]["event"] == "health_snapshot"
+    assert recs[-1]["run_id"] == "health-run-1"
+    assert recs[-1]["dispatch"] == 3
+
+
+def test_registry_per_shard_naming_rule():
+    reg = obs.registry.MetricsRegistry()
+    reg.gauge("scenario_plane_bytes_per_shard")  # canonical spelling
+    with pytest.raises(ValueError, match="_per_shard"):
+        reg.gauge("per_shard_plane_bytes")
+    with pytest.raises(ValueError, match="_per_shard"):
+        reg.counter("plane_per_shard_bytes")
+    with pytest.raises(ValueError, match="_per_shard"):
+        reg.histogram("plane_bytes_per_shard_s")
+    # Plain 'shards' (no per-device-share claim) stays legal.
+    reg.gauge("pipeline_shards")
+
+
+def test_repl_stats_live(monkeypatch):
+    cluster = Cluster(4, PyBackend(), seed=0)
+    lines = []
+    assert handle_command(cluster, "stats --live", lines.append)
+    keys = {l.split(" ")[0] for l in lines}
+    assert "rounds_total" in keys and "stalls_total" in keys
+    # The plain exposition path is untouched.
+    lines2 = []
+    assert handle_command(cluster, "stats", lines2.append)
+
+
+# -- ledger run-id riders -----------------------------------------------------
+
+
+def test_compile_ledger_rows_ride_run_id(tmp_path):
+    ledger = tmp_path / "ledger.json"
+    obs.reset_first_calls()
+    obs.configure_compile_ledger(str(ledger), {"jax": "x"})
+    try:
+        with flight.run_scope("ledger-run"):
+            first, changed, cross = obs.classify_compile(
+                "fn_a", {"capacity": 4}
+            )
+        assert first and changed is None
+        doc = json.loads(ledger.read_text())
+        assert doc["fns"]["fn_a"][0]["run_id"] == "ledger-run"
+        # A NEW process (fresh session state) compiling the same axes
+        # under a different run must NOT read as a cross-process change
+        # — the rider is provenance, not identity.
+        obs.reset_first_calls()
+        obs.configure_compile_ledger(str(ledger), {"jax": "x"})
+        with flight.run_scope("ledger-run-2"):
+            first, changed, cross = obs.classify_compile(
+                "fn_a", {"capacity": 4}
+            )
+        assert first and changed is None and not cross
+    finally:
+        obs.configure_compile_ledger(None)
+        obs.reset_first_calls()
+
+
+# -- bench sentinel -----------------------------------------------------------
+
+
+def _sentinel(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_sentinel.py"),
+         *args],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+
+
+def test_sentinel_index_only_green():
+    proc = _sentinel("--index-only")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "trajectory rows" in proc.stdout
+
+
+def test_sentinel_green_against_committed_baseline():
+    proc = _sentinel("--fresh", str(REPO / "BENCH_resilience_r10.json"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "green" in proc.stdout
+
+
+def test_sentinel_red_on_degraded_artifact(tmp_path):
+    doc = json.load(open(REPO / "BENCH_resilience_r10.json"))
+    doc["configs"]["resilience"]["rounds_per_sec"] /= 2.5  # >= 2x slower
+    degraded = tmp_path / "degraded.json"
+    degraded.write_text(json.dumps(doc))
+    proc = _sentinel("--fresh", str(degraded))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RED" in proc.stdout and "regression" in proc.stderr
+
+
+def test_sentinel_red_on_false_acceptance_flag(tmp_path):
+    doc = json.load(open(REPO / "BENCH_resilience_r10.json"))
+    doc["configs"]["resilience"]["recovery_within_15pct"] = False
+    bad = tmp_path / "accept.json"
+    bad.write_text(json.dumps(doc))
+    proc = _sentinel("--fresh", str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+def test_sentinel_refuses_vacuous_comparison(tmp_path):
+    # Comparing NOTHING must not be green: a fresh doc whose platform
+    # matches no committed baseline key (the silent-gate-off drift)
+    # exits 2, distinct from both green (0) and regression (1).
+    doc = json.load(open(REPO / "BENCH_resilience_r10.json"))
+    doc["platform"] = "made-up-platform"
+    drifted = tmp_path / "drifted.json"
+    drifted.write_text(json.dumps(doc))
+    proc = _sentinel("--fresh", str(drifted))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "compared nothing" in proc.stderr
+
+
+def test_committed_trajectory_artifact_is_current():
+    # BENCH_trajectory.json is the sentinel's own index, committed: it
+    # must stay regenerable byte-for-byte from the committed artifacts
+    # (a drifted table would silently mis-baseline future PRs).
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import bench_sentinel
+        index = bench_sentinel.build_index(
+            bench_sentinel.committed_artifacts(str(REPO))
+        )
+    finally:
+        sys.path.pop(0)
+    committed = json.load(open(REPO / "BENCH_trajectory.json"))
+    assert committed == json.loads(json.dumps(index))
