@@ -23,7 +23,7 @@ Usage::
     python -m ba_tpu.analysis ba_tpu/ examples/ bench.py
     python -m ba_tpu.analysis --format json --rules BA101,BA301 path/
 
-Rules (docs/DESIGN.md §11 has the full table and rationale):
+Rules (docs/DESIGN.md §12 has the full table and rationale):
 
 ====== ========================= =========================================
 code   name                      invariant

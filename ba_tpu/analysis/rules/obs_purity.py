@@ -37,15 +37,30 @@ assembly/sampling paths that run from watchdog threads and atexit
 hooks).  Importing through another obs module whose through-obs
 closure reaches core/ops is flagged at the edge that lets it in, same
 as the forward direction.
+
+The SERVING front-end (ISSUE 10): ``ba_tpu.runtime.serve`` joins the
+host-tier scope at MODULE level — its import-time closure must never
+reach ``ba_tpu.core``/``ba_tpu.ops`` (admission control, fault-plan
+validation and client shaping must run on hosts without jax, and
+``import ba_tpu.runtime.serve`` must never pay a jax import).  Unlike
+the obs modules, serve's DISPATCHER legitimately drives the engine, so
+FUNCTION-LOCAL imports are the sanctioned lazy seam (the
+``runtime/backends.py`` discipline) — the check skips imports nested
+inside a function body and flags everything at module scope, including
+module-level imports whose own closure reaches the jitted trees.
 """
 
 from __future__ import annotations
+
+import ast
 
 from ba_tpu.analysis.base import Rule, register
 
 SCOPES = ("ba_tpu.core", "ba_tpu.ops")
 OBS = "ba_tpu.obs"
 SINK = "ba_tpu.utils.metrics"
+# Host-tier-at-module-level modules: the serving front-end (ISSUE 10).
+HOST_TIER_MODULES = ("ba_tpu.runtime.serve",)
 
 
 def _in_scope(modname: str) -> bool:
@@ -75,6 +90,11 @@ class ObsPurity(Rule):
     def check_module(self, mod, project):
         if _in_obs_scope(mod.modname):
             yield from self._check_host_tier(mod, project)
+            return
+        if mod.modname in HOST_TIER_MODULES:
+            yield from self._check_host_tier(
+                mod, project, module_level_only=True
+            )
             return
         if not _in_scope(mod.modname):
             return
@@ -128,13 +148,18 @@ class ObsPurity(Rule):
                     "driver",
                 )
 
-    def _check_host_tier(self, mod, project):
+    def _check_host_tier(self, mod, project, module_level_only=False):
         """The reverse scope (ISSUE 9): obs modules never import the
         jitted trees — directly, or through ANY intermediary (unlike
         the forward rule, the closure here is unfiltered: an obs module
         pulling ``ba_tpu.parallel`` in would make ``import ba_tpu.obs``
         pay the core/jax import chain, which is exactly the host-tier
-        breach, whoever sits in the middle)."""
+        breach, whoever sits in the middle).
+
+        ``module_level_only`` (ISSUE 10, the serving front-end): only
+        imports OUTSIDE any function body count — a function-local
+        import is the sanctioned lazy engine seam, paid on the
+        dispatcher thread instead of at ``import`` time."""
         seen_lines: set = set()
 
         def once(node, message):
@@ -142,11 +167,31 @@ class ObsPurity(Rule):
                 seen_lines.add(node.lineno)
                 yield self.finding(mod, node, message)
 
+        lazy_spans = ()
+        if module_level_only:
+            lazy_spans = tuple(
+                (f.lineno, f.end_lineno or f.lineno)
+                for f in ast.walk(mod.tree)
+                if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            )
+
+        def is_lazy(node) -> bool:
+            return any(
+                lo <= node.lineno <= hi for lo, hi in lazy_spans
+            )
+
         for node, target in mod.import_records:
+            if module_level_only and is_lazy(node):
+                continue
             if _is_jit_tree(target):
                 yield from once(
                     node,
-                    f"host-tier obs module imports '{target}' — "
+                    f"host-tier module imports '{target}' — "
+                    f"{mod.modname} must stay importable without the "
+                    f"jitted trees (ba_tpu.core/ba_tpu.ops); reach "
+                    f"them lazily from a function body instead"
+                    if module_level_only
+                    else f"host-tier obs module imports '{target}' — "
                     f"ba_tpu.obs must stay importable without the "
                     f"jitted trees (ba_tpu.core/ba_tpu.ops); observe "
                     f"their drivers from runtime/ or parallel/ instead",
@@ -160,7 +205,14 @@ class ObsPurity(Rule):
             ):
                 yield from once(
                     node,
-                    f"host-tier obs module imports '{target}', whose "
+                    f"host-tier module imports '{target}', whose "
                     f"import closure reaches the jitted trees "
-                    f"(ba_tpu.core/ba_tpu.ops) — obs is host-tier",
+                    f"(ba_tpu.core/ba_tpu.ops) — "
+                    + (
+                        f"{mod.modname} is host-tier at module level "
+                        f"(lazy function-body imports are the "
+                        f"sanctioned engine seam)"
+                        if module_level_only
+                        else "obs is host-tier"
+                    ),
                 )

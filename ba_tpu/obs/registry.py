@@ -175,6 +175,19 @@ class MetricsRegistry:
                 f"END with '_per_shard' — the per-device-share suffix "
                 f"rule (DESIGN §8) keeps mesh gauge names joinable"
             )
+        # The serving front-end's family (ISSUE 10) mirrors the rule in
+        # the other direction: a metric owned by the service spells the
+        # `serve_` PREFIX — `serve_queue_depth`, never `queue_serve_*`
+        # — so one Prometheus prefix match scrapes the whole service
+        # dashboard.  Token-wise ("_"-split), not substring: names like
+        # `equivocation_observed` contain "serve" only as letters.
+        if "serve" in name.split("_") and not name.startswith("serve_"):
+            raise ValueError(
+                f"metric name {name!r} mentions the serve token but "
+                f"does not START with 'serve_' — the service-metric "
+                f"prefix rule (DESIGN §8) keeps the serving dashboard "
+                f"one prefix match"
+            )
         with self._lock:
             inst = self._instruments.get(name)
             if inst is None:

@@ -68,7 +68,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ba_tpu import obs
 from ba_tpu.core.election import elect_lowest_id
 from ba_tpu.core.state import SimState
-from ba_tpu.core.types import UNDEFINED
+from ba_tpu.core.types import COMMAND_DTYPE, UNDEFINED
 from ba_tpu.parallel.multihost import put_global
 from ba_tpu.parallel.sweep import agreement_step
 from ba_tpu.utils import metrics as _metrics
@@ -731,6 +731,493 @@ def load_carry_checkpoint(path: str) -> CarryCheckpoint:
     )
 
 
+# -- coalesced serving batches (ISSUE 10) -------------------------------------
+#
+# The serving front-end (``runtime/serve.py``) coalesces concurrent
+# interactive requests into ONE padded batch dimension.  The contract
+# that makes coalescing safe to offer at all is slot independence:
+# every batched result must be BIT-EXACT with the same request run
+# alone at equal padded capacity.  Two things deliver it:
+#
+# 1. **Per-slot key schedules.**  The plain engine derives round r's
+#    instance-i key as ``fold_in(fold_in(base, r), i)`` — so a request
+#    sharing a batch at slot 3 would draw a different stream than the
+#    same request alone at slot 0.  A coalesced batch instead carries
+#    one base key PER SLOT (``key_data`` [B, ...]) and every slot folds
+#    instance index 0: slot b draws exactly the stream its own B=1 run
+#    would (:func:`slot_round_keys`).
+# 2. **Per-slot counter blocks.**  The engine's counter block sums over
+#    the batch (and "unanimous" is a batch-global verdict), which would
+#    entangle cohabiting requests.  :func:`slot_counter_delta` keeps
+#    the same formulas per slot, exactly as a B=1 batch reduces them —
+#    a one-instance round is always unanimous, so that column is a
+#    constant 1 per round, which is precisely what the alone run's
+#    ``histogram.max() == 1`` computes.
+
+
+def make_slot_key_schedule(slot_keys, counter: int = 0) -> KeySchedule:
+    """A :class:`KeySchedule` carrying one base key PER SLOT.
+
+    ``slot_keys`` is a sequence of typed keys (one per batch slot); the
+    stacked raw data is COPIED (``jnp.stack`` allocates), so the
+    callers' keys survive the schedule entering the donation thread —
+    same contract as :func:`make_key_schedule`.
+    """
+    data = jnp.stack([jnp.asarray(jr.key_data(k)) for k in slot_keys])
+    return KeySchedule(
+        key_data=data, counter=jnp.asarray(counter, jnp.int32)
+    )
+
+
+def slot_round_keys(sched: KeySchedule) -> jax.Array:
+    """The current round's per-slot keys from a slot schedule
+    (trace-time, like :func:`round_keys`).
+
+    Slot ``b`` derives ``fold_in(fold_in(base_b, counter), 0)`` — the
+    exact key its own B=1 run's :func:`round_keys` derives for instance
+    0, which is the whole coalescing bit-exactness contract.  The
+    ``fold_in`` here is the sanctioned on-device derivation (ba-lint
+    BA102 bans only host-loop splits).
+    """
+
+    def one(kd):
+        base = jr.wrap_key_data(kd)
+        return jr.fold_in(
+            jr.fold_in(base, sched.counter), jnp.uint32(0)
+        )
+
+    return jax.vmap(one)(sched.key_data)
+
+
+def slot_counter_delta(
+    out: dict, state: SimState, scenario: bool
+) -> jax.Array:
+    """One round's PER-SLOT counter increments (trace-time, in-scan):
+    ``[B, C]`` where row ``b`` is bit-identical to the delta a B=1 run
+    of slot ``b`` alone would fold into its (scenario) counter block —
+    the same formulas as :func:`agreement_counter_delta` /
+    :func:`scenario_counter_delta` with the batch reductions dropped
+    and the unanimity verdict fixed at its B=1 value (one instance
+    always decides unanimously)."""
+    decision = out["decision"]
+    maj = out["majorities"]
+    idx = jnp.arange(state.faulty.shape[1])[None, :]
+    lieutenants = state.alive & (idx != state.leader[:, None])
+    big = jnp.asarray(127, maj.dtype)
+    mmax = jnp.max(jnp.where(lieutenants, maj, -big), axis=1)
+    mmin = jnp.min(jnp.where(lieutenants, maj, big), axis=1)
+    disagree = (mmax != mmin) & lieutenants.any(axis=1)
+    traitor = (state.faulty & state.alive).any(axis=1)
+    cols = [
+        (decision == UNDEFINED).astype(jnp.int32),
+        jnp.ones_like(decision, dtype=jnp.int32),
+        (disagree & traitor).astype(jnp.int32),
+    ]
+    if scenario:
+        honest_lt = lieutenants & ~state.faulty
+        hmax = jnp.max(jnp.where(honest_lt, maj, -big), axis=1)
+        hmin = jnp.min(jnp.where(honest_lt, maj, big), axis=1)
+        ic1 = (hmax != hmin) & honest_lt.any(axis=1)
+        leader_faulty = jnp.take_along_axis(
+            state.faulty, state.leader[:, None], axis=1
+        )[:, 0]
+        disobey = (honest_lt & (maj != state.order[:, None])).any(axis=1)
+        ic2 = ~leader_faulty & disobey
+        cols += [ic1.astype(jnp.int32), ic2.astype(jnp.int32)]
+    return jnp.stack(cols, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rounds", "m", "max_liars", "unroll", "scenario"),
+    donate_argnums=(0, 1, 2),
+)
+def coalesced_megastep(  # ba-lint: donates(state, sched, strategy)
+    state: SimState,
+    sched: KeySchedule,
+    strategy: jax.Array | None,
+    slot_counters: jax.Array,
+    events: dict | None,
+    *,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    scenario: bool = False,
+):
+    """``rounds`` rounds of a COALESCED serving batch in one donated
+    dispatch (ISSUE 10): every slot is an independent request.
+
+    ``sched`` is a slot schedule (:func:`make_slot_key_schedule` — one
+    base key per slot, all folding instance index 0), so slot ``b``'s
+    decisions/majorities/counters are bit-identical to its own B=1 run
+    at equal padded capacity.  ``scenario=True`` additionally applies
+    per-round event planes (``events``: the dict a
+    ``ScenarioBlock.chunk`` yields, each slot's campaign concatenated
+    along the batch axis) with the same kill → re-elect → agree
+    transition as :func:`scenario_megastep`, per slot.
+
+    DONATION CONTRACT: ``state``, ``sched`` and ``strategy`` are
+    CONSUMED — thread the returned ones.  ``slot_counters`` rides the
+    cumulative ``counter_rows`` output instead (the PR 4 pattern: no
+    output aliases its shape).
+
+    Returns ``(state, sched, strategy, last_majorities, decisions,
+    counter_rows[, leaders])``: ``last_majorities`` [B, n] is the FINAL
+    round's per-general block (carried, overwritten each round — the
+    interactive ``actual-order`` output without a second dispatch),
+    ``decisions`` [rounds, B], ``counter_rows`` [rounds, B, C]
+    cumulative per-slot blocks (last row continues the thread),
+    ``leaders`` [rounds, B] post-election (scenario only).
+    """
+
+    def body(carry, ev):
+        st, sc, strat, ctr, _maj = carry
+        if scenario:
+            kill, revive, fset, sset = ev
+            alive = (st.alive & ~kill) | revive
+            faulty = jnp.where(fset >= 0, fset > 0, st.faulty)
+            strat = jnp.where(sset >= 0, sset, strat)
+            leader_alive = jnp.take_along_axis(
+                alive, st.leader[:, None], axis=1
+            )[:, 0]
+            leader = jnp.where(
+                leader_alive, st.leader, elect_lowest_id(st.ids, alive)
+            )
+            st = SimState(st.order, leader, faulty, alive, st.ids)
+        keys = slot_round_keys(sc)
+        out = agreement_step(
+            keys, st, m=m, max_liars=max_liars,
+            strategies=strat if scenario else None,
+        )
+        ctr = ctr + slot_counter_delta(out, st, scenario)
+        nxt = KeySchedule(sc.key_data, sc.counter + 1)
+        ys = (out["decision"], ctr)
+        if scenario:
+            ys += (st.leader,)
+        return (st, nxt, strat, ctr, out["majorities"]), ys
+
+    B, n = state.faulty.shape
+    maj0 = jnp.full((B, n), UNDEFINED, COMMAND_DTYPE)
+    xs = None
+    if scenario:
+        xs = (
+            events["kill"],
+            events["revive"],
+            events["set_faulty"],
+            events["set_strategy"],
+        )
+    carry, ys = jax.lax.scan(
+        body, (state, sched, strategy, slot_counters, maj0), xs,
+        length=rounds, unroll=unroll,
+    )
+    return (carry[0], carry[1], carry[2], carry[4], *ys)
+
+
+def _pipeline_instruments(reg):
+    """The dispatch/retire discipline's instrument block — ONE creation
+    site shared by the campaign loop and the coalesced serving loop
+    (ISSUE 10), so a renamed histogram or changed bucket shape cannot
+    drift between the two traffic types: the health sampler's
+    depth-occupancy / retire-lag signals (the serving front-end's
+    admission inputs) must read both identically."""
+    return {
+        "lat": reg.histogram("pipeline_dispatch_latency_s"),
+        "lag": reg.histogram("pipeline_retire_lag_s"),
+        "occ": reg.histogram(
+            "pipeline_depth_occupancy", base=1.0, n_buckets=16
+        ),
+        "disp": reg.counter("pipeline_dispatches_total"),
+        "ret": reg.counter("pipeline_retires_total"),
+        "rounds": reg.counter("pipeline_rounds_total"),
+    }
+
+
+def _emit_flight_span(d, lo, hi, latency_s, lag_s, run_id=None):
+    """One ``flight_span`` record per retired round window — the ONE
+    spelling of the record shape (campaign loop and coalesced loop
+    both emit through here).  ``run_id`` stamps the id EXPLICITLY
+    (serving batches, which never activate the process-global scope);
+    None leaves stamping to the sink's scope-based setdefault."""
+    if not _metrics.default_sink().enabled:
+        return
+    rec = {
+        "event": "flight_span",
+        "v": _metrics.SCHEMA_VERSION,
+        "phase": "retire",
+        "dispatch": d,
+        "lo": lo,
+        "hi": hi,
+        "latency_s": round(latency_s, 6),
+        "lag_s": round(lag_s, 6),
+    }
+    if run_id is not None:
+        rec["run_id"] = run_id
+    _metrics.emit(rec)
+
+
+def coalesced_sweep(  # ba-lint: donates(state)
+    slot_keys,
+    state: SimState,
+    rounds: int,
+    *,
+    m: int = 1,
+    max_liars: int | None = None,
+    depth: int = 2,
+    rounds_per_dispatch: int = 8,
+    unroll: int = 1,
+    scenario=None,
+    initial_strategy: jax.Array | None = None,
+    exec_seam=None,
+    on_retire=None,
+):
+    """Run a coalesced serving batch through the depth-k pipelined loop
+    (ISSUE 10): B independent requests, one padded batch, bit-exact
+    slot results.
+
+    ``slot_keys`` is one typed key per slot; slot ``b``'s outputs are
+    bit-identical to ``pipeline_sweep(slot_keys[b], <its B=1 state>,
+    rounds)`` (or ``scenario_sweep`` with its own [R, 1, n] planes) at
+    equal padded capacity — the coalesced-batch parity test pins it.
+    ``scenario`` is a :class:`ba_tpu.scenario.compile.ScenarioBlock`
+    (or a plane dict) whose batch axis concatenates the slots'
+    campaigns.  ``exec_seam(call, phase, dispatch, lo, hi)`` is the
+    same injectable seam the main engine exposes — the serving
+    front-end composes chaos injection and transient retry there, and
+    a cohort whose retries exhaust fails as ONE unit (per-cohort fault
+    isolation; nothing outside this call is touched).
+    ``on_retire(dispatch, lo, hi, host_ys)`` delivers each retire
+    fetch's host block — the slot→request mapping hook: the service
+    streams per-request rows out as windows retire instead of waiting
+    for the drain.
+
+    The batch gets a run_id (``BA_TPU_RUN_ID`` pin, else derived from
+    the slot keys + rounds + event-plane content) carried EXPLICITLY on
+    its ``flight_span`` records and ``stats["run_id"]`` — it
+    deliberately does NOT activate the process-global run scope: the
+    serving dispatcher is its own thread, and taking the single-slot
+    scope there would make a concurrent main-thread campaign inherit a
+    transient cohort's id (or lose its own mid-run) in the documented
+    one-process roster+service mode.  It also emits NO
+    ``flight_summary``: serving batches are high-frequency, and the
+    assembler's rescan of the shared JSONL stream per batch would make
+    a long-lived service's sink quadratic — the per-request ``request``
+    records carry the run_id for correlation instead.
+
+    DONATION: ``state`` (and ``initial_strategy``'s staged copy) are
+    consumed by the first dispatch — serving callers stage fresh device
+    copies per batch (``fresh_copy`` numpy-staged states: the zero-copy
+    donation hazard applies here exactly as in ``runtime/backends``).
+
+    Returns a dict: ``decisions`` [rounds, B] host int8, ``majorities``
+    [B, n] host (final round's per-general block), ``counters`` [B, C]
+    host int32 per-slot final blocks + ``counter_names``, ``leaders``
+    [rounds, B] (scenario only), and ``stats`` (dispatches, depth,
+    slots, run_id, ...).
+    """
+    if rounds < 1:
+        raise ValueError(f"rounds={rounds} must be >= 1")
+    if depth < 1:
+        raise ValueError(f"depth={depth} must be >= 1")
+    if rounds_per_dispatch < 1:
+        raise ValueError(
+            f"rounds_per_dispatch={rounds_per_dispatch} must be >= 1"
+        )
+    B, n = state.faulty.shape
+    if len(slot_keys) != B:
+        raise ValueError(
+            f"{len(slot_keys)} slot key(s) for a batch of {B}"
+        )
+    sched = make_slot_key_schedule(slot_keys)
+    is_scenario = scenario is not None
+    strategy = None
+    ev_planes = None
+    if is_scenario:
+        ev_planes = (
+            scenario if isinstance(scenario, dict)
+            else scenario.chunk(0, rounds)
+            if hasattr(scenario, "chunk")
+            else None
+        )
+        if ev_planes is None or set(ev_planes) != {
+            "kill", "revive", "set_faulty", "set_strategy"
+        }:
+            raise ValueError(
+                "scenario must be a ScenarioBlock or a plane dict"
+            )
+        got = tuple(jnp.shape(ev_planes["kill"]))
+        if got != (rounds, B, n):
+            raise ValueError(
+                f"scenario planes are {got}, batch wants {(rounds, B, n)}"
+            )
+        if initial_strategy is None:
+            strategy = jnp.zeros((B, n), jnp.int8)
+        else:
+            strategy = jnp.asarray(initial_strategy, jnp.int8).copy()
+    elif initial_strategy is not None:
+        raise ValueError("initial_strategy needs a scenario block")
+    counters = jnp.zeros(
+        (B, len(SCENARIO_COUNTER_NAMES if is_scenario else COUNTER_NAMES)),
+        jnp.int32,
+    )
+    names = SCENARIO_COUNTER_NAMES if is_scenario else COUNTER_NAMES
+
+    chunks = [rounds_per_dispatch] * (rounds // rounds_per_dispatch)
+    if rounds % rounds_per_dispatch:
+        chunks.append(rounds % rounds_per_dispatch)
+
+    def _identity_material():
+        material = [
+            "coalesced", rounds, B,
+            jax.device_get(sched.key_data).tobytes(),
+        ]
+        if ev_planes is not None:
+            # Event-plane CONTENT joins the identity (the PR 9
+            # hardening, upheld here): two scenario cohorts with equal
+            # keys/rounds but different campaigns must not share a
+            # run_id, or their records would merge into one flight.
+            for name in ("kill", "revive", "set_faulty", "set_strategy"):
+                material.append(
+                    jax.device_get(ev_planes[name]).tobytes()
+                )
+        return material
+
+    # Env pin > derivation — NEVER an active scope's id (unlike
+    # resolve_run_id): a cohort inheriting a concurrent campaign's id
+    # is exactly the cross-thread merging this path must not do.
+    env = os.environ.get(obs.flight.RUN_ID_ENV)
+    if env:
+        if not obs.flight.valid_run_id(env):
+            raise ValueError(
+                f"{obs.flight.RUN_ID_ENV}={env!r} is not a valid run id"
+            )
+        rid = env
+    else:
+        rid = obs.flight.derive_run_id(*_identity_material())
+    out = _coalesced_loop(
+        state, sched, strategy, counters, ev_planes, chunks,
+        m=m, max_liars=max_liars, depth=depth, unroll=unroll,
+        is_scenario=is_scenario, exec_seam=exec_seam,
+        on_retire=on_retire, run_id=rid,
+    )
+    out["counter_names"] = list(names)
+    out["stats"]["run_id"] = rid
+    return out
+
+
+def _coalesced_loop(
+    state, sched, strategy, counters, ev_planes, chunks, *,
+    m, max_liars, depth, unroll, is_scenario, exec_seam, on_retire,
+    run_id=None,
+):
+    """The coalesced driver's dispatch loop: the main engine's depth-k
+    retire discipline, without scenario staging/checkpoint machinery
+    (serving batches are short) — instrumentation feeds the SAME
+    pipeline_* instruments (``_pipeline_instruments``), so the health
+    sampler's depth-occupancy and retire-lag signals (the service's
+    admission inputs) see serving traffic exactly like campaign
+    traffic."""
+    tracer = obs.default_tracer()
+    inst = _pipeline_instruments(obs.default_registry())
+    lat_h, lag_h, occ_h = inst["lat"], inst["lag"], inst["occ"]
+    disp_c, ret_c, rounds_c = inst["disp"], inst["ret"], inst["rounds"]
+
+    inflight: collections.deque = collections.deque()
+    retired = []
+    max_in_flight = 0
+
+    def retire():
+        d, ys, t_sub, lo, hi = inflight.popleft()
+        with obs.timed_span("retire", lag_h, dispatch=d) as lag_box:
+            with obs.xla.annotate("coalesced_retire", dispatch=d):
+                fetch = functools.partial(jax.device_get, ys)
+                if exec_seam is None:
+                    host_ys = fetch()
+                else:
+                    host_ys = exec_seam(fetch, "retire", d, lo, hi)
+                retired.append(host_ys)
+        latency_s = (time.perf_counter_ns() - t_sub) / 1e9
+        lat_h.record(latency_s)
+        ret_c.inc()
+        rounds_c.inc(hi - lo)
+        _emit_flight_span(
+            d, lo, hi, latency_s, lag_box.elapsed_s or 0.0, run_id=run_id
+        )
+        if on_retire is not None:
+            on_retire(d, lo, hi, host_ys)
+
+    round_base = 0
+    majorities = None
+    for d, nr in enumerate(chunks):
+        lo, hi = round_base, round_base + nr
+        axes = {
+            "batch": state.faulty.shape[0],
+            "capacity": state.faulty.shape[1],
+            "rounds": nr,
+            "m": m,
+            "max_liars": max_liars,
+            "unroll": min(unroll, nr),
+            "scenario": is_scenario,
+        }
+        ev = None
+        if is_scenario:
+            with tracer.span("stage_planes", lo=lo, hi=hi):
+                # Async upload of this dispatch's plane slice; it
+                # queues behind the in-flight dispatches.
+                ev = {k: jnp.asarray(v[lo:hi]) for k, v in ev_planes.items()}
+        with obs.compile_or_dispatch_span(
+            "coalesced_megastep", axes=axes, dispatch=d, rounds=nr
+        ):
+            with obs.xla.annotate("coalesced_dispatch", dispatch=d):
+                call = functools.partial(
+                    coalesced_megastep,
+                    state, sched, strategy, counters, ev,
+                    rounds=nr, m=m, max_liars=max_liars,
+                    unroll=min(unroll, nr), scenario=is_scenario,
+                )
+                if exec_seam is None:
+                    out = call()
+                else:
+                    out = exec_seam(call, "dispatch", d, lo, hi)
+        round_base = hi
+        t_sub = time.perf_counter_ns()
+        disp_c.inc()
+        state, sched, strategy, majorities = out[0], out[1], out[2], out[3]
+        ys = out[4:]
+        counters = ys[1][-1]  # cumulative rows' last row continues
+        inflight.append((d, ys, t_sub, lo, hi))
+        max_in_flight = max(max_in_flight, len(inflight))
+        occ_h.record(len(inflight))
+        while len(inflight) > depth:
+            retire()
+    while inflight:
+        retire()
+
+    import numpy as _host_np
+
+    # Everything below concatenates host blocks the retire fetches
+    # already brought back; the one extra fetch is the final carry's
+    # majorities/counters, which the drained queue has already waited
+    # on (no dispatch is still running).
+    result = {
+        "decisions": _host_np.concatenate([ys[0] for ys in retired]),
+        "counters": jax.device_get(counters),
+        "majorities": jax.device_get(majorities),
+        "stats": {
+            "rounds": round_base,
+            "slots": state.faulty.shape[0],
+            "dispatches": len(chunks),
+            "depth": depth,
+            "max_in_flight": max_in_flight,
+        },
+    }
+    if is_scenario:
+        result["leaders"] = _host_np.concatenate(
+            [ys[2] for ys in retired]
+        )
+    return result
+
+
 def pipeline_sweep(  # ba-lint: donates(state)
     key: jax.Array,
     state: SimState,
@@ -1235,15 +1722,14 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
     # the tracer is disabled; registry updates are in-memory scalar ops.
     tracer = obs.default_tracer()
     reg = obs.default_registry()
-    lat_h = reg.histogram("pipeline_dispatch_latency_s")
-    lag_h = reg.histogram("pipeline_retire_lag_s")
-    occ_h = reg.histogram("pipeline_depth_occupancy", base=1.0, n_buckets=16)
-    disp_c = reg.counter("pipeline_dispatches_total")
-    ret_c = reg.counter("pipeline_retires_total")
-    # Retired-round counter (ISSUE 9): the health sampler's rounds/s
-    # numerator — deltas between samples are exact, not inferred from
-    # retire counts times a dial that may degrade mid-campaign.
-    rounds_c = reg.counter("pipeline_rounds_total")
+    # Shared with the coalesced serving loop (ISSUE 10): one creation
+    # site for the dispatch/retire instrument block, incl. the
+    # retired-round counter (ISSUE 9) — the health sampler's rounds/s
+    # numerator, exact per-window deltas rather than retire counts
+    # times a dial that may degrade mid-campaign.
+    inst = _pipeline_instruments(reg)
+    lat_h, lag_h, occ_h = inst["lat"], inst["lag"], inst["occ"]
+    disp_c, ret_c, rounds_c = inst["disp"], inst["ret"], inst["rounds"]
     sampler = (
         obs.health.HealthSampler(reg, timeout_s=retire_timeout_s)
         if health_every is not None
@@ -1481,24 +1967,13 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
         lat_h.record(latency_s)
         ret_c.inc()
         rounds_c.inc(hi - lo)
-        if _metrics.default_sink().enabled:
-            # Flight recorder (ISSUE 9): one line per retired round
-            # window — the dispatch→retire leg of the run's timeline,
-            # keyed by ROUNDS so replayed windows after a recovery land
-            # on the same grid and the assembler dedups them.  A host
-            # emit on the fetch that just returned, never a new sync.
-            _metrics.emit(
-                {
-                    "event": "flight_span",
-                    "v": _metrics.SCHEMA_VERSION,
-                    "phase": "retire",
-                    "dispatch": d,
-                    "lo": lo,
-                    "hi": hi,
-                    "latency_s": round(latency_s, 6),
-                    "lag_s": round(lag_box.elapsed_s or 0.0, 6),
-                }
-            )
+        # Flight recorder (ISSUE 9): one line per retired round window
+        # — the dispatch→retire leg of the run's timeline, keyed by
+        # ROUNDS so replayed windows after a recovery land on the same
+        # grid and the assembler dedups them.  A host emit on the fetch
+        # that just returned, never a new sync; run_id stamps via the
+        # active scope.
+        _emit_flight_span(d, lo, hi, latency_s, lag_box.elapsed_s or 0.0)
         if on_rows is not None:
             # Before the checkpoint write on purpose: a supervisor
             # persisting campaign history next to each checkpoint needs

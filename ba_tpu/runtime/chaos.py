@@ -25,7 +25,14 @@ checkpoint hook:
   ``resilience`` bench;
 - ``corrupt`` — damage the just-written checkpoint file (``flip`` bytes
   mid-file or ``truncate`` it), exercising digest verification and
-  quarantine fallback.
+  quarantine fallback;
+- ``slow_client`` / ``abandon`` / ``deadline_storm`` (ISSUE 10) —
+  CLIENT-tier faults, fired at the ``client`` phase by a serving load
+  harness via :meth:`ChaosInjector.client_faults` and keyed by request
+  ordinal: they shape the synthetic callers of the agreement service
+  (``runtime/serve.py``) — late arrivals, never-read tickets, a fleet
+  flipping to near-zero deadlines — so the overload-survival drills
+  are as declarative and reproducible as the engine-fault ones.
 
 Faults are keyed by ROUND, not dispatch index: dispatch numbering
 restarts at 0 on every supervised resume, while the round cursor is the
@@ -52,10 +59,27 @@ import time
 from ba_tpu import obs
 from ba_tpu.utils import metrics as _metrics
 
-FAULT_KINDS = ("transient", "fatal", "oom", "stall", "kill", "corrupt")
-# corrupt fires from the checkpoint hook, everything else from the
-# execution seam's dispatch/retire phases.
-FAULT_PHASES = ("dispatch", "retire", "checkpoint")
+# Client-tier kinds (ISSUE 10): faults of the CALLERS, not the engine —
+# they fire at the "client" phase, consumed by a serving-load harness
+# (bench.py's `serving` config, tests/test_serve.py) shaping synthetic
+# clients against the agreement service (runtime/serve.py), keyed by
+# REQUEST ORDINAL instead of campaign round:
+#
+# - ``slow_client`` — the client sleeps ``seconds`` before submitting
+#   (a stalled upstream: requests arrive late and bunch up);
+# - ``abandon`` — the client submits and never reads its ticket (the
+#   service must complete/expire it without anyone waiting);
+# - ``deadline_storm`` — the client fleet switches to near-zero
+#   deadline budgets from this ordinal on (every coalesced batch then
+#   races admission-time expiry — the overload-survival drill).
+CLIENT_FAULT_KINDS = ("slow_client", "abandon", "deadline_storm")
+FAULT_KINDS = (
+    "transient", "fatal", "oom", "stall", "kill", "corrupt"
+) + CLIENT_FAULT_KINDS
+# corrupt fires from the checkpoint hook, client kinds from the serving
+# load harness, everything else from the execution seam's
+# dispatch/retire phases.
+FAULT_PHASES = ("dispatch", "retire", "checkpoint", "client")
 
 
 class FaultPlanError(ValueError):
@@ -95,9 +119,12 @@ _RAISES = {
 
 @dataclasses.dataclass(frozen=True)
 class Fault:
-    """One planned fault.  ``times`` is how often it fires (-1 =
-    unlimited — the poison-window tests); ``seconds`` is the stall
-    length; ``mode`` the corruption style."""
+    """One planned fault.  ``round`` is the campaign round it keys on —
+    or, for client-tier kinds (``phase == "client"``), the REQUEST
+    ORDINAL in the load harness's submission sequence.  ``times`` is
+    how often it fires (-1 = unlimited — the poison-window tests);
+    ``seconds`` is the stall/slow-client length; ``mode`` the
+    corruption style."""
 
     round: int
     kind: str
@@ -144,16 +171,19 @@ def from_dict(doc: dict) -> FaultPlan:
         rnd = f.get("round")
         if not isinstance(rnd, int) or isinstance(rnd, bool) or rnd < 0:
             raise FaultPlanError(f"faults[{i}]: bad round {rnd!r}")
-        phase = f.get("phase", "checkpoint" if kind == "corrupt" else
-                      "dispatch")
+        phase = f.get("phase", _default_phase(kind))
         if phase not in FAULT_PHASES:
             raise FaultPlanError(
                 f"faults[{i}]: phase {phase!r} not in {FAULT_PHASES}"
             )
-        if (kind == "corrupt") != (phase == "checkpoint"):
+        if phase != _default_phase(kind) and not (
+            kind not in ("corrupt",) + CLIENT_FAULT_KINDS
+            and phase in ("dispatch", "retire")
+        ):
             raise FaultPlanError(
                 f"faults[{i}]: kind {kind!r} cannot fire at phase {phase!r} "
-                f"(corrupt fires at 'checkpoint', everything else at "
+                f"(corrupt fires at 'checkpoint', client kinds "
+                f"{CLIENT_FAULT_KINDS} at 'client', everything else at "
                 f"'dispatch'/'retire')"
             )
         times = f.get("times", 1)
@@ -169,10 +199,11 @@ def from_dict(doc: dict) -> FaultPlan:
             seconds, bool
         ) or seconds < 0:
             raise FaultPlanError(f"faults[{i}]: bad seconds {seconds!r}")
-        if (kind == "stall") != (seconds > 0):
+        if (kind in ("stall", "slow_client")) != (seconds > 0):
             raise FaultPlanError(
-                f"faults[{i}]: seconds is the stall length — required > 0 "
-                f"for kind 'stall', meaningless otherwise"
+                f"faults[{i}]: seconds is the stall/delay length — "
+                f"required > 0 for kinds 'stall'/'slow_client', "
+                f"meaningless otherwise"
             )
         mode = f.get("mode", "flip")
         if mode not in ("flip", "truncate"):
@@ -187,6 +218,14 @@ def from_dict(doc: dict) -> FaultPlan:
     return FaultPlan(name=name, faults=tuple(faults))
 
 
+def _default_phase(kind) -> str:
+    if kind == "corrupt":
+        return "checkpoint"
+    if kind in CLIENT_FAULT_KINDS:
+        return "client"
+    return "dispatch"
+
+
 def to_dict(plan: FaultPlan) -> dict:
     """The exact inverse of :func:`from_dict` (round-trip pinned by the
     CLI and tests): defaulted fields are omitted, so a loaded-and-saved
@@ -194,12 +233,11 @@ def to_dict(plan: FaultPlan) -> dict:
     faults = []
     for f in plan.faults:
         d = {"round": f.round, "kind": f.kind}
-        default_phase = "checkpoint" if f.kind == "corrupt" else "dispatch"
-        if f.phase != default_phase:
+        if f.phase != _default_phase(f.kind):
             d["phase"] = f.phase
         if f.times != 1:
             d["times"] = f.times
-        if f.kind == "stall":
+        if f.kind in ("stall", "slow_client"):
             d["seconds"] = f.seconds
         if f.kind == "corrupt" and f.mode != "flip":
             d["mode"] = f.mode
@@ -299,6 +337,35 @@ class ChaosInjector:
                    if f.kind == "oom" else ")")
             )
         return call()
+
+    def client_faults(self, ordinal: int):
+        """Client-tier faults due at request ``ordinal`` (ISSUE 10).
+
+        Consumed by the serving LOAD HARNESS (bench.py ``serving``,
+        tests/test_serve.py) shaping synthetic clients — the service
+        itself never reads these: a real client's slowness or
+        abandonment happens outside the process.  Returns the fired
+        :class:`Fault` list (``times`` consumed, ``fault_injected``
+        records emitted with ``phase: "client"``); the caller applies
+        the semantics — sleep ``seconds`` for ``slow_client``, drop the
+        ticket for ``abandon``, switch to near-zero deadlines from here
+        on for ``deadline_storm``.
+
+        Matching is by EXACT ordinal, so in a harness that draws each
+        ordinal once, ``times > 1`` never fires more than once — plan
+        one fault entry per ordinal to inject repeatedly (``times``
+        matters only when a harness re-queries an ordinal, e.g. one
+        submission retried after a rejection).
+        """
+        fired = []
+        for i, f in enumerate(self.plan.faults):
+            if f.phase != "client" or f.round != ordinal:
+                continue
+            if self._remaining[i] == 0:
+                continue
+            self._consume(i, f, ordinal, ordinal + 1)
+            fired.append(f)
+        return fired
 
     def after_checkpoint(self, round_cursor, path):
         """The checkpoint hook: corrupt a just-written checkpoint whose

@@ -36,6 +36,15 @@ stay byte-identical):
   one-line error naming the mismatch, as does asking for more devices
   than exist); batched multi-chip campaigns use
   ``parallel.pipeline.scenario_sweep(mesh=)`` from library code.
+- ``serve start|stat|stop`` (ISSUE 10) — control a local
+  agreement-as-a-service front-end (``runtime/serve.py``): ``start``
+  spawns the continuous-batching dispatcher (``serve start queue=N
+  window=S batch=N`` override the ``BA_TPU_SERVE_*`` defaults),
+  ``stat`` prints the service's live stats block (tier, queue depth,
+  admitted/completed/rejected/expired/failed tallies), ``stop`` drains
+  and prints the final tallies.  Library/bench clients submit via
+  ``serve.AgreementService`` — the REPL command exists so one process
+  can host the roster AND the service.
 - ``stats`` — dump the observability registry (``ba_tpu.obs``) as
   Prometheus-style text: round wall-time histogram, pipeline dispatch /
   retire latencies and depth occupancy, election and failover counters.
@@ -260,6 +269,69 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
                 f"retries={sup['retries']}, "
                 f"recoveries={sup['recoveries']}, stalls={sup['stalls']}"
             )
+
+    elif command == "serve":
+        # Framework extension (additive, ISSUE 10): start/stat/stop a
+        # local agreement-as-a-service front-end.  The service module
+        # is host-tier (importing it never touches jax — lint-pinned),
+        # so the command works on the PyBackend REPL too; the first
+        # DISPATCH on a jax-less install fails that request's cohort
+        # with a classified error, never the REPL.
+        args = [t for t in cmd[1:] if t]
+        if not args or args[0] not in ("start", "stat", "stop"):
+            out("serve error: usage: serve start [queue=N] [window=S] "
+                "[batch=N] | serve stat | serve stop")
+            return True
+        from ba_tpu.runtime import serve as serve_mod
+
+        svc = getattr(cluster, "_serve_service", None)
+        if args[0] == "start":
+            if svc is not None and svc.running():
+                out("serve error: already running (serve stop first)")
+                return True
+            overrides = {}
+            names = {"queue": ("max_queue", int),
+                     "window": ("coalesce_window_s", float),
+                     "batch": ("max_batch", int)}
+            for tok in args[1:]:
+                key, sep, val = tok.partition("=")
+                if not sep or key not in names:
+                    out(f"serve error: unknown option {tok!r} (usage: "
+                        f"serve start [queue=N] [window=S] [batch=N])")
+                    return True
+                field, cast = names[key]
+                try:
+                    overrides[field] = cast(val)
+                except ValueError:
+                    out(f"serve error: {key}= wants a {cast.__name__}, "
+                        f"got {val!r}")
+                    return True
+            try:
+                cfg = serve_mod.ServeConfig.from_env(**overrides)
+            except ValueError as e:
+                out(f"serve error: {e}")
+                return True
+            svc = serve_mod.AgreementService(
+                cfg, registry=obs.default_registry()
+            )
+            svc.start()
+            cluster._serve_service = svc
+            out(f"serve: started (queue={cfg.max_queue}, "
+                f"window={cfg.coalesce_window_s}s, "
+                f"batch={cfg.max_batch})")
+        elif svc is None:
+            out("serve error: not running (serve start first)")
+        elif args[0] == "stat":
+            for k, v in svc.stats().items():
+                out(f"serve_{k} {v}")
+        else:  # stop
+            svc.stop()
+            cluster._serve_service = None
+            st = svc.stats()
+            out(f"serve: stopped — admitted={st['admitted']}, "
+                f"completed={st['completed']}, "
+                f"rejected={st['rejected']}, expired={st['expired']}, "
+                f"failed={st['failed']}")
 
     elif command == "g-state":
         if len(cmd) == 3:

@@ -1,0 +1,1018 @@
+"""Agreement-as-a-service: the overload-safe continuous-batched serving
+front-end (ISSUE 10 tentpole).
+
+The reference program is one caller talking to one REPL; our
+``Cluster``/``JaxBackend`` inherited that shape — one campaign owns the
+process.  This module is the long-lived layer that lets THOUSANDS of
+concurrent callers share one process safely:
+
+- **Continuous batching.**  Concurrent ``actual-order`` /
+  ``run-rounds`` / ``scenario`` requests coalesce into the engine's
+  already-padded batch dimension (the bucketed-capacity discipline that
+  keeps ``sweep10k_signed`` recompile-free: rosters pad to power-of-two
+  capacities, cohorts pad to power-of-two batch slots).  The engine
+  entry is ``parallel.pipeline.coalesced_sweep`` — per-SLOT key
+  schedules make every batched result BIT-EXACT with the same request
+  run alone at equal padded capacity (the parity test is the heart of
+  the PR; the coalescing is pure throughput, never a semantic change).
+- **Deadline budgets.**  Every request carries a deadline; an expired
+  request is cancelled BEFORE dispatch (a :class:`DeadlineExceeded`
+  ticket and a ``request`` record with ``status: "expired"``), never
+  after — once a cohort's carry is donated the batch completes and
+  late results are still delivered (cancelling mid-donation would
+  poison the cohort's shared buffers for everyone else in it).
+- **Admission control + backpressure.**  The queue is BOUNDED
+  (``max_queue``); an admission that cannot be honored raises
+  :class:`Overloaded` with a ``retry_after_s`` hint (queue depth x the
+  observed per-batch service time) instead of growing the queue — the
+  service's memory is O(max_queue), whatever the fleet does.  Pressure
+  is read off the signals ``obs/health.py`` already samples from the
+  engine's own instruments: depth-occupancy (device saturation) and
+  retire-lag p99 (service quality), plus queue occupancy.
+- **Load shedding tiers** (:func:`shed_tier`): under pressure the
+  service FIRST halves the coalescing window (tier 1 — dispatch
+  sooner, trade batching efficiency for latency), THEN sheds
+  batch-coalescable interactive work (tier 2 — ``actual-order`` /
+  ``run-rounds`` rejections; long ``scenario`` campaigns, which cannot
+  cheaply be re-issued, keep admitting), and only at tier 3 rejects
+  everything.  Tier transitions emit ``shed`` records and the
+  ``serve_shed_tier`` gauge.
+- **Per-request fault isolation.**  Each coalesced batch dispatches
+  through the same execution seam the supervisor uses: transient
+  faults retry in place (backoff + deterministic jitter, shared with
+  ``runtime/supervisor.py``); a dispatch that exhausts retries fails
+  ONLY the requests in that batch slot's cohort — classified via
+  ``supervisor.classify_fault`` (one fault taxonomy,
+  ``supervisor.fault_attribution``) — while the dispatcher thread
+  keeps serving the next cohort.
+
+HOST-TIER BY LINT CONTRACT (ba-lint BA301, mutation-checked like obs):
+this module's MODULE-LEVEL import closure never reaches
+``ba_tpu.core``/``ba_tpu.ops`` — admission control, fault-plan
+validation and client shaping run on hosts without jax; the engine is
+reached lazily from the dispatcher thread (``_execute``), exactly the
+``runtime/backends.py`` discipline.
+
+Environment: ``BA_TPU_SERVE_BATCH`` / ``BA_TPU_SERVE_QUEUE`` /
+``BA_TPU_SERVE_WINDOW_S`` / ``BA_TPU_SERVE_DEADLINE_S`` /
+``BA_TPU_SERVE_RETRIES`` override :meth:`ServeConfig.from_env`.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import os
+import threading
+import time
+
+import numpy as np
+
+from ba_tpu import obs
+from ba_tpu.scenario.compile import compile_scenario, empty_block
+from ba_tpu.utils import metrics as _metrics
+
+# NOTE: runtime.supervisor (classification/backoff) and the engine
+# (parallel.pipeline) are imported LAZILY from the dispatcher path —
+# the supervisor's own lazy engine seam makes its import-graph closure
+# reach the jitted trees, and this module's import-time closure is
+# host-tier by lint contract (BA301, module docstring).
+
+REQUEST_KINDS = ("actual-order", "run-rounds", "scenario")
+ORDERS = ("attack", "retreat")
+# Admission outcomes the `admission` record's `reason` field may carry.
+REJECT_REASONS = ("queue_full", "shed_interactive", "shed_all")
+
+
+class ServeError(RuntimeError):
+    """The service could not accept or complete a request."""
+
+
+class Overloaded(ServeError):
+    """Admission refused: bounded queue full or load-shed.  Carries the
+    backpressure contract — ``retry_after_s`` (the observed-service-rate
+    hint), ``tier`` and ``reason`` — so a client can retry sanely
+    instead of hammering."""
+
+    def __init__(self, message, *, retry_after_s, tier, reason):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.tier = tier
+        self.reason = reason
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline budget expired before its cohort
+    dispatched (expiry is always pre-dispatch — see module docstring)."""
+
+
+class RequestFailed(ServeError):
+    """The request's COHORT dispatch exhausted its retries; ``fault``
+    is the ``supervisor.classify_fault`` classification."""
+
+    def __init__(self, message, *, fault):
+        super().__init__(message)
+        self.fault = fault
+
+
+def _capacity(n: int) -> int:
+    """Power-of-two roster capacity, floor 4 — the exact bucketing
+    ``runtime.backends.JaxBackend`` pads interactive rosters with, so
+    serve cohorts reuse the same compiled specializations."""
+    cap = 4
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _batch_bucket(n: int) -> int:
+    """Power-of-two batch-slot bucket: cohorts of 3 and 4 share one
+    compiled batch=4 program instead of specializing per arrival
+    count."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving dials.  ``from_env`` overlays the ``BA_TPU_SERVE_*``
+    variables; everything validates eagerly."""
+
+    max_batch: int = 8             # coalesced requests per dispatch
+    max_queue: int = 64            # bounded admission queue
+    coalesce_window_s: float = 0.005  # wait-for-cohort window (tier 0)
+    default_deadline_s: float | None = 30.0  # None = no deadline
+    queue_soft_frac: float = 0.5   # tier 1 queue-occupancy threshold
+    queue_hard_frac: float = 0.875  # tier 2 queue-occupancy threshold
+    lag_soft_s: float = 1.0        # tier 1 retire-lag p99 threshold
+    lag_hard_s: float = 5.0        # tier 2 retire-lag p99 threshold
+    depth: int = 2                 # engine dispatch depth per cohort
+    rounds_per_dispatch: int = 8   # engine scan length per dispatch
+    m: int = 1                     # recursion depth served
+    max_retries: int | None = None  # None: BA_TPU_SERVE_RETRIES >
+    #                                 BA_TPU_MAX_RETRIES > 3
+    dispatch_timeout_s: float | None = None  # cohort watchdog; None =
+    #                                 supervisor.derive_timeout_s
+    #                                 (BA_TPU_SUPERVISE_TIMEOUT_S pin,
+    #                                 30 s floor)
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch={self.max_batch} must be >= 1")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue={self.max_queue} must be >= 1")
+        if self.coalesce_window_s < 0:
+            raise ValueError(
+                f"coalesce_window_s={self.coalesce_window_s} must be >= 0"
+            )
+        if self.default_deadline_s is not None and (
+            self.default_deadline_s < 0
+        ):
+            raise ValueError(
+                f"default_deadline_s={self.default_deadline_s} "
+                f"must be >= 0"
+            )
+        if not 0 < self.queue_soft_frac <= self.queue_hard_frac <= 1.0:
+            raise ValueError(
+                f"need 0 < queue_soft_frac <= queue_hard_frac <= 1, got "
+                f"{self.queue_soft_frac}/{self.queue_hard_frac}"
+            )
+        if not 0 < self.lag_soft_s <= self.lag_hard_s:
+            raise ValueError(
+                f"need 0 < lag_soft_s <= lag_hard_s, got "
+                f"{self.lag_soft_s}/{self.lag_hard_s}"
+            )
+        if self.dispatch_timeout_s is not None and (
+            self.dispatch_timeout_s <= 0
+        ):
+            raise ValueError(
+                f"dispatch_timeout_s={self.dispatch_timeout_s} "
+                f"must be > 0"
+            )
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        env = {}
+        if "BA_TPU_SERVE_BATCH" in os.environ:
+            env["max_batch"] = int(os.environ["BA_TPU_SERVE_BATCH"])
+        if "BA_TPU_SERVE_QUEUE" in os.environ:
+            env["max_queue"] = int(os.environ["BA_TPU_SERVE_QUEUE"])
+        if "BA_TPU_SERVE_WINDOW_S" in os.environ:
+            env["coalesce_window_s"] = float(
+                os.environ["BA_TPU_SERVE_WINDOW_S"]
+            )
+        if "BA_TPU_SERVE_DEADLINE_S" in os.environ:
+            raw = os.environ["BA_TPU_SERVE_DEADLINE_S"]
+            env["default_deadline_s"] = None if raw == "" else float(raw)
+        env.update(overrides)
+        return cls(**env)
+
+    def resolved_max_retries(self) -> int:
+        if self.max_retries is not None:
+            return self.max_retries
+        return int(
+            os.environ.get(
+                "BA_TPU_SERVE_RETRIES",
+                os.environ.get("BA_TPU_MAX_RETRIES", 3),
+            )
+        )
+
+
+def shed_tier(queue_frac, lag_p99_s, occupancy, config: ServeConfig) -> int:
+    """The load-shedding tier from the pressure signals (pure, pinned
+    by unit tests):
+
+    - tier 3 — queue full: reject everything;
+    - tier 2 — queue past ``queue_hard_frac`` or retire-lag p99 past
+      ``lag_hard_s`` (inf — the overflow bucket — counts): shed
+      interactive work, keep admitting campaigns;
+    - tier 1 — queue past ``queue_soft_frac``, lag past ``lag_soft_s``,
+      or the engine's depth-occupancy at/over the configured depth
+      (every pipeline slot full — the device is saturated): halve the
+      coalescing window, admit everything;
+    - tier 0 — healthy.
+
+    ``lag_p99_s``/``occupancy`` are ``obs/health.py`` sample fields and
+    may be None (no window yet) — absent signals never raise the tier.
+    """
+    if queue_frac >= 1.0:
+        return 3
+    lag_hard = lag_p99_s is not None and lag_p99_s >= config.lag_hard_s
+    if queue_frac >= config.queue_hard_frac or lag_hard:
+        return 2
+    lag_soft = lag_p99_s is not None and lag_p99_s >= config.lag_soft_s
+    saturated = occupancy is not None and occupancy >= config.depth
+    if queue_frac >= config.queue_soft_frac or lag_soft or saturated:
+        return 1
+    return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class AgreementRequest:
+    """One caller's request: its OWN simulated cluster (n generals with
+    ids 1..n, ``faulty`` roster indices, leader = lowest id), order,
+    seed and round count — the service is stateless per request.
+    ``spec`` (a ``ba_tpu.scenario.spec.Scenario``) is required for
+    ``kind="scenario"`` and supplies the round count there."""
+
+    kind: str = "actual-order"
+    order: str = "attack"
+    n: int = 4
+    faulty: tuple = ()
+    seed: int = 0
+    rounds: int = 1
+    spec: object = None
+
+
+def validate_request(req: AgreementRequest) -> AgreementRequest:
+    """Eager request validation (raises ValueError before admission —
+    a malformed request must never reach the dispatcher thread)."""
+    if req.kind not in REQUEST_KINDS:
+        raise ValueError(
+            f"kind {req.kind!r} not in {REQUEST_KINDS}"
+        )
+    if req.order not in ORDERS:
+        raise ValueError(f"order {req.order!r} not in {ORDERS}")
+    if req.n < 1:
+        raise ValueError(f"n={req.n} must be >= 1")
+    for i in req.faulty:
+        if not isinstance(i, int) or isinstance(i, bool) or not (
+            0 <= i < req.n
+        ):
+            raise ValueError(
+                f"faulty index {i!r} outside roster [0, {req.n})"
+            )
+    if req.kind == "scenario":
+        if req.spec is None:
+            raise ValueError("kind='scenario' needs a spec")
+    elif req.spec is not None:
+        raise ValueError(f"kind={req.kind!r} does not take a spec")
+    if req.kind == "actual-order" and req.rounds != 1:
+        raise ValueError(
+            f"actual-order is one round; rounds={req.rounds} "
+            f"(use kind='run-rounds')"
+        )
+    if req.rounds < 1:
+        raise ValueError(f"rounds={req.rounds} must be >= 1")
+    return req
+
+
+def request_rounds(req: AgreementRequest) -> int:
+    return req.spec.rounds if req.kind == "scenario" else req.rounds
+
+
+def cohort_key(req: AgreementRequest) -> tuple:
+    """Requests sharing this key coalesce into one batch: same compiled
+    specialization (round count, padded capacity, scenario-ness) —
+    orders, seeds, fault patterns and event planes are per-slot DATA."""
+    return (
+        req.kind == "scenario", request_rounds(req), _capacity(req.n)
+    )
+
+
+class Ticket:
+    """The caller's handle on a submitted request (a tiny future):
+    ``result(timeout=None)`` blocks for the terminal state and returns
+    the result dict or raises the failure (:class:`DeadlineExceeded`,
+    :class:`RequestFailed`, :class:`ServeError`)."""
+
+    def __init__(self, request, req_id, deadline_t):
+        self.request = request
+        self.id = req_id
+        self.deadline_t = deadline_t  # perf_counter deadline or None
+        self.enqueued_t = time.perf_counter()
+        self.dispatched_t = None
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+        self._block = None  # compiled per-slot scenario planes
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} not finished within {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _resolve(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def _fail(self, error) -> None:
+        self._error = error
+        self._event.set()
+
+
+class AgreementService:
+    """The long-lived, thread-safe serving front-end (module docstring
+    for the architecture).  Lifecycle::
+
+        svc = AgreementService()        # or (ServeConfig(...), plan)
+        svc.start()
+        ticket = svc.submit(AgreementRequest(kind="run-rounds",
+                                             n=4, rounds=32, seed=7))
+        out = ticket.result(timeout=60)
+        svc.stop()
+
+    ``fault_plan`` (a ``runtime.chaos.FaultPlan`` or live
+    ``ChaosInjector``) injects engine-phase faults into every cohort
+    dispatch for drills — the same plans the supervisor drills with.
+    ``open()`` alone (admission without the dispatcher thread) is the
+    deterministic-overload drill hook the tests and the schema check
+    use: submissions queue/reject exactly as in production, and a later
+    ``start()`` drains them.
+    """
+
+    def __init__(self, config: ServeConfig | None = None, fault_plan=None,
+                 registry=None):
+        self._cfg = config or ServeConfig.from_env()
+        self._reg = registry if registry is not None else (
+            obs.default_registry()
+        )
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._open = False
+        self._drain = True
+        self._thread = None
+        self._tier = 0
+        self._window_s = self._cfg.coalesce_window_s
+        self._batch_s = None  # EWMA of cohort dispatch wall time
+        self._ids = itertools.count(1)
+        # The pressure sampler reads the PROCESS-GLOBAL registry, not
+        # self._reg: the engine records its pipeline_* instruments
+        # (depth occupancy, retire lag) into obs.default_registry()
+        # whatever registry the service's own serve_* family lives in
+        # — sampling self._reg would leave the lag/occupancy shed
+        # signals permanently None for any service constructed with a
+        # custom registry (engine pressure is process-global by
+        # design; serve bookkeeping is what registry= isolates).
+        self._sampler = obs.health.HealthSampler()
+        from ba_tpu.runtime.supervisor import (
+            SupervisorConfig,
+            derive_timeout_s,
+        )
+
+        self._sup_cfg = SupervisorConfig()
+        self._max_retries = self._cfg.resolved_max_retries()
+        # Cohort watchdog (PR 7's timeout machinery reused): an
+        # in-process hung dispatch is not interruptible — the watchdog
+        # OBSERVES and applies BACKPRESSURE (tier 3, explicit
+        # rejections with the wedge named) so a wedged engine reads as
+        # an overloaded service, never a silently growing queue of
+        # forever-blocked tickets.  Recovery from a true wedge is
+        # process replacement, exactly as for supervised campaigns.
+        self._dispatch_timeout_s = (
+            self._cfg.dispatch_timeout_s
+            if self._cfg.dispatch_timeout_s is not None
+            else derive_timeout_s(self._sup_cfg)
+        )
+        self._wedged = False
+        self._stalls_c = self._reg.counter("serve_stalls_total")
+        injector = fault_plan
+        if injector is not None and not hasattr(injector, "fire"):
+            from ba_tpu.runtime.chaos import ChaosInjector
+
+            injector = ChaosInjector(injector)
+        self._injector = injector
+        # serve_* instrument family (the `serve_` PREFIX rule is
+        # registry-asserted, like `_per_shard` — DESIGN §8).
+        self._admitted_c = self._reg.counter("serve_admitted_total")
+        self._completed_c = self._reg.counter("serve_completed_total")
+        self._rejected_c = self._reg.counter("serve_rejected_total")
+        self._expired_c = self._reg.counter("serve_expired_total")
+        self._failed_c = self._reg.counter("serve_failed_total")
+        self._retries_c = self._reg.counter("serve_retries_total")
+        self._batches_c = self._reg.counter("serve_batches_total")
+        self._slots_h = self._reg.histogram(
+            "serve_batch_slots", base=1.0, n_buckets=12
+        )
+        self._wait_h = self._reg.histogram("serve_queue_wait_s")
+        self._latency_h = self._reg.histogram("serve_request_latency_s")
+        self._reg.gauge("serve_queue_depth").set(0)
+        self._reg.gauge("serve_shed_tier").set(0)
+        self._reg.gauge("serve_window_s").set(self._window_s)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> None:
+        """Open ADMISSION without the dispatcher (see class docstring)."""
+        with self._cond:
+            self._open = True
+        self._sampler.prime()
+
+    def start(self) -> None:
+        self.open()
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="ba-tpu-serve", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float | None = None) -> None:
+        """Close admission; with ``drain`` (default) the dispatcher
+        finishes the queued work first, otherwise queued tickets fail
+        with :class:`ServeError`."""
+        with self._cond:
+            self._open = False
+            self._drain = drain
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        # Whatever is left (no dispatcher ever ran, or drain=False):
+        # fail loudly rather than leaving callers blocked forever.
+        leftovers = []
+        with self._cond:
+            while self._queue:
+                leftovers.append(self._queue.popleft())
+            self._gauge_queue_locked()
+        for t in leftovers:
+            # Counted as failures so stats()/the REPL line and the
+            # emitted request records stay joinable on one tally.
+            self._failed_c.inc()
+            t._fail(ServeError("service stopped before dispatch"))
+            self._emit_request(t, status="failed", fault=None)
+
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(
+        self, request: AgreementRequest, deadline_s=...,
+    ) -> Ticket:
+        """Admit one request (or raise): eager validation, bounded-queue
+        + shed-tier admission, deadline stamping.  ``deadline_s``
+        defaults to the config's budget; ``None`` disables the deadline
+        for this request."""
+        validate_request(request)
+        if deadline_s is ...:
+            deadline_s = self._cfg.default_deadline_s
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(f"deadline_s={deadline_s} must be >= 0")
+
+        def check(depth, tier):
+            # ONE spelling of the admission ladder, used twice (see
+            # below): queue bound, then shed tiers — interactive work
+            # sheds BEFORE long campaigns (an interactive caller
+            # retries cheaply, a campaign re-issue re-pays its spec).
+            if depth >= self._cfg.max_queue:
+                return ("queue_full", depth, tier)
+            if tier >= 3:
+                return ("shed_all", depth, tier)
+            if tier >= 2 and request.kind != "scenario":
+                return ("shed_interactive", depth, tier)
+            return None
+
+        with self._cond:
+            if not self._open:
+                raise ServeError(
+                    "service is not accepting requests (call start())"
+                )
+            # Pre-compile admission probe: an overloaded service must
+            # reject in O(1), not after paying a full per-request
+            # scenario lowering it is about to throw away.
+            reject = check(len(self._queue), self._tier)
+        block = None
+        if reject is None and request.kind == "scenario":
+            # Compile in the CALLER's thread, before enqueue: spec
+            # errors (unknown ids, bad strategies) belong to the caller
+            # eagerly, and the dispatcher must never pay per-request
+            # lowering inside the coalescing window.
+            cap = _capacity(request.n)
+            block = compile_scenario(
+                request.spec, batch=1, capacity=cap,
+                ids=np.arange(1, cap + 1, dtype=np.int64),
+            )
+        with self._cond:
+            if not self._open:
+                raise ServeError(
+                    "service is not accepting requests (call start())"
+                )
+            depth = len(self._queue)
+            tier = self._tier
+            if reject is None:
+                # Re-check under the lock: the queue/tier may have
+                # moved while the spec compiled.
+                reject = check(depth, tier)
+            if reject is None:
+                ticket = Ticket(
+                    request,
+                    next(self._ids),
+                    None
+                    if deadline_s is None
+                    else time.perf_counter() + deadline_s,
+                )
+                ticket._block = block
+                self._queue.append(ticket)
+                self._gauge_queue_locked()
+                self._cond.notify_all()
+        if reject is not None:
+            reason, depth, tier = reject
+            retry_after = self._retry_after(depth)
+            self._rejected_c.inc()
+            _metrics.emit(
+                {
+                    "event": "admission",
+                    "v": _metrics.SCHEMA_VERSION,
+                    "decision": "reject",
+                    "reason": reason,
+                    "kind": request.kind,
+                    "tier": tier,
+                    "queue_depth": depth,
+                    "queue_limit": self._cfg.max_queue,
+                    "retry_after_s": retry_after,
+                }
+            )
+            obs.instant(
+                "serve_reject", reason=reason, tier=tier, queue=depth
+            )
+            raise Overloaded(
+                f"overloaded ({reason}): queue {depth}/"
+                f"{self._cfg.max_queue}, shed tier {tier} — retry in "
+                f"~{retry_after}s",
+                retry_after_s=retry_after,
+                tier=tier,
+                reason=reason,
+            )
+        self._admitted_c.inc()
+        return ticket
+
+    def _retry_after(self, queue_depth: int) -> float:
+        per_batch = (
+            self._batch_s
+            if self._batch_s is not None
+            else max(self._cfg.coalesce_window_s, 0.001)
+        )
+        batches_ahead = max(
+            1, -(-max(1, queue_depth) // self._cfg.max_batch)
+        )
+        return round(max(self._window_s, batches_ahead * per_batch), 4)
+
+    def _gauge_queue_locked(self) -> None:
+        self._reg.gauge("serve_queue_depth").set(len(self._queue))
+
+    # -- the dispatcher thread ----------------------------------------------
+
+    def _run(self) -> None:
+        # Tier refresh rides every loop iteration — INCLUDING idle ones
+        # (the cohort wait below is bounded): a service that shed its
+        # way to tier 3 under a storm must decay back down once the
+        # queue drains, or rejection would outlive the overload.
+        while True:
+            self._refresh_tier()
+            cohort = self._next_cohort()
+            if cohort is None:
+                break
+            if cohort:
+                self._dispatch_cohort(cohort)
+
+    def _next_cohort(self):
+        """Pop one coalescable cohort (None = shut down, [] = nothing
+        dispatchable this round — idle tick or expired-only).  Expiry
+        is checked at pop AND immediately before returning — a request
+        is cancelled before dispatch or not at all."""
+        expired = []
+        cohort = []
+        with self._cond:
+            if self._open and not self._queue:
+                # Bounded idle wait, not a loop: the caller's loop must
+                # keep ticking the tier refresh while idle.
+                self._cond.wait(0.05)
+            if not self._open and (not self._drain or not self._queue):
+                return None
+            if not self._queue:
+                return []
+            now = time.perf_counter()
+            head = None
+            while self._queue:
+                t = self._queue.popleft()
+                if t.deadline_t is not None and now >= t.deadline_t:
+                    expired.append(t)
+                    continue
+                head = t
+                break
+            if head is not None:
+                ckey = cohort_key(head.request)
+                cohort = [head]
+                window_end = time.perf_counter() + self._window_s
+                while len(cohort) < self._cfg.max_batch:
+                    keep: collections.deque = collections.deque()
+                    now = time.perf_counter()
+                    while self._queue:
+                        t = self._queue.popleft()
+                        if (
+                            t.deadline_t is not None
+                            and now >= t.deadline_t
+                        ):
+                            expired.append(t)
+                        elif (
+                            len(cohort) < self._cfg.max_batch
+                            and cohort_key(t.request) == ckey
+                        ):
+                            cohort.append(t)
+                        else:
+                            keep.append(t)
+                    self._queue = keep
+                    if len(cohort) >= self._cfg.max_batch:
+                        break
+                    remaining = window_end - time.perf_counter()
+                    if remaining <= 0 or not self._open:
+                        break
+                    self._cond.wait(remaining)
+            self._gauge_queue_locked()
+        for t in expired:
+            self._expire(t)
+        live = []
+        now = time.perf_counter()
+        for t in cohort:
+            if t.deadline_t is not None and now >= t.deadline_t:
+                self._expire(t)
+            else:
+                live.append(t)
+        return live
+
+    def _expire(self, ticket: Ticket) -> None:
+        self._expired_c.inc()
+        ticket._fail(
+            DeadlineExceeded(
+                f"request {ticket.id} expired after "
+                f"{time.perf_counter() - ticket.enqueued_t:.3f}s in "
+                f"queue (cancelled before dispatch)"
+            )
+        )
+        self._emit_request(ticket, status="expired", fault=None)
+
+    def _refresh_tier(self) -> None:
+        """One health sample (lock-free registry reads — the same
+        depth-occupancy / retire-lag signals ``stats --live`` renders)
+        -> shed tier -> coalescing window; a transition emits one
+        ``shed`` record.  A WEDGED dispatcher (watchdog fired, dispatch
+        still out) holds tier 3 — decay resumes once the dispatch
+        returns."""
+        if self._wedged:
+            return
+        snap = self._sampler.sample()
+        with self._cond:
+            depth = len(self._queue)
+        frac = depth / self._cfg.max_queue
+        tier = shed_tier(
+            frac,
+            snap.get("retire_lag_p99_s"),
+            snap.get("depth_occupancy"),
+            self._cfg,
+        )
+        if tier != self._tier:
+            self._transition_tier(tier, depth, snap=snap, frac=frac)
+
+    def _transition_tier(self, tier, depth, snap=None, frac=None,
+                         reason=None) -> None:
+        """Apply + record one shed-tier transition (the dispatcher's
+        refresh path AND the watchdog's wedge path — one spelling of
+        the window/gauge/record bookkeeping)."""
+        prev, self._tier = self._tier, tier
+        # Halve the window per tier under pressure BEFORE any
+        # rejection tier bites (tiers 2/3 keep the halved window for
+        # whatever still admits).
+        self._window_s = self._cfg.coalesce_window_s * (
+            0.5 ** min(tier, 2)
+        )
+        self._reg.gauge("serve_shed_tier").set(tier)
+        self._reg.gauge("serve_window_s").set(self._window_s)
+        lag = (snap or {}).get("retire_lag_p99_s")
+        _metrics.emit(
+            {
+                "event": "shed",
+                "v": _metrics.SCHEMA_VERSION,
+                "tier": tier,
+                "prev_tier": prev,
+                "window_s": round(self._window_s, 6),
+                "queue_depth": depth,
+                "queue_frac": round(
+                    frac if frac is not None
+                    else depth / self._cfg.max_queue, 4
+                ),
+                "retire_lag_p99_s": (
+                    None if lag == float("inf") else lag
+                ),
+                "depth_occupancy": (snap or {}).get("depth_occupancy"),
+                **({"reason": reason} if reason else {}),
+            }
+        )
+        obs.instant("serve_shed", tier=tier, prev=prev, queue=depth)
+
+    def _declare_wedged(self, slots, lo_rounds) -> None:
+        # Timer-thread path (the PR 7 watchdog pattern): the cohort's
+        # dispatch has run past dispatch_timeout_s.  An in-process hung
+        # dispatch cannot be interrupted — observe (counter + instant)
+        # and apply BACKPRESSURE: tier 3 holds until the dispatch
+        # returns, so new submissions reject explicitly instead of
+        # queueing behind a wedge forever.
+        self._wedged = True
+        self._stalls_c.inc()
+        obs.instant(
+            "serve_dispatch_stalled", slots=slots, rounds=lo_rounds,
+            timeout_s=self._dispatch_timeout_s,
+        )
+        with self._cond:
+            depth = len(self._queue)
+        if self._tier != 3:
+            self._transition_tier(3, depth, reason="dispatcher_stalled")
+
+    # -- cohort dispatch ----------------------------------------------------
+
+    def _seam(self, call, phase, d, lo, hi):
+        """The cohort's execution seam: chaos injection (drills) +
+        in-place transient retry with the supervisor's backoff/jitter.
+        Anything that escapes fails the COHORT (caught one frame up),
+        never the service."""
+        from ba_tpu.runtime.supervisor import (
+            TRANSIENT,
+            backoff_s,
+            classify_fault,
+        )
+
+        wrapped = (
+            call
+            if self._injector is None
+            else lambda: self._injector.fire(call, phase, lo, hi)
+        )
+        tries = 0
+        while True:
+            try:
+                return wrapped()
+            except Exception as e:
+                if (
+                    classify_fault(e) != TRANSIENT
+                    or tries >= self._max_retries
+                ):
+                    raise
+                tries += 1
+                self._retries_c.inc()
+                time.sleep(
+                    backoff_s(self._sup_cfg, tries, f"serve:{phase}:{lo}")
+                )
+
+    def _dispatch_cohort(self, live) -> None:
+        from ba_tpu.runtime.supervisor import fault_attribution
+
+        t0 = time.perf_counter()
+        for t in live:
+            t.dispatched_t = t0
+            self._wait_h.record(t0 - t.enqueued_t)
+        rounds = request_rounds(live[0].request)
+        watchdog = threading.Timer(
+            self._dispatch_timeout_s, self._declare_wedged,
+            args=(len(live), rounds),
+        )
+        watchdog.daemon = True
+        watchdog.start()
+        try:
+            try:
+                results, run_id = self._execute(live)
+            except Exception as e:  # per-cohort fault isolation
+                att = fault_attribution(e)
+                self._failed_c.inc(len(live))
+                obs.instant(
+                    "serve_cohort_failed", fault=att["fault"],
+                    slots=len(live),
+                )
+                for t in live:
+                    t._fail(
+                        RequestFailed(
+                            f"cohort of {len(live)} failed "
+                            f"({att['fault']}): {att['error']}",
+                            fault=att["fault"],
+                        )
+                    )
+                    self._emit_request(
+                        t, status="failed", fault=att["fault"]
+                    )
+                return
+        finally:
+            # Whether the dispatch returned, failed, or ran past the
+            # watchdog (which can only observe — see _declare_wedged):
+            # the wedge is over once control is back here, and the
+            # next _refresh_tier decays the forced tier 3 normally.
+            watchdog.cancel()
+            self._wedged = False
+        wall = time.perf_counter() - t0
+        self._batch_s = (
+            wall
+            if self._batch_s is None
+            else 0.5 * self._batch_s + 0.5 * wall
+        )
+        self._batches_c.inc()
+        self._slots_h.record(len(live))
+        self._completed_c.inc(len(live))
+        for t, result in zip(live, results):
+            t._resolve(result)
+            self._latency_h.record(time.perf_counter() - t.enqueued_t)
+            self._emit_request(
+                t, status="ok", fault=None,
+                batch=len(live), slot=result["slot"], run_id=run_id,
+            )
+
+    def _execute(self, live):
+        """Stage + dispatch one coalesced batch (the dispatcher
+        thread's only engine contact — jax imports live HERE, keeping
+        the module import host-tier)."""
+        import jax.random as jr
+
+        from ba_tpu.core.state import SimState
+        from ba_tpu.core.types import (
+            ATTACK,
+            COMMAND_DTYPE,
+            RETREAT,
+            command_from_name,
+        )
+        from ba_tpu.parallel.pipeline import coalesced_sweep, fresh_copy
+
+        import jax.numpy as jnp
+
+        is_scenario, rounds, cap = cohort_key(live[0].request)
+        n_live = len(live)
+        B = min(_batch_bucket(n_live), _batch_bucket(self._cfg.max_batch))
+        # Filler slots replicate slot 0 under a fixed key: independent
+        # lanes, results discarded — padding is pure shape discipline.
+        reqs = [t.request for t in live] + [live[0].request] * (B - n_live)
+        order = np.zeros(B, np.int8)
+        leader = np.zeros(B, np.int32)
+        faulty = np.zeros((B, cap), np.bool_)
+        alive = np.zeros((B, cap), np.bool_)
+        ids = np.tile(np.arange(1, cap + 1, dtype=np.int32), (B, 1))
+        for b, req in enumerate(reqs):
+            order[b] = command_from_name(req.order)
+            alive[b, : req.n] = True
+            for i in req.faulty:
+                faulty[b, i] = True
+        # fresh_copy is LOAD-BEARING (the backends.py lesson): the
+        # numpy staging above may be zero-copied by jnp.asarray on CPU,
+        # and the engine donates this state.
+        state = fresh_copy(
+            SimState(
+                order=jnp.asarray(order.astype(COMMAND_DTYPE)),
+                leader=jnp.asarray(leader),
+                faulty=jnp.asarray(faulty),
+                alive=jnp.asarray(alive),
+                ids=jnp.asarray(ids),
+            )
+        )
+        keys = [jr.key(req.seed) for req in reqs[:n_live]]
+        keys += [jr.key(0)] * (B - n_live)
+        planes = None
+        if is_scenario:
+            blocks = [t._block for t in live]
+            fill = empty_block(rounds, B - n_live, cap) if B > n_live else None
+            planes = {
+                name: np.concatenate(
+                    [getattr(b, name) for b in blocks]
+                    + ([getattr(fill, name)] if fill is not None else []),
+                    axis=1,
+                )
+                for name in ("kill", "revive", "set_faulty", "set_strategy")
+            }
+        out = coalesced_sweep(
+            keys,
+            state,
+            rounds,
+            m=self._cfg.m,
+            depth=self._cfg.depth,
+            rounds_per_dispatch=self._cfg.rounds_per_dispatch,
+            scenario=planes,
+            exec_seam=self._seam,
+        )
+        results = []
+        for i, t in enumerate(live):
+            dec = out["decisions"][:, i]
+            n_attack = int((dec == ATTACK).sum())
+            n_retreat = int((dec == RETREAT).sum())
+            result = {
+                "kind": t.request.kind,
+                "rounds": rounds,
+                "decisions": [int(v) for v in dec],
+                "counts": {
+                    "attack": n_attack,
+                    "retreat": n_retreat,
+                    "undefined": rounds - n_attack - n_retreat,
+                },
+                "majorities": [
+                    int(v) for v in out["majorities"][i, : t.request.n]
+                ],
+                "counters": {
+                    name: int(v)
+                    for name, v in zip(
+                        out["counter_names"], out["counters"][i]
+                    )
+                },
+                "batch": n_live,
+                "slot": i,
+                "run_id": out["stats"]["run_id"],
+            }
+            if is_scenario:
+                result["leaders"] = [int(v) for v in out["leaders"][:, i]]
+            results.append(result)
+        return results, out["stats"]["run_id"]
+
+    # -- records / stats ----------------------------------------------------
+
+    def _emit_request(self, ticket, *, status, fault, batch=None,
+                      slot=None, run_id=None) -> None:
+        now = time.perf_counter()
+        rec = {
+            "event": "request",
+            "v": _metrics.SCHEMA_VERSION,
+            "id": ticket.id,
+            "kind": ticket.request.kind,
+            "status": status,
+            "rounds": request_rounds(ticket.request),
+            "queue_s": round(
+                (ticket.dispatched_t or now) - ticket.enqueued_t, 6
+            ),
+            "wall_s": round(now - ticket.enqueued_t, 6),
+        }
+        if fault is not None:
+            rec["fault"] = fault
+        if batch is not None:
+            rec["batch"] = batch
+        if slot is not None:
+            rec["slot"] = slot
+        if run_id is not None:
+            rec["run_id"] = run_id
+        _metrics.emit(rec)
+
+    def stats(self) -> dict:
+        with self._cond:
+            depth = len(self._queue)
+        return {
+            "open": self._open,
+            "running": self.running(),
+            "tier": self._tier,
+            "window_s": round(self._window_s, 6),
+            "queue_depth": depth,
+            "queue_limit": self._cfg.max_queue,
+            "max_batch": self._cfg.max_batch,
+            "admitted": self._admitted_c.value,
+            "completed": self._completed_c.value,
+            "rejected": self._rejected_c.value,
+            "expired": self._expired_c.value,
+            "failed": self._failed_c.value,
+            "retries": self._retries_c.value,
+            "stalls": self._stalls_c.value,
+            "batches": self._batches_c.value,
+            "batch_s_ewma": (
+                round(self._batch_s, 6) if self._batch_s else None
+            ),
+            "injected": (
+                len(self._injector.fired)
+                if self._injector is not None
+                else 0
+            ),
+        }

@@ -155,6 +155,21 @@ def classify_fault(exc: BaseException) -> str:
     return FATAL
 
 
+def fault_attribution(exc: BaseException) -> dict:
+    """``{"fault": <classification>, "error": "<Type>: <msg>"}`` — the
+    ONE spelling of fault attribution (ISSUE 10): the supervisor's
+    ``recovery``/quarantine records and the serving front-end's
+    per-cohort request failures (``runtime/serve.py``) attribute a
+    raised execution error identically, so an operator joining
+    ``recovery`` rows against ``request`` rows reads one taxonomy.
+    The error text truncates at 200 chars like every record that
+    carries one."""
+    return {
+        "fault": classify_fault(exc),
+        "error": f"{type(exc).__name__}: {exc}"[:200],
+    }
+
+
 @dataclasses.dataclass(frozen=True)
 class SupervisorConfig:
     """Supervision dials.  ``None`` fields resolve from the environment
@@ -852,7 +867,8 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                 # the campaign from scratch and then misreport a
                 # one-line config error as a PoisonousWindow.
                 raise
-            kind = classify_fault(e)
+            attribution = fault_attribution(e)
+            kind = attribution["fault"]
             faults_c.inc()
             fail_round = completed_round()
             window_failures[fail_round] = (
@@ -940,7 +956,7 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                     "attempt": attempt,
                     "from_round": from_round,
                     "lost_rounds": lost,
-                    "error": f"{type(e).__name__}: {e}"[:200],
+                    "error": attribution["error"],
                 }
             )
             if kind in (TRANSIENT, OOM):
@@ -1009,7 +1025,7 @@ def _quarantine_window(
         "rounds_per_dispatch": rpd,
         "failures": failures,
         "fault": kind,
-        "error": f"{type(exc).__name__}: {exc}"[:200],
+        "error": fault_attribution(exc)["error"],
         "resume": newest[0] if newest is not None else None,
         "hint": (
             "re-run pipeline_sweep(resume=<resume>, "

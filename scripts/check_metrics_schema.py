@@ -12,10 +12,14 @@ introspection) and ``recompile`` (obs/instrument.py explainer) emitters
 — plus (ISSUES 7+9) a tiny SUPERVISED MESH campaign with a chaos plan
 and the flight recorder + health sampler live, driving the real
 ``fault_injected``, ``recovery``, ``flight_span``, ``health_snapshot``
-and assembled ``flight_summary`` emitters — into a temp sink, then
-validates every line, including the typed shape of the device-tier,
-resilience and flight records, and the presence/shape of ``run_id`` on
-every record family that carries it.  Run by ``scripts/ci.sh`` before
+and assembled ``flight_summary`` emitters — plus (ISSUE 10) a short
+deterministic SERVE session (queue-full rejection, shed-tier
+transition, deadline expiry, two served cohorts) driving the real
+``request``/``admission``/``shed`` emitters and the ``serve_*`` gauge
+family (prefix-rule-checked) — into a temp sink, then validates every
+line, including the typed shape of the device-tier, resilience, flight
+and serving records, and the presence/shape of ``run_id`` on every
+record family that carries it.  Run by ``scripts/ci.sh`` before
 the tier-1 suite; standalone: ``JAX_PLATFORMS=cpu python
 scripts/check_metrics_schema.py``.
 """
@@ -120,6 +124,56 @@ def main() -> int:
             health_every=1,
             config=SupervisorConfig(timeout_s=60.0, backoff_base_s=0.0),
         )
+        # Serving-front-end records (ISSUE 10): a short deterministic
+        # serve session drives the real request/admission/shed
+        # emitters.  open() (admission without the dispatcher — the
+        # documented drill hook) lets the queue fill deterministically:
+        # one already-expired ticket + two live cohorts saturate
+        # max_queue=3, the fourth submission rejects (admission
+        # record), and start() then sheds (queue 3/3 -> tier 3, shed
+        # record), expires the dead ticket (request/expired) and serves
+        # the rest (request/ok carrying each cohort's run_id).
+        from ba_tpu.runtime.serve import (
+            AgreementRequest, AgreementService, Overloaded, ServeConfig,
+        )
+
+        svc = AgreementService(
+            ServeConfig(
+                max_batch=2, max_queue=3, coalesce_window_s=0.01,
+                rounds_per_dispatch=2,
+            )
+        )
+        svc.open()
+        t_exp = svc.submit(
+            AgreementRequest(kind="run-rounds", n=4, seed=1, rounds=3),
+            deadline_s=0.0,
+        )
+        t_scn = svc.submit(
+            AgreementRequest(kind="scenario", n=4, seed=2, spec=spec)
+        )
+        t_run = svc.submit(
+            AgreementRequest(
+                kind="run-rounds", n=4, faulty=(2,), seed=3, rounds=2
+            )
+        )
+        overloaded = False
+        try:
+            svc.submit(AgreementRequest(kind="actual-order", n=4, seed=4))
+        except Overloaded as e:
+            overloaded = e.retry_after_s > 0
+        assert overloaded, "queue-full submission did not reject"
+        svc.start()
+        t_scn.result(timeout=300)
+        t_run.result(timeout=300)
+        svc.stop()
+        try:
+            t_exp.result(timeout=1)
+            print("schema check: expired ticket resolved", file=sys.stderr)
+            return 1
+        except Exception as e:
+            if type(e).__name__ != "DeadlineExceeded":
+                raise
+
         obs.default_registry().emit_snapshot(sink=sink, source="ci-check")
         sink.close()
 
@@ -320,11 +374,105 @@ def main() -> int:
                         file=sys.stderr,
                     )
                     bad += 1
+            elif rec.get("event") == "request":
+                # Serving front-end (ISSUE 10): terminal per-request
+                # records; dispatched ("ok") ones carry their cohort's
+                # run_id plus the slot→request mapping.
+                ok_shape = (
+                    isinstance(rec.get("id"), int)
+                    and rec.get("kind")
+                    in ("actual-order", "run-rounds", "scenario")
+                    and rec.get("status") in ("ok", "failed", "expired")
+                    and isinstance(rec.get("rounds"), int)
+                    and isinstance(rec.get("queue_s"), (int, float))
+                    and isinstance(rec.get("wall_s"), (int, float))
+                )
+                if ok_shape and rec["status"] == "ok":
+                    ok_shape = (
+                        _flight.valid_run_id(rec.get("run_id"))
+                        and isinstance(rec.get("batch"), int)
+                        and isinstance(rec.get("slot"), int)
+                    )
+                if ok_shape and rec["status"] == "failed":
+                    ok_shape = rec.get("fault") in (
+                        None, "transient", "fatal", "oom",
+                    )
+                if not ok_shape:
+                    print(
+                        f"schema check: line {i} malformed request: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "admission":
+                if not (
+                    rec.get("decision") == "reject"
+                    and rec.get("reason")
+                    in ("queue_full", "shed_interactive", "shed_all")
+                    and isinstance(rec.get("tier"), int)
+                    and isinstance(rec.get("queue_depth"), int)
+                    and isinstance(rec.get("queue_limit"), int)
+                    and isinstance(rec.get("retry_after_s"), (int, float))
+                    and rec.get("retry_after_s") > 0
+                ):
+                    print(
+                        f"schema check: line {i} malformed admission: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "shed":
+                if not (
+                    isinstance(rec.get("tier"), int)
+                    and isinstance(rec.get("prev_tier"), int)
+                    and rec.get("tier") != rec.get("prev_tier")
+                    and isinstance(rec.get("window_s"), (int, float))
+                    and isinstance(rec.get("queue_depth"), int)
+                    and _num_or_null(rec.get("retire_lag_p99_s"))
+                    and _num_or_null(rec.get("depth_occupancy"))
+                ):
+                    print(
+                        f"schema check: line {i} malformed shed: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
             elif rec.get("event") == "metrics_snapshot":
                 # Shard-labeled gauges (ISSUE 8): the engine stamps the
                 # device count and per-device carry/plane byte shares
                 # after every sweep — the weak-scaling denominators.
                 metrics_blk = rec.get("metrics", {})
+                # Service-metric prefix rule (ISSUE 10, DESIGN §8 —
+                # the `_per_shard` suffix-rule pattern, mirrored): any
+                # metric whose "_"-tokenized name mentions serve must
+                # spell the `serve_` PREFIX, and the serve session
+                # above must have left its gauge family behind.
+                for name in metrics_blk:
+                    if "serve" in name.split("_") and not name.startswith(
+                        "serve_"
+                    ):
+                        print(
+                            f"schema check: line {i} metric {name!r} "
+                            f"violates the serve_ prefix rule",
+                            file=sys.stderr,
+                        )
+                        bad += 1
+                for g in (
+                    "serve_queue_depth",
+                    "serve_shed_tier",
+                    "serve_window_s",
+                ):
+                    snap = metrics_blk.get(g)
+                    if not (
+                        isinstance(snap, dict)
+                        and isinstance(snap.get("value"), (int, float))
+                    ):
+                        print(
+                            f"schema check: line {i} metrics_snapshot "
+                            f"missing/malformed gauge {g}: {line[:160]}",
+                            file=sys.stderr,
+                        )
+                        bad += 1
                 for g in (
                     "pipeline_shards",
                     "pipeline_carry_bytes_per_shard",
@@ -352,6 +500,9 @@ def main() -> int:
             "flight_span",
             "health_snapshot",
             "flight_summary",
+            "request",
+            "admission",
+            "shed",
         }
         if not want <= events:
             print(

@@ -150,6 +150,16 @@ mutate_and_expect BA301 obs/health.py \
 # likelier real-world breach.
 mutate_and_expect BA301 obs/health.py \
     'from ba_tpu.parallel import sweep as _mut_indirect' || exit 1
+# ISSUE 10: the serving front-end joined the host-tier scope at MODULE
+# level — `import ba_tpu.runtime.serve` must never pull the jitted
+# trees (admission control and plan validation run jax-free; the
+# dispatcher reaches the engine through function-local imports).
+# Prove both directions are live: a direct core import and an indirect
+# one through the engine.
+mutate_and_expect BA301 runtime/serve.py \
+    'from ba_tpu.core import om as _mut_core' || exit 1
+mutate_and_expect BA301 runtime/serve.py \
+    'from ba_tpu.parallel import pipeline as _mut_engine' || exit 1
 
 echo "== scenario spec round-trip =="
 # ISSUE 5: the committed campaign specs must load, validate, round-trip
@@ -179,6 +189,21 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q \
         -k "classify or backoff or derive_timeout or fault_plan or chaos_cli" \
         -p no:cacheprovider; then
     echo "chaos smoke tests failed" >&2
+    exit 1
+fi
+
+echo "== serve smoke: jax-free admission layer + fast serve tests =="
+# ISSUE 10: the serving front-end's admission machinery — request
+# validation, shed-tier ladder, bounded-queue rejection, deadline
+# bookkeeping, client-tier fault plans — runs WITHOUT jax (the module
+# is host-tier by the BA301 contract proven above; the jax-free import
+# is pinned by tests/test_serve.py::test_serve_import_is_jax_free).
+# The engine-touching serve tests (coalesced parity, cohort isolation)
+# run in tier-1 below.
+if ! JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
+        -k "tier or admission or validate or plan or ticket or jax_free" \
+        -p no:cacheprovider; then
+    echo "serve smoke tests failed" >&2
     exit 1
 fi
 

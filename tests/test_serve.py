@@ -1,0 +1,657 @@
+"""Serving front-end tests (ISSUE 10).
+
+Two layers, mirroring the module's own split:
+
+- the jax-free admission layer (request validation, shed-tier ladder,
+  bounded-queue rejection, deadline bookkeeping, client-tier fault
+  plans, the host-tier import contract) — these are the
+  ``scripts/ci.sh`` serve-smoke subset (``-k "tier or admission or
+  validate or plan or ticket or jax_free"``) and never touch jax;
+- the engine-backed serving layer: the COALESCED-BATCH PARITY pin (the
+  acceptance criterion — any request served in a coalesced batch is
+  bit-identical to the same request run alone at equal padded
+  capacity), overload determinism, deadline expiry before-dispatch vs
+  in-flight, and per-cohort fault isolation.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ba_tpu.obs.registry import MetricsRegistry
+from ba_tpu.runtime import chaos
+from ba_tpu.runtime.serve import (
+    AgreementRequest,
+    AgreementService,
+    DeadlineExceeded,
+    Overloaded,
+    RequestFailed,
+    ServeConfig,
+    ServeError,
+    Ticket,
+    cohort_key,
+    shed_tier,
+    validate_request,
+)
+
+
+# -- jax-free admission layer -------------------------------------------------
+
+
+def test_serve_import_is_jax_free():
+    # The BA301 host-tier contract, proven at runtime: importing the
+    # service must not pull jax (admission control and plan validation
+    # run on hosts without it).
+    code = (
+        "import sys; import ba_tpu.runtime.serve; "
+        "assert 'jax' not in sys.modules, 'serve import pulled jax'; "
+        "print('ok')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+def test_shed_tier_ladder():
+    cfg = ServeConfig()
+    # Healthy; absent signals never raise the tier.
+    assert shed_tier(0.0, None, None, cfg) == 0
+    assert shed_tier(0.0, 0.1, 1.0, cfg) == 0
+    # Tier 1: queue soft, lag soft, or device saturation.
+    assert shed_tier(cfg.queue_soft_frac, None, None, cfg) == 1
+    assert shed_tier(0.0, cfg.lag_soft_s, None, cfg) == 1
+    assert shed_tier(0.0, None, float(cfg.depth), cfg) == 1
+    # Tier 2: queue hard or lag hard (inf — the overflow bucket —
+    # counts as hard).
+    assert shed_tier(cfg.queue_hard_frac, None, None, cfg) == 2
+    assert shed_tier(0.0, cfg.lag_hard_s, None, cfg) == 2
+    assert shed_tier(0.0, float("inf"), None, cfg) == 2
+    # Tier 3: queue full beats everything.
+    assert shed_tier(1.0, None, None, cfg) == 3
+
+
+def test_serve_config_validate_and_env(monkeypatch):
+    with pytest.raises(ValueError):
+        ServeConfig(max_batch=0)
+    with pytest.raises(ValueError):
+        ServeConfig(max_queue=0)
+    with pytest.raises(ValueError):
+        ServeConfig(coalesce_window_s=-1.0)
+    with pytest.raises(ValueError):
+        ServeConfig(queue_soft_frac=0.9, queue_hard_frac=0.5)
+    with pytest.raises(ValueError):
+        ServeConfig(lag_soft_s=9.0, lag_hard_s=1.0)
+    monkeypatch.setenv("BA_TPU_SERVE_BATCH", "16")
+    monkeypatch.setenv("BA_TPU_SERVE_QUEUE", "5")
+    monkeypatch.setenv("BA_TPU_SERVE_WINDOW_S", "0.25")
+    monkeypatch.setenv("BA_TPU_SERVE_DEADLINE_S", "")
+    cfg = ServeConfig.from_env()
+    assert (cfg.max_batch, cfg.max_queue) == (16, 5)
+    assert cfg.coalesce_window_s == 0.25
+    assert cfg.default_deadline_s is None  # "" = no deadline
+    monkeypatch.setenv("BA_TPU_SERVE_RETRIES", "7")
+    assert cfg.resolved_max_retries() == 7
+
+
+def test_validate_request_errors():
+    validate_request(AgreementRequest())  # the default is valid
+    with pytest.raises(ValueError):
+        validate_request(AgreementRequest(kind="nope"))
+    with pytest.raises(ValueError):
+        validate_request(AgreementRequest(order="surrender"))
+    with pytest.raises(ValueError):
+        validate_request(AgreementRequest(n=0))
+    with pytest.raises(ValueError):
+        validate_request(AgreementRequest(faulty=(4,)))  # outside n=4
+    with pytest.raises(ValueError):
+        validate_request(AgreementRequest(faulty=(True,)))
+    with pytest.raises(ValueError):  # actual-order is one round
+        validate_request(AgreementRequest(kind="actual-order", rounds=3))
+    with pytest.raises(ValueError):
+        validate_request(AgreementRequest(kind="run-rounds", rounds=0))
+    with pytest.raises(ValueError):  # scenario needs a spec
+        validate_request(AgreementRequest(kind="scenario"))
+    with pytest.raises(ValueError):  # ...and only scenario takes one
+        validate_request(AgreementRequest(kind="run-rounds", spec=object()))
+    # Cohorts: same (scenario-ness, rounds, padded capacity) coalesce;
+    # an actual-order and a 1-round run-rounds share a batch.
+    a = AgreementRequest(kind="actual-order", n=3, seed=1)
+    b = AgreementRequest(kind="run-rounds", n=4, seed=2, rounds=1)
+    c = AgreementRequest(kind="run-rounds", n=5, seed=3, rounds=1)
+    assert cohort_key(a) == cohort_key(b)
+    assert cohort_key(c) != cohort_key(b)  # capacity 8 vs 4
+
+
+def test_admission_closed_service_rejects():
+    svc = AgreementService(ServeConfig(max_queue=2), registry=MetricsRegistry())
+    with pytest.raises(ServeError):
+        svc.submit(AgreementRequest())
+    svc.open()
+    t = svc.submit(AgreementRequest())
+    assert isinstance(t, Ticket) and not t.done()
+    svc.stop()  # never started: queued ticket fails loudly
+    with pytest.raises(ServeError):
+        t.result(timeout=1)
+    with pytest.raises(ServeError):
+        svc.submit(AgreementRequest())  # closed again
+
+
+def test_admission_queue_full_is_bounded_rejection():
+    cfg = ServeConfig(max_queue=3)
+    svc = AgreementService(cfg, registry=MetricsRegistry())
+    svc.open()  # admission without the dispatcher: deterministic fill
+    for i in range(cfg.max_queue):
+        svc.submit(AgreementRequest(kind="run-rounds", seed=i, rounds=2))
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(AgreementRequest(kind="run-rounds", seed=99, rounds=2))
+    assert exc.value.reason == "queue_full"
+    assert exc.value.retry_after_s > 0
+    # The queue NEVER grew past its bound (the overload acceptance
+    # criterion's memory half): depth stays max_queue however many
+    # submissions storm in.
+    for i in range(10):
+        with pytest.raises(Overloaded):
+            svc.submit(AgreementRequest(kind="run-rounds", rounds=2))
+    assert svc.stats()["queue_depth"] == cfg.max_queue
+    assert svc.stats()["rejected"] == 11
+    svc.stop()
+
+
+def test_admission_sheds_interactive_before_campaigns():
+    from ba_tpu.scenario import from_dict
+
+    svc = AgreementService(ServeConfig(max_queue=100), registry=MetricsRegistry())
+    svc.open()
+    spec = from_dict({"name": "t", "rounds": 2, "events": []})
+    # Tier 2 (set directly — the ladder itself is unit-tested above,
+    # and the live transition is driven end-to-end by
+    # scripts/check_metrics_schema.py): interactive sheds, campaigns
+    # still admit.
+    svc._tier = 2
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(AgreementRequest(kind="run-rounds", rounds=2))
+    assert exc.value.reason == "shed_interactive"
+    with pytest.raises(Overloaded):
+        svc.submit(AgreementRequest(kind="actual-order"))
+    svc.submit(AgreementRequest(kind="scenario", spec=spec))  # admitted
+    # Tier 3: everything rejects.
+    svc._tier = 3
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(AgreementRequest(kind="scenario", spec=spec))
+    assert exc.value.reason == "shed_all"
+    svc.stop()
+
+
+def test_ticket_result_timeout():
+    t = Ticket(AgreementRequest(), 1, None)
+    with pytest.raises(TimeoutError):
+        t.result(timeout=0.01)
+    t._resolve({"x": 1})
+    assert t.result(timeout=1) == {"x": 1}
+
+
+def test_client_fault_plan_round_trip_and_validation():
+    doc = {
+        "name": "clients",
+        "faults": [
+            {"round": 2, "kind": "slow_client", "seconds": 0.5,
+             "times": 3},
+            {"round": 4, "kind": "abandon"},
+            {"round": 6, "kind": "deadline_storm"},
+        ],
+    }
+    plan = chaos.from_dict(doc)
+    assert [f.phase for f in plan.faults] == ["client"] * 3
+    assert chaos.to_dict(plan) == doc  # defaults omitted, byte-stable
+    with pytest.raises(chaos.FaultPlanError):  # needs seconds
+        chaos.from_dict({"name": "x", "faults": [
+            {"round": 0, "kind": "slow_client"}]})
+    with pytest.raises(chaos.FaultPlanError):  # seconds meaningless
+        chaos.from_dict({"name": "x", "faults": [
+            {"round": 0, "kind": "abandon", "seconds": 1.0}]})
+    with pytest.raises(chaos.FaultPlanError):  # client kind, engine phase
+        chaos.from_dict({"name": "x", "faults": [
+            {"round": 0, "kind": "abandon", "phase": "dispatch"}]})
+    with pytest.raises(chaos.FaultPlanError):  # engine kind, client phase
+        chaos.from_dict({"name": "x", "faults": [
+            {"round": 0, "kind": "transient", "phase": "client"}]})
+
+
+def test_client_fault_plan_ordinal_consumption():
+    plan = chaos.from_dict({
+        "name": "t",
+        "faults": [
+            {"round": 1, "kind": "slow_client", "seconds": 0.1,
+             "times": 2},
+            {"round": 1, "kind": "abandon"},
+        ],
+    })
+    inj = chaos.ChaosInjector(plan)
+    assert inj.client_faults(0) == []
+    fired = inj.client_faults(1)
+    assert sorted(f.kind for f in fired) == ["abandon", "slow_client"]
+    # times respected; the slow_client entry has one firing left but
+    # only matches its own ordinal.
+    assert [f.kind for f in inj.client_faults(1)] == ["slow_client"]
+    assert inj.client_faults(1) == []
+    assert len(inj.fired) == 3
+    assert all(f["phase"] == "client" for f in inj.fired)
+
+
+def test_committed_deadline_storm_plan_is_valid():
+    plan = chaos.load("examples/faults/deadline_storm.json")
+    kinds = {f.kind for f in plan.faults}
+    assert kinds == {"slow_client", "abandon", "deadline_storm"}
+    assert all(f.phase == "client" for f in plan.faults)
+
+
+# -- engine-backed serving layer ----------------------------------------------
+
+
+def _alone_state(n, faulty, order, cap):
+    """The B=1 padded state a request run ALONE would use (exactly the
+    service's staging at batch slot 0)."""
+    import jax.numpy as jnp
+
+    from ba_tpu.core.state import SimState
+    from ba_tpu.core.types import COMMAND_DTYPE, command_from_name
+    from ba_tpu.parallel.pipeline import fresh_copy
+
+    f = np.zeros((1, cap), bool)
+    a = np.zeros((1, cap), bool)
+    a[0, :n] = True
+    for i in faulty:
+        f[0, i] = True
+    return fresh_copy(
+        SimState(
+            order=jnp.full((1,), command_from_name(order), COMMAND_DTYPE),
+            leader=jnp.zeros((1,), jnp.int32),
+            faulty=jnp.asarray(f),
+            alive=jnp.asarray(a),
+            ids=jnp.asarray(
+                np.arange(1, cap + 1, dtype=np.int32)[None, :]
+            ),
+        )
+    )
+
+
+def _alone_run(req, rounds=None, scenario_block=None):
+    """The reference the parity pin compares against: the same request
+    run ALONE through the standard engine at equal padded capacity."""
+    import jax.random as jr
+
+    from ba_tpu.parallel.pipeline import pipeline_sweep, scenario_sweep
+
+    cap = 4
+    state = _alone_state(req.n, req.faulty, req.order, cap)
+    if scenario_block is not None:
+        return scenario_sweep(
+            jr.key(req.seed), state, scenario_block,
+            collect_decisions=True, rounds_per_dispatch=2,
+        )
+    return pipeline_sweep(
+        jr.key(req.seed), state, rounds, collect_decisions=True,
+        with_counters=True, rounds_per_dispatch=2,
+    )
+
+
+def test_coalesced_parity_plain():
+    # THE acceptance pin (heart of ISSUE 10): every slot of a coalesced
+    # batch is bit-identical to its own run alone at equal padded
+    # capacity — decisions, per-slot counters, final majorities.
+    import jax.random as jr
+
+    from ba_tpu.parallel.pipeline import coalesced_sweep, fresh_copy
+
+    reqs = [
+        AgreementRequest(kind="run-rounds", order="attack", n=4,
+                         faulty=(2,), seed=11, rounds=4),
+        AgreementRequest(kind="run-rounds", order="retreat", n=3,
+                         faulty=(), seed=12, rounds=4),
+        AgreementRequest(kind="run-rounds", order="attack", n=4,
+                         faulty=(1, 3), seed=13, rounds=4),
+    ]
+    import jax.numpy as jnp
+
+    from ba_tpu.core.state import SimState
+
+    rows = [_alone_state(r.n, r.faulty, r.order, 4) for r in reqs]
+    batched = fresh_copy(
+        SimState(*[
+            jnp.concatenate([getattr(s, f) for s in rows])
+            for f in ("order", "leader", "faulty", "alive", "ids")
+        ])
+    )
+    co = coalesced_sweep(
+        [jr.key(r.seed) for r in reqs], batched, 4,
+        rounds_per_dispatch=2,
+    )
+    retire_windows = []
+    co2 = coalesced_sweep(
+        [jr.key(r.seed) for r in reqs],
+        fresh_copy(SimState(*[
+            jnp.concatenate([getattr(s, f) for s in
+                             [_alone_state(r.n, r.faulty, r.order, 4)
+                              for r in reqs]])
+            for f in ("order", "leader", "faulty", "alive", "ids")
+        ])),
+        4, rounds_per_dispatch=2,
+        on_retire=lambda d, lo, hi, ys: retire_windows.append((lo, hi)),
+    )
+    # The slot→request mapping hook saw every round window, in order.
+    assert retire_windows == [(0, 2), (2, 4)]
+    np.testing.assert_array_equal(co2["decisions"], co["decisions"])
+    for i, req in enumerate(reqs):
+        alone = _alone_run(req, rounds=4)
+        np.testing.assert_array_equal(
+            co["decisions"][:, i], alone["decisions"][:, 0]
+        )
+        got = dict(zip(co["counter_names"], (int(v) for v in
+                                             co["counters"][i])))
+        assert got == alone["counters"]
+    # Majorities: alone at B=1 through the same coalesced entry.
+    for i, req in enumerate(reqs):
+        solo = coalesced_sweep(
+            [jr.key(req.seed)],
+            _alone_state(req.n, req.faulty, req.order, 4),
+            4, rounds_per_dispatch=2,
+        )
+        np.testing.assert_array_equal(
+            co["majorities"][i], solo["majorities"][0]
+        )
+
+
+def test_coalesced_parity_scenario():
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ba_tpu.core.state import SimState
+    from ba_tpu.parallel.pipeline import coalesced_sweep, fresh_copy
+    from ba_tpu.scenario import compile_scenario, from_dict
+
+    spec_a = from_dict({"name": "a", "rounds": 4, "events": [
+        {"round": 1, "kill": [1]},
+    ]})
+    spec_b = from_dict({"name": "b", "rounds": 4, "events": [
+        {"round": 2, "set_faulty": [2], "value": True},
+        {"round": 3, "set_strategy": [2], "value": "collude_attack"},
+    ]})
+    ids = np.arange(1, 5, dtype=np.int64)
+    blocks = [
+        compile_scenario(s, 1, 4, ids=ids) for s in (spec_a, spec_b)
+    ]
+    reqs = [
+        AgreementRequest(kind="scenario", n=4, seed=21, spec=spec_a),
+        AgreementRequest(kind="scenario", n=4, faulty=(3,), seed=22,
+                         spec=spec_b),
+    ]
+    rows = [_alone_state(r.n, r.faulty, r.order, 4) for r in reqs]
+    batched = fresh_copy(
+        SimState(*[
+            jnp.concatenate([getattr(s, f) for s in rows])
+            for f in ("order", "leader", "faulty", "alive", "ids")
+        ])
+    )
+    planes = {
+        name: np.concatenate(
+            [getattr(b, name) for b in blocks], axis=1
+        )
+        for name in ("kill", "revive", "set_faulty", "set_strategy")
+    }
+    co = coalesced_sweep(
+        [jr.key(r.seed) for r in reqs], batched, 4,
+        rounds_per_dispatch=2, scenario=planes,
+    )
+    for i, (req, block) in enumerate(zip(reqs, blocks)):
+        alone = _alone_run(req, scenario_block=block)
+        np.testing.assert_array_equal(
+            co["decisions"][:, i], alone["decisions"][:, 0]
+        )
+        np.testing.assert_array_equal(
+            co["leaders"][:, i], alone["leaders"][:, 0]
+        )
+        got = dict(zip(co["counter_names"], (int(v) for v in
+                                             co["counters"][i])))
+        assert got == alone["counters"]
+
+
+def test_serve_batched_requests_bit_exact_and_coalesced():
+    # The service path end-to-end: concurrent submissions coalesce into
+    # ONE batch and every result matches its alone run.
+    svc = AgreementService(
+        ServeConfig(max_batch=4, max_queue=16, coalesce_window_s=0.25,
+                    rounds_per_dispatch=2),
+        registry=MetricsRegistry(),
+    )
+    svc.start()
+    reqs = [
+        AgreementRequest(kind="run-rounds", order=("attack", "retreat")[i % 2],
+                         n=(4, 3, 4, 2)[i], faulty=((2,), (), (1,), ())[i],
+                         seed=30 + i, rounds=4)
+        for i in range(4)
+    ]
+    tickets = [svc.submit(r) for r in reqs]
+    outs = [t.result(timeout=300) for t in tickets]
+    try:
+        assert [o["batch"] for o in outs] == [4, 4, 4, 4]
+        assert sorted(o["slot"] for o in outs) == [0, 1, 2, 3]
+        for req, out in zip(reqs, outs):
+            alone = _alone_run(req, rounds=4)
+            assert out["decisions"] == [
+                int(v) for v in alone["decisions"][:, 0]
+            ]
+            assert out["counters"] == alone["counters"]
+            assert out["run_id"].startswith("run-")
+    finally:
+        svc.stop()
+
+
+def test_overload_path_deterministic_no_deadlock():
+    # Fill the bounded queue with the dispatcher parked, overflow
+    # rejects explicitly, then the dispatcher drains EVERYTHING — no
+    # deadlock, every ticket terminal.
+    cfg = ServeConfig(max_batch=4, max_queue=4, coalesce_window_s=0.05,
+                      rounds_per_dispatch=2)
+    svc = AgreementService(cfg, registry=MetricsRegistry())
+    svc.open()
+    tickets = [
+        svc.submit(AgreementRequest(kind="run-rounds", seed=40 + i,
+                                    rounds=2))
+        for i in range(cfg.max_queue)
+    ]
+    with pytest.raises(Overloaded):
+        svc.submit(AgreementRequest(kind="run-rounds", rounds=2))
+    svc.start()
+    outs = [t.result(timeout=300) for t in tickets]
+    assert all(o["counts"]["attack"] + o["counts"]["retreat"]
+               + o["counts"]["undefined"] == 2 for o in outs)
+    st = svc.stats()
+    assert st["completed"] == 4 and st["rejected"] == 1
+    assert st["queue_depth"] == 0
+    svc.stop()
+    assert not svc.running()
+
+
+def test_deadline_expiry_before_dispatch_vs_in_flight():
+    # Before-dispatch: an expired budget cancels the request with
+    # DeadlineExceeded.  In-flight: a deadline passing AFTER dispatch
+    # never cancels — the donated cohort completes and the (late)
+    # result is still delivered.
+    plan = chaos.from_dict({"name": "slow", "faults": [
+        {"round": 0, "kind": "stall", "phase": "dispatch",
+         "seconds": 0.4},
+    ]})
+    svc = AgreementService(
+        ServeConfig(max_batch=2, max_queue=8, coalesce_window_s=0.001,
+                    rounds_per_dispatch=2),
+        fault_plan=plan,
+        registry=MetricsRegistry(),
+    )
+    svc.open()
+    dead = svc.submit(
+        AgreementRequest(kind="run-rounds", seed=50, rounds=2),
+        deadline_s=0.0,
+    )
+    svc.start()
+    with pytest.raises(DeadlineExceeded):
+        dead.result(timeout=60)
+    # In-flight: dispatch starts immediately (empty queue, ~zero
+    # window) and the injected 0.4 s stall pushes completion past the
+    # 0.15 s budget — the result must still arrive.
+    t0 = time.perf_counter()
+    late = svc.submit(
+        AgreementRequest(kind="run-rounds", seed=51, rounds=2),
+        deadline_s=0.15,
+    )
+    out = late.result(timeout=60)
+    assert time.perf_counter() - t0 >= 0.35
+    assert out["counts"]
+    assert svc.stats()["expired"] == 1
+    svc.stop()
+
+
+def test_cohort_fatal_fails_only_its_cohort():
+    # A mid-request injected fatal exhausts nothing but its own
+    # cohort: those tickets fail with the classified fault while a
+    # concurrent request (different cohort) completes bit-exactly and
+    # the service keeps serving afterwards.
+    plan = chaos.from_dict({"name": "one-fatal", "faults": [
+        {"round": 0, "kind": "fatal"},
+    ]})
+    svc = AgreementService(
+        ServeConfig(max_batch=2, max_queue=8, coalesce_window_s=0.02,
+                    rounds_per_dispatch=2),
+        fault_plan=plan,
+        registry=MetricsRegistry(),
+    )
+    svc.open()
+    doomed_req = AgreementRequest(kind="run-rounds", seed=60, rounds=4)
+    doomed = svc.submit(doomed_req)
+    bystander_req = AgreementRequest(kind="run-rounds", seed=61, rounds=2)
+    bystander = svc.submit(bystander_req)  # different cohort (rounds)
+    svc.start()
+    with pytest.raises(RequestFailed) as exc:
+        doomed.result(timeout=300)
+    assert exc.value.fault == "fatal"
+    out = bystander.result(timeout=300)
+    alone = _alone_run(bystander_req, rounds=2)
+    assert out["decisions"] == [int(v) for v in alone["decisions"][:, 0]]
+    # The service survived: the SAME request re-submitted (fault
+    # consumed, times=1) now completes bit-exactly.
+    retry = svc.submit(doomed_req).result(timeout=300)
+    alone2 = _alone_run(doomed_req, rounds=4)
+    assert retry["decisions"] == [int(v) for v in alone2["decisions"][:, 0]]
+    st = svc.stats()
+    assert st["failed"] == 1 and st["completed"] == 2
+    assert st["injected"] == 1
+    svc.stop()
+
+
+def test_serve_transient_retry_in_place():
+    # Transient faults retry inside the seam (supervisor backoff +
+    # classification) without failing the cohort — and the retried
+    # result is bit-exact (injection fires before the donated carry is
+    # consumed).
+    plan = chaos.from_dict({"name": "flaky", "faults": [
+        {"round": 0, "kind": "transient", "times": 2},
+    ]})
+    svc = AgreementService(
+        ServeConfig(max_batch=2, max_queue=8, coalesce_window_s=0.001,
+                    rounds_per_dispatch=2),
+        fault_plan=plan,
+        registry=MetricsRegistry(),
+    )
+    svc.start()
+    req = AgreementRequest(kind="run-rounds", seed=70, rounds=2)
+    out = svc.submit(req).result(timeout=300)
+    alone = _alone_run(req, rounds=2)
+    assert out["decisions"] == [int(v) for v in alone["decisions"][:, 0]]
+    st = svc.stats()
+    assert st["retries"] == 2 and st["failed"] == 0
+    svc.stop()
+
+
+def test_dispatch_watchdog_wedge_applies_backpressure():
+    # A dispatch running past dispatch_timeout_s cannot be interrupted
+    # (PR 7 semantics) — the watchdog observes and applies explicit
+    # backpressure: tier 3 while wedged (submissions reject with the
+    # wedge named in the shed record), the late result still delivers,
+    # and the tier decays once the dispatch returns.
+    plan = chaos.from_dict({"name": "wedge", "faults": [
+        {"round": 0, "kind": "stall", "phase": "dispatch",
+         "seconds": 1.0},
+    ]})
+    svc = AgreementService(
+        ServeConfig(max_batch=2, max_queue=8, coalesce_window_s=0.001,
+                    rounds_per_dispatch=2, dispatch_timeout_s=0.2),
+        fault_plan=plan,
+        registry=MetricsRegistry(),
+    )
+    svc.open()
+    req = AgreementRequest(kind="run-rounds", seed=80, rounds=2)
+    wedged = svc.submit(req)
+    svc.start()
+    time.sleep(0.6)  # stall 1.0 s in flight; watchdog fired at ~0.2 s
+    assert svc.stats()["tier"] == 3
+    with pytest.raises(Overloaded) as exc:
+        svc.submit(AgreementRequest(kind="run-rounds", seed=81, rounds=2))
+    assert exc.value.reason == "shed_all"
+    out = wedged.result(timeout=60)  # the wedge clears, result delivers
+    alone = _alone_run(req, rounds=2)
+    assert out["decisions"] == [int(v) for v in alone["decisions"][:, 0]]
+    # Recovery: tier decays on the dispatcher's next refresh ticks.
+    later = None
+    for _ in range(200):
+        try:
+            later = svc.submit(
+                AgreementRequest(kind="run-rounds", seed=82, rounds=2)
+            )
+            break
+        except Overloaded:
+            time.sleep(0.05)
+    assert later is not None, "tier never decayed after the wedge"
+    later.result(timeout=60)
+    assert svc.stats()["stalls"] == 1
+    svc.stop()
+
+
+def test_repl_serve_command():
+    from ba_tpu.runtime.backends import PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    cluster = Cluster(4, PyBackend(), seed=0)
+    lines = []
+    handle_command(cluster, "serve", lines.append)
+    assert lines and lines[0].startswith("serve error: usage")
+    lines.clear()
+    handle_command(cluster, "serve stat", lines.append)
+    assert lines == ["serve error: not running (serve start first)"]
+    lines.clear()
+    handle_command(
+        cluster, "serve start queue=4 window=0.01 batch=2", lines.append
+    )
+    assert lines == ["serve: started (queue=4, window=0.01s, batch=2)"]
+    lines.clear()
+    handle_command(cluster, "serve start", lines.append)
+    assert lines == ["serve error: already running (serve stop first)"]
+    lines.clear()
+    handle_command(cluster, "serve stat", lines.append)
+    assert any(ln.startswith("serve_queue_depth ") for ln in lines)
+    assert any(ln.startswith("serve_tier ") for ln in lines)
+    lines.clear()
+    handle_command(cluster, "serve start queue=x", lines.append)
+    assert lines == ["serve error: already running (serve stop first)"]
+    lines.clear()
+    handle_command(cluster, "serve stop", lines.append)
+    assert lines[0].startswith("serve: stopped — admitted=0")
+    lines.clear()
+    handle_command(cluster, "serve bogus", lines.append)
+    assert lines[0].startswith("serve error: usage")
