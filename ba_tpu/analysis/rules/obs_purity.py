@@ -48,6 +48,14 @@ FUNCTION-LOCAL imports are the sanctioned lazy seam (the
 ``runtime/backends.py`` discipline) — the check skips imports nested
 inside a function body and flags everything at module scope, including
 module-level imports whose own closure reaches the jitted trees.
+
+The WARMUP pass (ISSUE 11): ``ba_tpu.runtime.warmup`` joins the same
+module-level host-tier scope (plan construction is jax-free; the AOT
+builders, which need the jitted trees, load lazily from the runner
+thread).  The executable cache ``ba_tpu.obs.aotcache`` needs no listing
+— it sits inside the obs scope, whose STRICTER rule (even function-local
+core/ops imports are findings) already covers it; its specialization
+builders therefore live in ``parallel/pipeline.py`` and are passed in.
 """
 
 from __future__ import annotations
@@ -59,8 +67,11 @@ from ba_tpu.analysis.base import Rule, register
 SCOPES = ("ba_tpu.core", "ba_tpu.ops")
 OBS = "ba_tpu.obs"
 SINK = "ba_tpu.utils.metrics"
-# Host-tier-at-module-level modules: the serving front-end (ISSUE 10).
-HOST_TIER_MODULES = ("ba_tpu.runtime.serve",)
+# Host-tier-at-module-level modules: the serving front-end (ISSUE 10)
+# and the warmup pass (ISSUE 11) — both must import jax-free (plan
+# construction and admission run on hosts without jax) and reach the
+# engine only through function-local imports.
+HOST_TIER_MODULES = ("ba_tpu.runtime.serve", "ba_tpu.runtime.warmup")
 
 
 def _in_scope(modname: str) -> bool:

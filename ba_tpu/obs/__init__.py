@@ -36,7 +36,7 @@ buffers, and triggers no extra compiles — the overhead-guard tests in
 tests/test_obs.py and tests/test_obs_xla.py pin it.
 """
 
-from ba_tpu.obs import flight, health, instrument, registry, trace, xla
+from ba_tpu.obs import aotcache, flight, health, instrument, registry, trace, xla
 from ba_tpu.obs.instrument import (
     classify_compile,
     compile_or_dispatch_span,
@@ -51,6 +51,7 @@ from ba_tpu.obs.trace import Tracer, default_tracer, instant, span
 __all__ = [
     "MetricsRegistry",
     "Tracer",
+    "aotcache",
     "classify_compile",
     "compile_or_dispatch_span",
     "configure_compile_ledger",

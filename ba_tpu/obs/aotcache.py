@@ -1,0 +1,490 @@
+"""Persistent compiled-executable cache: AOT specializations the
+serving path can dispatch without ever compiling on a request
+(ISSUE 11 tentpole).
+
+The persistent XLA cache (``utils/platform.enable_compilation_cache``)
+already turns repeat compiles into disk reads — but a disk read still
+happens INSIDE the first dispatch of each specialization, on whatever
+thread issued it.  For a service that is the request path:
+``BENCH_serving_r11.json`` reads p50 22 ms / p99 1.27 s because
+first-window compiles land on request latency.  This module is the next
+step: whole ``jax.stages.Compiled`` executables, AOT lower+compiled OFF
+the request path (``runtime/warmup.py``'s background pass) and persisted
+NEXT TO the XLA cache, so a warm process — or a warm fleet sharing the
+directory — dispatches every bucketed specialization without paying even
+the deserialize inside a request.
+
+Cache entries are keyed by the SAME named-axes compile signature the
+recompile explainer and the cross-run ledger speak
+(``obs/instrument.py``): ``fn`` + axes dict + jax/jaxlib versions +
+backend.  The filename tag hashes only (fn, axes) — the env components
+live in the entry HEADER and are verified on every load, so a
+stale-toolchain entry is an OBSERVABLE eager invalidation (counted,
+entry removed, fresh compile) rather than a silent never-hit.  The
+degradation ladder, in order:
+
+- **signature mismatch** (jax/jaxlib/backend/axes drift): the entry is
+  invalidated eagerly — removed, counted, ``None`` returned; the caller
+  falls back to a fresh compile.  A stale executable must never load,
+  and a load that would misexecute is structurally impossible because
+  the comparison covers every key component.
+- **corrupt entry** (bad magic/header/pickle, or a deserialize the
+  backend refuses): quarantined to ``<entry>.corrupt`` (the
+  ``utils/snapshot.py`` discipline — bytes kept for post-mortem, the
+  family never trips on it twice), counted, fresh compile.
+- **plain miss**: fresh compile (the engine's jit path — compile-on-miss
+  always works; the serving dispatcher counts it as a request-path
+  compile).
+
+DONATION CONTRACT ON LOADS: a deserialized executable preserves the
+program's input/output aliasing — the donated carry is consumed exactly
+as by the jit path (pinned by test).  But its ``memory_analysis()`` is
+EMPTY, the same persistent-cache-hit trap ``obs/xla.py``'s
+``_compile_uncached`` documents — which is why :meth:`ExecutableCache
+.ensure` compiles through ``_compile_uncached`` (real memory stats),
+harvests the cost/memory analyses ONCE, and stores them in the entry
+header: ``alias_bytes`` in the header is the donation-regression
+evidence for every future process that loads the entry, and
+``obs/xla.introspect`` reuses these recorded analyses instead of paying
+its own second uncached compile (the ISSUE 11 dedupe).
+
+This module is an obs module: HOST-TIER by the ba-lint BA301 contract —
+it never imports ``ba_tpu.core``/``ba_tpu.ops`` (even lazily) and
+imports jax only inside function bodies.  Specialization BUILDERS (axes
+-> abstract args, which need the jitted trees) therefore live in
+``parallel/pipeline.py`` (``AOT_SPECS``) and are passed IN as callables.
+
+``BA_TPU_AOT_CACHE`` overrides the directory (``0`` disables
+persistence — the cache still memoizes in-process); the default sits
+next to the persistent XLA cache at ``~/.cache/ba_tpu/aot``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import threading
+import time
+
+# ONE spelling of the CompiledMemoryStats attr -> record-field mapping
+# (obs/xla.py owns it; its module level is stdlib-only, so this import
+# stays jax-free): a memory field added there lands in entry headers —
+# and thus in introspect's dedupe branch — without a drift hazard.
+from ba_tpu.obs.xla import _MEMORY_FIELDS
+
+CACHE_ENV = "BA_TPU_AOT_CACHE"
+ENTRY_FORMAT = "ba_tpu.aot_executable"
+ENTRY_VERSION = 1
+_MAGIC = b"BAAOT1\n"
+
+# Fields harvested from the FRESH compile's analyses into every entry
+# header (and the in-process analyses registry below) — the same set
+# obs/xla.introspect records, so the dedupe path emits identical shapes.
+ANALYSIS_FIELDS = ("flops", "bytes_accessed") + tuple(
+    field for _attr, field in _MEMORY_FIELDS
+)
+
+# In-process analyses registry: (fn, frozen axes) -> {field: number}.
+# Written by every ExecutableCache on ensure()/load; read by
+# obs/xla.introspect so a signature the aotcache already compiled (with
+# REAL memory stats) never pays introspection's second uncached compile.
+_analyses_lock = threading.Lock()
+_analyses: dict = {}
+
+
+def cache_dir() -> str | None:
+    """The entry directory: ``BA_TPU_AOT_CACHE`` (``0`` disables), else
+    ``~/.cache/ba_tpu/aot`` — next to the persistent XLA cache's default
+    so one cache hygiene policy covers both."""
+    env = os.environ.get(CACHE_ENV, "")
+    if env == "0":
+        return None
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "ba_tpu", "aot")
+
+
+def env_signature() -> dict:
+    """The process-constant key components: a serialized executable is
+    only valid under the exact toolchain + backend — AND ba_tpu release
+    — that produced it (a package upgrade may change a megastep's
+    traced computation under unchanged axes names; without the version
+    component a stale executable would load and silently diverge from
+    the jit path, the one failure the bit-exactness contract cannot
+    tolerate.  Development edits between releases share a version
+    string — clear ``BA_TPU_AOT_CACHE`` or the cache dir when editing
+    megastep semantics in place)."""
+    import jax
+
+    import ba_tpu
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "unknown")
+    except ImportError:  # pragma: no cover - jax without jaxlib
+        jaxlib_version = "unknown"
+    return {
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version,
+        "backend": jax.default_backend(),
+        "ba_tpu_version": getattr(ba_tpu, "__version__", "unknown"),
+    }
+
+
+def full_signature(fn: str, axes: dict, env: dict | None = None) -> dict:
+    """The complete entry key: fn + named axes + env components — the
+    ledger's compile signature extended with the backend."""
+    sig = {"fn": fn}
+    sig.update(axes)
+    sig.update(env if env is not None else env_signature())
+    return sig
+
+
+def _axes_tag(fn: str, axes: dict) -> str:
+    blob = json.dumps({"fn": fn, "axes": axes}, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def entry_path(directory: str, fn: str, axes: dict) -> str:
+    """One stable filename per (fn, axes).  Env components are NOT in
+    the tag on purpose: a toolchain bump must surface as an observable
+    header-mismatch invalidation at load, not a silent never-hit that
+    strands stale entries forever."""
+    return os.path.join(directory, f"{fn}-{_axes_tag(fn, axes)}.aot")
+
+
+def _freeze(axes: dict):
+    from ba_tpu.obs.instrument import _freeze as freeze
+
+    return freeze(axes)
+
+
+def _jsonable(sig: dict) -> dict:
+    """The signature as it reads back from a JSON header — comparisons
+    must happen in this form or a tuple-vs-list difference would read as
+    a spurious invalidation."""
+    return json.loads(json.dumps(sig, sort_keys=True, default=str))
+
+
+def record_analyses(fn: str, axes: dict, fields: dict) -> None:
+    with _analyses_lock:
+        _analyses[(fn, _freeze(axes))] = {
+            f: fields[f] for f in ANALYSIS_FIELDS if f in fields
+        }
+
+
+def recorded_analyses(fn: str, axes: dict) -> dict | None:
+    """The cost/memory analyses an ExecutableCache harvested for this
+    signature (fresh compile's real stats — possibly in a previous
+    process, read back from the entry header), or None.  The
+    ``obs/xla.introspect`` dedupe source."""
+    with _analyses_lock:
+        got = _analyses.get((fn, _freeze(axes)))
+        return dict(got) if got is not None else None
+
+
+def reset_recorded_analyses() -> None:
+    """Test hook: forget every harvested analysis."""
+    with _analyses_lock:
+        _analyses.clear()
+
+
+class ExecutableCache:
+    """Thread-safe executable cache: in-process memo over a persistent
+    entry directory (``directory=None`` resolves :func:`cache_dir`; a
+    disabled directory keeps the memo, drops persistence).
+
+    - :meth:`get` — the ENGINE's request-path lookup: memo, then disk.
+      Never compiles; a miss returns None and the engine's jit path
+      compiles as it always did (counted by the serving dispatcher).
+    - :meth:`ensure` — the WARMUP path: memo, then disk, then a fresh
+      AOT compile through ``obs/xla._compile_uncached`` (real memory
+      stats — the persistent-cache-hit trap), persisted.
+
+    Both store a cross-run LEDGER row at acquisition
+    (``obs.instrument.note_ledger``) so the signature joins the next
+    process's warmup replay set — but deliberately NOT a jit
+    first-call mark: an AOT compile never populates jit's executable
+    cache, and a marked-but-jit-cold signature would later read as a
+    cached ``dispatch`` while paying a real, uncounted request-path
+    compile.  Warm dispatches skip the classifier entirely (the engine
+    spans them ``dispatch`` with ``warm=True``); cache-less jit
+    dispatches classify exactly as before.
+    """
+
+    def __init__(self, directory: str | None = None):
+        self.directory = cache_dir() if directory is None else (
+            directory or None
+        )
+        self._lock = threading.Lock()
+        self._mem: dict = {}   # key -> compiled callable
+        self._meta: dict = {}  # key -> entry header dict
+        # Negative memo: signatures a get() already probed the disk for
+        # and found nothing.  Without it, every dispatch window of an
+        # unwarmed signature would re-stat the entry file on the
+        # REQUEST path (the engine consults get() before each
+        # dispatch).  ensure() clears the mark, so a warmup completing
+        # mid-run becomes visible; an entry another PROCESS writes
+        # after our first probe stays invisible until restart —
+        # documented, and cheaper than per-dispatch I/O.
+        self._absent: set = set()
+        self._env: dict | None = None  # lazy: env_signature() needs jax
+        self.counts = {
+            "compiles": 0,     # fresh AOT compiles this process
+            "loads": 0,        # disk entries deserialized
+            "memo_hits": 0,
+            "misses": 0,       # get() found nothing anywhere
+            "invalidated": 0,  # eager signature-mismatch rejections
+            "corrupt": 0,      # quarantined entries
+            "evicted": 0,      # call-time failures dropped from memo
+            "store_errors": 0,
+        }
+
+    def enabled(self) -> bool:
+        return self.directory is not None
+
+    def _key(self, fn: str, axes: dict):
+        return (fn, _freeze(axes))
+
+    def _env_sig(self) -> dict:
+        if self._env is None:
+            self._env = env_signature()
+        return self._env
+
+    def _note_ledger(self, fn: str, axes: dict) -> None:
+        # Ledger row ONLY — never the jit first-call classifier: an AOT
+        # compile does not populate jit's executable cache, so marking
+        # the signature `seen` would make a later cache-less jit
+        # dispatch read as a cached `dispatch` while paying a real,
+        # uncounted request-path compile.  (The engine's warm dispatches
+        # skip the classifier entirely — pipeline._dispatch_span.)
+        from ba_tpu.obs import instrument
+
+        instrument.note_ledger(fn, dict(axes))
+
+    # -- request-path lookup -------------------------------------------------
+
+    def get(self, fn: str, axes: dict):
+        """The dispatcher's pre-dispatch consult: a warm executable for
+        this exact signature, or None (never compiles)."""
+        key = self._key(fn, axes)
+        with self._lock:
+            exe = self._mem.get(key)
+            if exe is not None:
+                self.counts["memo_hits"] += 1
+                return exe
+            if not self.enabled() or key in self._absent:
+                self.counts["misses"] += 1
+                return None
+        loaded = self._load(fn, axes)
+        if loaded is None:
+            with self._lock:
+                self._absent.add(key)
+                self.counts["misses"] += 1
+            return None
+        exe, header = loaded
+        with self._lock:
+            self._mem[key] = exe
+            self._meta[key] = header
+            self.counts["loads"] += 1
+        record_analyses(fn, axes, header)
+        self._note_ledger(fn, axes)
+        return exe
+
+    def evict(self, fn: str, axes: dict) -> None:
+        """Drop a signature whose memoized executable failed at CALL
+        time (the engine's warm-dispatch fallback): forget the memo,
+        quarantine the disk entry (it deserialized but cannot run —
+        keep the bytes for post-mortem, never trip on them again), and
+        negative-mark so later lookups skip straight to the jit path."""
+        key = self._key(fn, axes)
+        with self._lock:
+            self._mem.pop(key, None)
+            self._meta.pop(key, None)
+            self._absent.add(key)
+            self.counts["evicted"] += 1
+        if self.enabled():
+            path = entry_path(self.directory, fn, axes)
+            if os.path.exists(path):
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:
+                    pass
+
+    # -- warmup path ---------------------------------------------------------
+
+    def ensure(self, fn: str, axes: dict, builder) -> dict:
+        """Make this signature warm: memo -> disk load -> fresh AOT
+        compile (+persist).  ``builder(axes)`` returns ``(jitted, args,
+        kwargs)`` with abstract (ShapeDtypeStruct) array arguments —
+        ``parallel.pipeline.AOT_SPECS`` provides them.  Returns a status
+        dict (``status`` in ``cached``/``loaded``/``compiled``, plus
+        ``wall_s`` and — for fresh compiles — ``alias_bytes``).
+        Exceptions propagate: the warmup runner counts and continues.
+        """
+        key = self._key(fn, axes)
+        with self._lock:
+            if key in self._mem:
+                return {"status": "cached", "wall_s": 0.0}
+        t0 = time.perf_counter()
+        if self.enabled():
+            loaded = self._load(fn, axes)
+            if loaded is not None:
+                exe, header = loaded
+                with self._lock:
+                    self._mem[key] = exe
+                    self._meta[key] = header
+                    self.counts["loads"] += 1
+                record_analyses(fn, axes, header)
+                self._note_ledger(fn, axes)
+                return {
+                    "status": "loaded",
+                    "wall_s": round(time.perf_counter() - t0, 6),
+                }
+        exe, header = self._compile(fn, axes, builder)
+        with self._lock:
+            self._mem[key] = exe
+            self._meta[key] = header
+            self._absent.discard(key)
+            self.counts["compiles"] += 1
+        record_analyses(fn, axes, header)
+        self._note_ledger(fn, axes)
+        return {
+            "status": "compiled",
+            "wall_s": round(time.perf_counter() - t0, 6),
+            "alias_bytes": header.get("alias_bytes", 0),
+        }
+
+    def _compile(self, fn: str, axes: dict, builder):
+        # _compile_uncached, not plain .compile(): a persistent-XLA-cache
+        # HIT would hand back an executable with EMPTY memory stats, and
+        # the alias_bytes evidence stored below would silently read
+        # "donation broken" forever (the obs/xla.py trap, documented at
+        # its _compile_uncached).
+        from ba_tpu.obs.xla import _compile_uncached, _scalar
+
+        jitted, args, kwargs = builder(dict(axes))
+        lowered = jitted.lower(*args, **kwargs)
+        compiled = _compile_uncached(lowered)
+        try:
+            cost = compiled.cost_analysis()
+        except Exception:  # some backends only analyze pre-compile
+            cost = lowered.cost_analysis()
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:  # pragma: no cover - backend without stats
+            mem = None
+        header = {
+            "format": ENTRY_FORMAT,
+            "v": ENTRY_VERSION,
+            "fn": fn,
+            "axes": dict(axes),
+            "signature": _jsonable(
+                full_signature(fn, axes, env=self._env_sig())
+            ),
+            "flops": _scalar(cost, "flops"),
+            "bytes_accessed": _scalar(cost, "bytes accessed"),
+        }
+        for attr, field in _MEMORY_FIELDS:
+            header[field] = int(getattr(mem, attr, 0)) if mem is not None else 0
+        if self.enabled():
+            self._store(fn, axes, compiled, header)
+        return compiled, header
+
+    # -- disk entries --------------------------------------------------------
+
+    def _store(self, fn: str, axes: dict, compiled, header: dict) -> None:
+        from jax.experimental.serialize_executable import serialize
+
+        try:
+            payload = pickle.dumps(serialize(compiled))
+        except (ValueError, TypeError, pickle.PicklingError):
+            # A backend whose executables do not serialize: the memo
+            # still serves this process; persistence silently degrades.
+            with self._lock:
+                self.counts["store_errors"] += 1
+            return
+        head = json.dumps(header, sort_keys=True, default=str).encode()
+        path = entry_path(self.directory, fn, axes)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            with open(tmp, "wb") as fh:
+                fh.write(_MAGIC)
+                fh.write(struct.pack(">I", len(head)))
+                fh.write(head)
+                fh.write(payload)
+            os.replace(tmp, path)
+        except OSError:
+            with self._lock:
+                self.counts["store_errors"] += 1
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def _load(self, fn: str, axes: dict):
+        """One disk entry -> (executable, header), or None through the
+        documented degradation ladder (module docstring): mismatch
+        invalidates eagerly, corruption quarantines, absence is a plain
+        miss — a load NEVER raises into the caller."""
+        path = entry_path(self.directory, fn, axes)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+            if not data.startswith(_MAGIC):
+                raise ValueError("bad magic")
+            off = len(_MAGIC)
+            (hlen,) = struct.unpack(">I", data[off:off + 4])
+            header = json.loads(data[off + 4:off + 4 + hlen])
+            payload = data[off + 4 + hlen:]
+            if (
+                header.get("format") != ENTRY_FORMAT
+                or header.get("v") != ENTRY_VERSION
+                or not isinstance(header.get("signature"), dict)
+            ):
+                raise ValueError("bad header")
+        except (OSError, ValueError, struct.error):
+            self._quarantine(path)
+            return None
+        # EAGER invalidation on ANY key-component mismatch: axes (a
+        # hash-collision guard), jax/jaxlib versions, backend.  A stale
+        # entry must fall back to a fresh compile — never deserialize
+        # under a toolchain it was not built for.
+        want = _jsonable(full_signature(fn, axes, env=self._env_sig()))
+        if header["signature"] != want:
+            with self._lock:
+                self.counts["invalidated"] += 1
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            serialized, in_tree, out_tree = pickle.loads(payload)
+            exe = deserialize_and_load(serialized, in_tree, out_tree)
+        except Exception:
+            # Bad pickle bytes OR a backend refusing the deserialize:
+            # either way the entry is unusable — quarantine + fresh
+            # compile, never a crash on the warm path.
+            self._quarantine(path)
+            return None
+        return exe, header
+
+    def _quarantine(self, path: str) -> None:
+        with self._lock:
+            self.counts["corrupt"] += 1
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass

@@ -152,6 +152,51 @@ def _read_ledger_file(path: str) -> dict:
     return {}
 
 
+def ledger_signatures() -> dict:
+    """``{fn: [signature, ...]}`` — the cross-run ledger's accumulated
+    compile signatures: the previous processes' union plus this
+    session's, each row still carrying its env axes (and ``run_id``
+    rider).  The warmup pass (ISSUE 11) replays this set through the
+    executable cache so a restarted service pre-compiles exactly the
+    specializations real traffic reached before.  Empty when no ledger
+    is configured (``BA_TPU_COMPILE_LEDGER=0``, or no persistent cache).
+    """
+    with _ledger_lock:
+        if _ledger_path is None:
+            return {}
+        fns = {f: [dict(s) for s in sigs] for f, sigs in _ledger_prev.items()}
+        for f, cur in _ledger_cur.items():
+            rows = fns.setdefault(f, [])
+            rows.extend(dict(s) for s in cur if not _sig_in(s, rows))
+        return fns
+
+
+def ledger_env_axes() -> dict:
+    """The configured process-constant env axes (jax/jaxlib versions) —
+    what :func:`ledger_signatures` rows must match to be reproducible by
+    THIS process's toolchain (the warmup replay filter)."""
+    with _ledger_lock:
+        return dict(_ledger_env)
+
+
+def note_ledger(fn: str, axes: dict) -> None:
+    """Store one compile signature into the cross-run ledger WITHOUT
+    touching the jit first-call classifier (ISSUE 11).
+
+    The executable cache records its AOT compilations here so the next
+    process's warmup replays them — but an AOT ``.compile()`` never
+    populates jit's executable cache, so marking the signature ``seen``
+    (what :func:`classify_compile` does) would make a LATER jit dispatch
+    of the same signature read as a cached ``dispatch`` while silently
+    paying a real request-path compile.  The ledger row and the
+    classifier mark are separate concerns; this writes only the former.
+    No-op when no ledger is configured."""
+    with _ledger_lock:
+        if _ledger_path is None:
+            return
+        _ledger_store_locked(fn, {**axes, **_ledger_env})
+
+
 def _sig_core(sig: dict) -> dict:
     """A ledger row minus its ``run_id`` rider — the comparable compile
     signature.  The rider is provenance (which campaign's first compile

@@ -34,7 +34,11 @@ share jit's executable cache, so introspection pays one extra compile
 per specialization (a persistent-cache load when
 ``BA_TPU_COMPILE_CACHE`` is on; seconds on CPU, potentially a minute
 through the TPU tunnel — which is why it only runs when the sink or an
-HLO dir asks for it).  Meshed calls are introspected at their UNSHARDED
+HLO dir asks for it).  The ISSUE 11 dedupe removes the double-compile
+where possible: a signature the executable cache (``obs/aotcache.py``)
+already AOT-compiled — with real memory stats, by the same
+``_compile_uncached`` discipline — reuses those harvested analyses, and
+``_compile_uncached`` runs only on true cache misses.  Meshed calls are introspected at their UNSHARDED
 global shapes (the sharded executable may differ in layout; flops and
 alias accounting are shape-level properties and carry over).
 
@@ -155,38 +159,70 @@ def introspect(jitted, fn: str, args=(), kwargs=None, axes=None):
     from ba_tpu import obs
     from ba_tpu.utils import metrics
 
-    try:
-        with obs.timed_span("xla_introspect", "xla_introspect_s", fn=fn):
-            abs_args = abstractify(tuple(args))
-            abs_kwargs = abstractify(dict(kwargs or {}))
-            lowered = jitted.lower(*abs_args, **abs_kwargs)
-            compiled = _compile_uncached(lowered)
-            try:
-                cost = compiled.cost_analysis()
-            except Exception:  # some backends only analyze pre-compile
-                cost = lowered.cost_analysis()
-            mem = compiled.memory_analysis()
+    # Dedupe against the executable cache (ISSUE 11): when the aotcache
+    # already AOT-compiled this exact signature — with REAL memory stats
+    # (its ensure() pays _compile_uncached for precisely that) — reuse
+    # the harvested analyses instead of paying a SECOND uncached compile
+    # here.  HLO dumping still needs the live lowered/compiled objects,
+    # so an active BA_TPU_HLO keeps the full path.
+    cached = None
+    if hlo_dir() is None and axes is not None:
+        from ba_tpu.obs import aotcache
+
+        cached = aotcache.recorded_analyses(fn, dict(axes))
+    if cached is not None:
         record = {
             "event": "compiled_artifact",
             "v": metrics.SCHEMA_VERSION,
             "fn": fn,
-            "axes": dict(axes or {}),
-            "flops": _scalar(cost, "flops"),
-            "bytes_accessed": _scalar(cost, "bytes accessed"),
+            "axes": dict(axes),
+            "flops": cached.get("flops", 0.0),
+            "bytes_accessed": cached.get("bytes_accessed", 0.0),
         }
-        for attr, field in _MEMORY_FIELDS:
-            record[field] = int(getattr(mem, attr, 0)) if mem is not None else 0
+        for _attr, field in _MEMORY_FIELDS:
+            record[field] = int(cached.get(field, 0))
         record["donation_aliased"] = record["alias_bytes"] > 0
-        record["hlo_dump"] = _dump_hlo(fn, record["axes"], lowered, compiled)
-    except Exception as exc:  # best-effort: warn once per fn, move on
-        if fn not in _warned_fns:
-            _warned_fns.add(fn)
-            print(
-                f"ba_tpu.obs.xla: introspection of {fn!r} failed ({exc!r}); "
-                f"skipping",
-                file=sys.stderr,
+        record["hlo_dump"] = None
+        record["source"] = "aotcache"
+    else:
+        try:
+            with obs.timed_span(
+                "xla_introspect", "xla_introspect_s", fn=fn
+            ):
+                abs_args = abstractify(tuple(args))
+                abs_kwargs = abstractify(dict(kwargs or {}))
+                lowered = jitted.lower(*abs_args, **abs_kwargs)
+                compiled = _compile_uncached(lowered)
+                try:
+                    cost = compiled.cost_analysis()
+                except Exception:  # some backends analyze pre-compile
+                    cost = lowered.cost_analysis()
+                mem = compiled.memory_analysis()
+            record = {
+                "event": "compiled_artifact",
+                "v": metrics.SCHEMA_VERSION,
+                "fn": fn,
+                "axes": dict(axes or {}),
+                "flops": _scalar(cost, "flops"),
+                "bytes_accessed": _scalar(cost, "bytes accessed"),
+            }
+            for attr, field in _MEMORY_FIELDS:
+                record[field] = (
+                    int(getattr(mem, attr, 0)) if mem is not None else 0
+                )
+            record["donation_aliased"] = record["alias_bytes"] > 0
+            record["hlo_dump"] = _dump_hlo(
+                fn, record["axes"], lowered, compiled
             )
-        return None
+        except Exception as exc:  # best-effort: warn once per fn, move on
+            if fn not in _warned_fns:
+                _warned_fns.add(fn)
+                print(
+                    f"ba_tpu.obs.xla: introspection of {fn!r} failed "
+                    f"({exc!r}); skipping",
+                    file=sys.stderr,
+                )
+            return None
     metrics.emit(record)
     reg = obs.default_registry()
     for field in ("flops", "bytes_accessed", "temp_bytes", "alias_bytes"):

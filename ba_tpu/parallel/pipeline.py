@@ -53,6 +53,7 @@ propagated by the compiler.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import functools
 import json
@@ -914,6 +915,200 @@ def coalesced_megastep(  # ba-lint: donates(state, sched, strategy)
     return (carry[0], carry[1], carry[2], carry[4], *ys)
 
 
+# -- AOT specialization specs (ISSUE 11) --------------------------------------
+#
+# The executable cache (``obs/aotcache.py``) compiles megastep
+# specializations OFF the request path, keyed by the SAME named-axes
+# signature the dispatch loops build for the recompile explainer.  These
+# builders are the axes -> abstract-signature inverse: given one axes
+# dict, reconstruct the exact (jitted, args, kwargs) lowering call the
+# engine's dispatch of that signature performs — so a warmup-compiled
+# executable is THE executable the jit path would have compiled, and the
+# engine can dispatch it interchangeably (the warm-vs-cold bit-exactness
+# tests pin it).  They live HERE, not in the obs tier: building abstract
+# SimStates needs the jitted trees, which obs modules must never import
+# (ba-lint BA301).
+
+
+def _abstract_state(batch: int, capacity: int) -> SimState:
+    S = jax.ShapeDtypeStruct
+    return SimState(
+        order=S((batch,), COMMAND_DTYPE),
+        leader=S((batch,), jnp.int32),
+        faulty=S((batch, capacity), jnp.bool_),
+        alive=S((batch, capacity), jnp.bool_),
+        ids=S((batch, capacity), jnp.int32),
+    )
+
+
+def _key_data_spec():
+    """Shape/dtype of one typed key's raw data under the ACTIVE rng
+    implementation (threefry: ``(2,) uint32``) — executables specialize
+    on it, so the spec must be read live, never hard-coded."""
+    kd = jr.key_data(jr.key(0))
+    return tuple(kd.shape), kd.dtype
+
+
+def _event_plane_specs(rounds: int, batch: int, capacity: int) -> dict:
+    S = jax.ShapeDtypeStruct
+    shape = (rounds, batch, capacity)
+    # Dtypes mirror scenario.compile._fresh_planes — the one definition
+    # of a staged chunk's layout.
+    return {
+        "kill": S(shape, jnp.bool_),
+        "revive": S(shape, jnp.bool_),
+        "set_faulty": S(shape, jnp.int8),
+        "set_strategy": S(shape, jnp.int8),
+    }
+
+
+def coalesced_aot_spec(axes: dict):
+    """``(jitted, args, kwargs)`` lowering one :func:`coalesced_megastep`
+    specialization from its named axes signature (the serving
+    dispatcher's dict: batch/capacity/rounds/m/max_liars/unroll/
+    scenario)."""
+    S = jax.ShapeDtypeStruct
+    B, n, nr = axes["batch"], axes["capacity"], axes["rounds"]
+    scenario = bool(axes["scenario"])
+    kshape, kdtype = _key_data_spec()
+    sched = KeySchedule(
+        key_data=S((B,) + kshape, kdtype), counter=S((), jnp.int32)
+    )
+    strategy = S((B, n), jnp.int8) if scenario else None
+    names = SCENARIO_COUNTER_NAMES if scenario else COUNTER_NAMES
+    counters = S((B, len(names)), jnp.int32)
+    events = _event_plane_specs(nr, B, n) if scenario else None
+    return (
+        coalesced_megastep,
+        (_abstract_state(B, n), sched, strategy, counters, events),
+        dict(
+            rounds=nr,
+            m=axes["m"],
+            max_liars=axes["max_liars"],
+            unroll=axes["unroll"],
+            scenario=scenario,
+        ),
+    )
+
+
+def pipeline_aot_spec(axes: dict):
+    """``(jitted, args, kwargs)`` for one :func:`pipeline_megastep`
+    specialization (campaign axes: batch/capacity/rounds/m/max_liars/
+    unroll/collect_decisions/counters/data — single-device only; a
+    sharded signature, ``data > 1``, has no portable serialized form)."""
+    if axes.get("data", 1) != 1:
+        raise ValueError(
+            f"cannot AOT-cache a sharded specialization (data="
+            f"{axes.get('data')})"
+        )
+    S = jax.ShapeDtypeStruct
+    B, n, nr = axes["batch"], axes["capacity"], axes["rounds"]
+    kshape, kdtype = _key_data_spec()
+    sched = KeySchedule(key_data=S(kshape, kdtype), counter=S((), jnp.int32))
+    counters = (
+        S((len(COUNTER_NAMES),), jnp.int32) if axes["counters"] else None
+    )
+    return (
+        pipeline_megastep,
+        (_abstract_state(B, n), sched),
+        dict(
+            rounds=nr,
+            m=axes["m"],
+            max_liars=axes["max_liars"],
+            unroll=axes["unroll"],
+            collect_decisions=axes["collect_decisions"],
+            counters=counters,
+        ),
+    )
+
+
+def scenario_aot_spec(axes: dict):
+    """``(jitted, args, kwargs)`` for one :func:`scenario_megastep`
+    specialization (single-device, like :func:`pipeline_aot_spec`)."""
+    if axes.get("data", 1) != 1:
+        raise ValueError(
+            f"cannot AOT-cache a sharded specialization (data="
+            f"{axes.get('data')})"
+        )
+    S = jax.ShapeDtypeStruct
+    B, n, nr = axes["batch"], axes["capacity"], axes["rounds"]
+    kshape, kdtype = _key_data_spec()
+    sched = KeySchedule(key_data=S(kshape, kdtype), counter=S((), jnp.int32))
+    return (
+        scenario_megastep,
+        (
+            _abstract_state(B, n),
+            sched,
+            S((B, n), jnp.int8),
+            S((len(SCENARIO_COUNTER_NAMES),), jnp.int32),
+            _event_plane_specs(nr, B, n),
+        ),
+        dict(
+            rounds=nr,
+            m=axes["m"],
+            max_liars=axes["max_liars"],
+            unroll=axes["unroll"],
+            collect_decisions=axes["collect_decisions"],
+        ),
+    )
+
+
+# fn name -> builder; the names ARE the compile-signature/ledger fn
+# names, so the warmup pass can map ledger rows straight onto builders.
+AOT_SPECS = {
+    "coalesced_megastep": coalesced_aot_spec,
+    "pipeline_megastep": pipeline_aot_spec,
+    "scenario_megastep": scenario_aot_spec,
+}
+
+
+@contextlib.contextmanager
+def _dispatch_span(fn: str, axes: dict, warm: bool, **attrs):
+    """The dispatch site's span, in both temperatures (ISSUE 11).
+
+    A WARM dispatch (precompiled executable) is a plain ``dispatch``
+    span with ``warm=True`` — it deliberately never touches the jit
+    first-call classifier: an AOT executable does not populate jit's
+    cache, so marking the signature seen would make a LATER cache-less
+    jit dispatch of the same shape read as a cached ``dispatch`` while
+    paying a real, uncounted compile.  A cold dispatch classifies
+    through ``compile_or_dispatch_span`` exactly as before.  Yields the
+    phase name either way.
+    """
+    if warm:
+        with obs.default_tracer().span("dispatch", warm=True, **attrs):
+            yield "dispatch"
+    else:
+        with obs.compile_or_dispatch_span(fn, axes=axes, **attrs) as phase:
+            yield phase
+
+
+def _warm_call(exe_call, jit_call, executables, fn, axes, fell_back):
+    """Wrap a warm dispatch with its jit-path fallback: if the
+    precompiled executable ITSELF raises at call time, evict the entry
+    (quarantining its disk bytes for post-mortem) and run the jit path
+    — the cache's load-time degradation ladder extended to call time,
+    so one unusable entry costs one compile, never a bricked signature.
+
+    The fallback is safe exactly when the executable raised BEFORE
+    consuming the donated carry (argument-structure mismatches do —
+    they fail at host-side flattening); a post-donation device failure
+    makes the jit retry raise use-after-donate, which propagates as the
+    fault it is.  ``fell_back`` is a mutable list cell — the caller
+    counts a fallback as a request-path compile, not a warm dispatch.
+    """
+
+    def call():
+        try:
+            return exe_call()
+        except Exception:
+            executables.evict(fn, axes)
+            fell_back.append(fn)
+            return jit_call()
+
+    return call
+
+
 def _pipeline_instruments(reg):
     """The dispatch/retire discipline's instrument block — ONE creation
     site shared by the campaign loop and the coalesced serving loop
@@ -970,6 +1165,7 @@ def coalesced_sweep(  # ba-lint: donates(state)
     initial_strategy: jax.Array | None = None,
     exec_seam=None,
     on_retire=None,
+    executables=None,
 ):
     """Run a coalesced serving batch through the depth-k pipelined loop
     (ISSUE 10): B independent requests, one padded batch, bit-exact
@@ -990,6 +1186,15 @@ def coalesced_sweep(  # ba-lint: donates(state)
     fetch's host block — the slot→request mapping hook: the service
     streams per-request rows out as windows retire instead of waiting
     for the drain.
+
+    ``executables`` (ISSUE 11) is an ``obs.aotcache.ExecutableCache``
+    (anything with ``.get(fn, axes)``): the loop consults it BEFORE each
+    dispatch and, on a hit, dispatches the precompiled executable
+    instead of the jit path — bit-identical results (the AOT lowering is
+    the same program), zero compile on the request path.  A miss falls
+    back to the jit path exactly as before (compile-on-miss), counted in
+    ``stats["request_path_compiles"]``; warm dispatches count in
+    ``stats["warm_dispatches"]``.
 
     The batch gets a run_id (``BA_TPU_RUN_ID`` pin, else derived from
     the slot keys + rounds + event-plane content) carried EXPLICITLY on
@@ -1098,7 +1303,7 @@ def coalesced_sweep(  # ba-lint: donates(state)
         state, sched, strategy, counters, ev_planes, chunks,
         m=m, max_liars=max_liars, depth=depth, unroll=unroll,
         is_scenario=is_scenario, exec_seam=exec_seam,
-        on_retire=on_retire, run_id=rid,
+        on_retire=on_retire, run_id=rid, executables=executables,
     )
     out["counter_names"] = list(names)
     out["stats"]["run_id"] = rid
@@ -1108,7 +1313,7 @@ def coalesced_sweep(  # ba-lint: donates(state)
 def _coalesced_loop(
     state, sched, strategy, counters, ev_planes, chunks, *,
     m, max_liars, depth, unroll, is_scenario, exec_seam, on_retire,
-    run_id=None,
+    run_id=None, executables=None,
 ):
     """The coalesced driver's dispatch loop: the main engine's depth-k
     retire discipline, without scenario staging/checkpoint machinery
@@ -1125,6 +1330,8 @@ def _coalesced_loop(
     inflight: collections.deque = collections.deque()
     retired = []
     max_in_flight = 0
+    warm_dispatches = 0
+    request_path_compiles = 0
 
     def retire():
         d, ys, t_sub, lo, hi = inflight.popleft()
@@ -1165,20 +1372,48 @@ def _coalesced_loop(
                 # Async upload of this dispatch's plane slice; it
                 # queues behind the in-flight dispatches.
                 ev = {k: jnp.asarray(v[lo:hi]) for k, v in ev_planes.items()}
-        with obs.compile_or_dispatch_span(
-            "coalesced_megastep", axes=axes, dispatch=d, rounds=nr
-        ):
+        # Executable-cache consult (ISSUE 11): a hit dispatches the
+        # precompiled executable under a plain warm `dispatch` span
+        # (_dispatch_span documents why it skips the classifier); a
+        # miss is the jit path exactly as before.
+        exe = (
+            executables.get("coalesced_megastep", axes)
+            if executables is not None
+            else None
+        )
+        fell_back: list = []
+        with _dispatch_span(
+            "coalesced_megastep", axes, exe is not None,
+            dispatch=d, rounds=nr,
+        ) as phase:
             with obs.xla.annotate("coalesced_dispatch", dispatch=d):
-                call = functools.partial(
+                jit_call = functools.partial(
                     coalesced_megastep,
                     state, sched, strategy, counters, ev,
                     rounds=nr, m=m, max_liars=max_liars,
                     unroll=min(unroll, nr), scenario=is_scenario,
                 )
+                if exe is not None:
+                    # The executable's call takes only the traced
+                    # arguments (statics baked at lowering); a call-time
+                    # failure evicts + falls back to jit_call.
+                    call = _warm_call(
+                        functools.partial(
+                            exe, state, sched, strategy, counters, ev
+                        ),
+                        jit_call, executables,
+                        "coalesced_megastep", axes, fell_back,
+                    )
+                else:
+                    call = jit_call
                 if exec_seam is None:
                     out = call()
                 else:
                     out = exec_seam(call, "dispatch", d, lo, hi)
+        if exe is not None and not fell_back:
+            warm_dispatches += 1
+        elif phase == "compile" or fell_back:
+            request_path_compiles += 1
         round_base = hi
         t_sub = time.perf_counter_ns()
         disp_c.inc()
@@ -1209,6 +1444,8 @@ def _coalesced_loop(
             "dispatches": len(chunks),
             "depth": depth,
             "max_in_flight": max_in_flight,
+            "warm_dispatches": warm_dispatches,
+            "request_path_compiles": request_path_compiles,
         },
     }
     if is_scenario:
@@ -1335,6 +1572,7 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
     on_stall=None,
     on_rows=None,
     health_every: int | None = None,
+    executables=None,
 ):
     """Run ``rounds`` sweep rounds through the depth-k pipelined engine.
 
@@ -1482,6 +1720,15 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
       same retire: a supervisor can persist campaign history alongside
       each checkpoint and stitch a full bit-exact result across
       recoveries.
+
+    WARM EXECUTABLES (ISSUE 11, opt-in): ``executables`` (an
+    ``obs.aotcache.ExecutableCache``) is consulted before every
+    single-device dispatch; a precompiled specialization dispatches
+    without the jit path's first-call compile (bit-identical program —
+    the AOT lowering is the same trace).  Mesh dispatches ignore it (a
+    sharded executable has no portable serialized form).
+    ``stats["warm_dispatches"]`` / ``stats["request_path_compiles"]``
+    report the split.
 
     HEALTH SAMPLING (ISSUE 9): ``health_every=N`` takes one
     ``obs.health.HealthSampler`` sample every N dispatches, from the
@@ -1709,6 +1956,8 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
     retired = []  # (histograms, decisions|None) host tuples, dispatch order
     max_in_flight = 0
     retires_before_drain = 0
+    warm_dispatches = 0
+    request_path_compiles = 0
     n_checkpoints = 0
     n_stalls = 0
     plane_peak_bytes = 0
@@ -2024,6 +2273,18 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
             "data": n_shards,
             "scenario": scenario is not None,
         }
+        # Executable-cache consult (ISSUE 11, single-device only): a hit
+        # dispatches the precompiled executable under a plain warm
+        # `dispatch` span (_dispatch_span documents why it skips the
+        # classifier); a call-time failure evicts + falls back to jit.
+        exe = None
+        fell_back: list = []
+        if executables is not None and mesh is None:
+            exe = executables.get(
+                "scenario_megastep" if scenario is not None
+                else "pipeline_megastep",
+                axes,
+            )
         if scenario is not None:
             # This dispatch's event planes were staged one loop
             # iteration ago (chunk 0 before the loop): the upload is
@@ -2037,14 +2298,31 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
                 unroll=min(unroll, nr),
                 collect_decisions=collect_decisions,
             )
-            with obs.compile_or_dispatch_span(
-                "scenario_megastep", axes=axes, dispatch=d, rounds=nr
+            with _dispatch_span(
+                "scenario_megastep", axes, exe is not None,
+                dispatch=d, rounds=nr,
             ) as phase:
                 with obs.xla.annotate("megastep_dispatch", dispatch=d):
                     # functools.partial (not a lambda) binds the carry
                     # NOW: the seam may retry the zero-arg call, and the
                     # names `state`/`sched`/... rebind right below.
-                    if mesh is None:
+                    if exe is not None:
+                        # Statics were baked at AOT lowering: the
+                        # executable takes only the traced arguments;
+                        # a call-time failure evicts + falls back.
+                        call = _warm_call(
+                            functools.partial(
+                                exe, state, sched, strategy, counters, ev
+                            ),
+                            functools.partial(
+                                scenario_megastep,
+                                state, sched, strategy, counters, ev,
+                                **kwargs,
+                            ),
+                            executables, "scenario_megastep", axes,
+                            fell_back,
+                        )
+                    elif mesh is None:
                         call = functools.partial(
                             scenario_megastep,
                             state, sched, strategy, counters, ev,
@@ -2082,11 +2360,26 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
                 collect_decisions=collect_decisions,
                 counters=counters,
             )
-            with obs.compile_or_dispatch_span(
-                "pipeline_megastep", axes=axes, dispatch=d, rounds=nr
+            with _dispatch_span(
+                "pipeline_megastep", axes, exe is not None,
+                dispatch=d, rounds=nr,
             ) as phase:
                 with obs.xla.annotate("megastep_dispatch", dispatch=d):
-                    if mesh is None:
+                    if exe is not None:
+                        # Only `counters` of the kwargs is a traced
+                        # argument; the statics were baked at lowering.
+                        # A call-time failure evicts + falls back.
+                        call = _warm_call(
+                            functools.partial(
+                                exe, state, sched, counters=counters
+                            ),
+                            functools.partial(
+                                pipeline_megastep, state, sched, **kwargs
+                            ),
+                            executables, "pipeline_megastep", axes,
+                            fell_back,
+                        )
+                    elif mesh is None:
                         call = functools.partial(
                             pipeline_megastep, state, sched, **kwargs
                         )
@@ -2119,6 +2412,10 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
                     obs.xla.abstractify(kwargs),
                     axes=axes,
                 )
+        if exe is not None and not fell_back:
+            warm_dispatches += 1
+        elif phase == "compile" or fell_back:
+            request_path_compiles += 1
         round_base = hi
         t_sub = time.perf_counter_ns()
         disp_c.inc()
@@ -2217,6 +2514,8 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
             "rounds_per_dispatch": rounds_per_dispatch,
             "max_in_flight": max_in_flight,
             "retires_before_drain": retires_before_drain,
+            "warm_dispatches": warm_dispatches,
+            "request_path_compiles": request_path_compiles,
             "checkpoints": n_checkpoints,
             "stalls": n_stalls,
             "plane_peak_bytes": plane_peak_bytes,

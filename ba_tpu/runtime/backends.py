@@ -280,7 +280,8 @@ class JaxBackend:
         return [int(v) for v in np.asarray(maj[0, :n])]
 
     def run_rounds(
-        self, generals, leader_idx, order_code, seed, rounds, host_work=None
+        self, generals, leader_idx, order_code, seed, rounds,
+        host_work=None, executables=None,
     ):
         """``rounds`` agreement rounds through the pipelined sweep engine.
 
@@ -295,6 +296,10 @@ class JaxBackend:
         round's per-roster-general majorities (for the REPL's per-general
         block), each round's device quorum decision code, and the engine's
         dispatch stats — or None when the protocol cannot be pipelined.
+
+        ``executables`` (ISSUE 11, opt-in) is an
+        ``obs.aotcache.ExecutableCache`` consulted before each dispatch —
+        the campaign-side mirror of the serving dispatcher's warm path.
         """
         import os
 
@@ -333,6 +338,7 @@ class JaxBackend:
             collect_decisions=True,
             with_counters=True,
             host_work=host_work,
+            executables=executables,
         )
         # Per-general block for the LAST round: recompute it from the same
         # key schedule (counter = rounds - 1).  Bit-exact with what the
@@ -373,6 +379,7 @@ class JaxBackend:
         fault_plan=None,
         mesh=None,
         health_every=None,
+        executables=None,
     ):
         """A declarative scenario campaign on the B=1 interactive cluster.
 
@@ -466,6 +473,7 @@ class JaxBackend:
             checkpoint_keep_last=checkpoint_keep_last,
             mesh=mesh,
             health_every=health_every,
+            executables=executables,
         )
         if supervise:
             from ba_tpu.runtime.supervisor import supervised_sweep

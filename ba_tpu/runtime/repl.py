@@ -39,10 +39,14 @@ stay byte-identical):
 - ``serve start|stat|stop`` (ISSUE 10) — control a local
   agreement-as-a-service front-end (``runtime/serve.py``): ``start``
   spawns the continuous-batching dispatcher (``serve start queue=N
-  window=S batch=N`` override the ``BA_TPU_SERVE_*`` defaults),
-  ``stat`` prints the service's live stats block (tier, queue depth,
-  admitted/completed/rejected/expired/failed tallies), ``stop`` drains
-  and prints the final tallies.  Library/bench clients submit via
+  window=S batch=N warm=0|1`` override the ``BA_TPU_SERVE_*`` /
+  ``BA_TPU_WARM`` defaults; ``warm=1`` (ISSUE 11) launches the
+  background AOT warmup pass so dispatches hit precompiled
+  executables), ``stat`` prints the service's live stats block (tier,
+  queue depth, admitted/completed/rejected/expired/failed tallies,
+  plus — warm — warmup signatures warmed/pending and the
+  compile-on-miss count), ``stop`` drains and prints the final
+  tallies.  Library/bench clients submit via
   ``serve.AgreementService`` — the REPL command exists so one process
   can host the roster AND the service.
 - ``stats`` — dump the observability registry (``ba_tpu.obs``) as
@@ -280,7 +284,7 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
         args = [t for t in cmd[1:] if t]
         if not args or args[0] not in ("start", "stat", "stop"):
             out("serve error: usage: serve start [queue=N] [window=S] "
-                "[batch=N] | serve stat | serve stop")
+                "[batch=N] [warm=0|1] | serve stat | serve stop")
             return True
         from ba_tpu.runtime import serve as serve_mod
 
@@ -290,14 +294,18 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
                 out("serve error: already running (serve stop first)")
                 return True
             overrides = {}
+            # warm= casts through int so `warm=yes` is a one-line error
+            # like every other malformed option, then lands as a bool.
             names = {"queue": ("max_queue", int),
                      "window": ("coalesce_window_s", float),
-                     "batch": ("max_batch", int)}
+                     "batch": ("max_batch", int),
+                     "warm": ("warm", int)}
             for tok in args[1:]:
                 key, sep, val = tok.partition("=")
                 if not sep or key not in names:
                     out(f"serve error: unknown option {tok!r} (usage: "
-                        f"serve start [queue=N] [window=S] [batch=N])")
+                        f"serve start [queue=N] [window=S] [batch=N] "
+                        f"[warm=0|1])")
                     return True
                 field, cast = names[key]
                 try:
@@ -306,6 +314,8 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
                     out(f"serve error: {key}= wants a {cast.__name__}, "
                         f"got {val!r}")
                     return True
+            if "warm" in overrides:
+                overrides["warm"] = bool(overrides["warm"])
             try:
                 cfg = serve_mod.ServeConfig.from_env(**overrides)
             except ValueError as e:
@@ -318,7 +328,8 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             cluster._serve_service = svc
             out(f"serve: started (queue={cfg.max_queue}, "
                 f"window={cfg.coalesce_window_s}s, "
-                f"batch={cfg.max_batch})")
+                f"batch={cfg.max_batch}"
+                + (", warm" if cfg.warm else "") + ")")
         elif svc is None:
             out("serve error: not running (serve start first)")
         elif args[0] == "stat":

@@ -53,9 +53,23 @@ validation and client shaping run on hosts without jax; the engine is
 reached lazily from the dispatcher thread (``_execute``), exactly the
 ``runtime/backends.py`` discipline.
 
+- **Warm serving** (ISSUE 11).  ``warm=True`` (``BA_TPU_WARM=1``)
+  launches a background AOT warmup pass at ``open()``
+  (``runtime/warmup.py``): the cross-run ledger's signature set plus
+  the cohort-key bucket lattice compile into the persistent executable
+  cache (``obs/aotcache.py``, ``BA_TPU_AOT_CACHE``) off the request
+  path, health-gated so warmup never sheds live traffic.  The
+  dispatcher consults the cache before every cohort dispatch; a warm
+  service's ``serve_compile_on_request_path_total`` stays 0 after the
+  :meth:`AgreementService.warm_barrier` — the measured acceptance
+  boolean — while an unwarmed cohort still serves via compile-on-miss
+  (counted in ``serve_warmup_miss_total``).
+
 Environment: ``BA_TPU_SERVE_BATCH`` / ``BA_TPU_SERVE_QUEUE`` /
 ``BA_TPU_SERVE_WINDOW_S`` / ``BA_TPU_SERVE_DEADLINE_S`` /
-``BA_TPU_SERVE_RETRIES`` override :meth:`ServeConfig.from_env`.
+``BA_TPU_SERVE_RETRIES`` / ``BA_TPU_WARM`` override
+:meth:`ServeConfig.from_env`; ``BA_TPU_AOT_CACHE`` places (or
+disables) the executable-cache directory.
 """
 
 from __future__ import annotations
@@ -158,6 +172,18 @@ class ServeConfig:
     #                                 supervisor.derive_timeout_s
     #                                 (BA_TPU_SUPERVISE_TIMEOUT_S pin,
     #                                 30 s floor)
+    warm: bool = False             # ISSUE 11: background AOT warmup at
+    #                                 open() + warm executable dispatch
+    warm_capacities: tuple = (4,)  # capacity buckets the lattice warms
+    warm_rounds: int | None = None  # expected request rounds (warms the
+    #                                 ragged remainder window too)
+    warm_scenarios: bool = True    # also warm scenario-cohort
+    #                                 specializations (kind="scenario"
+    #                                 is first-class traffic; False
+    #                                 halves warmup wall when the fleet
+    #                                 is known interactive-only)
+    aot_cache: str | None = None   # executable-cache dir; None = the
+    #                                 BA_TPU_AOT_CACHE / default dir
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -192,6 +218,15 @@ class ServeConfig:
                 f"dispatch_timeout_s={self.dispatch_timeout_s} "
                 f"must be > 0"
             )
+        if self.warm_rounds is not None and self.warm_rounds < 1:
+            raise ValueError(
+                f"warm_rounds={self.warm_rounds} must be >= 1"
+            )
+        for cap in self.warm_capacities:
+            if not isinstance(cap, int) or isinstance(cap, bool) or cap < 1:
+                raise ValueError(
+                    f"warm_capacities entry {cap!r} must be an int >= 1"
+                )
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -207,6 +242,8 @@ class ServeConfig:
         if "BA_TPU_SERVE_DEADLINE_S" in os.environ:
             raw = os.environ["BA_TPU_SERVE_DEADLINE_S"]
             env["default_deadline_s"] = None if raw == "" else float(raw)
+        if "BA_TPU_WARM" in os.environ:
+            env["warm"] = os.environ["BA_TPU_WARM"] not in ("", "0")
         env.update(overrides)
         return cls(**env)
 
@@ -416,6 +453,32 @@ class AgreementService:
         )
         self._wedged = False
         self._stalls_c = self._reg.counter("serve_stalls_total")
+        # Warm-serving stack (ISSUE 11): the executable cache the
+        # dispatcher consults before every cohort dispatch, and the
+        # background warmup runner open() starts.  The cache exists
+        # whenever warmup is on OR an explicit cache dir is configured
+        # (BA_TPU_AOT_CACHE / aot_cache) — a cold-configured service
+        # keeps the exact pre-ISSUE-11 dispatch path.
+        self._exec_cache = None
+        self._warmup = None
+        cache_env = os.environ.get(obs.aotcache.CACHE_ENV, "")
+        if self._cfg.warm or self._cfg.aot_cache or cache_env not in (
+            "", "0"
+        ):
+            self._exec_cache = obs.aotcache.ExecutableCache(
+                directory=self._cfg.aot_cache
+            )
+        self._compile_rp_c = self._reg.counter(
+            "serve_compile_on_request_path_total"
+        )
+        self._warm_miss_c = self._reg.counter("serve_warmup_miss_total")
+        # Instance-local tallies for stats(): registry counters are
+        # shared by every service on the registry (the documented
+        # one-process roster+service mode), and "did THIS service
+        # compile on its request path" must not blend another
+        # service's history in.
+        self._rpc_n = 0
+        self._warm_miss_n = 0
         injector = fault_plan
         if injector is not None and not hasattr(injector, "fire"):
             from ba_tpu.runtime.chaos import ChaosInjector
@@ -443,10 +506,35 @@ class AgreementService:
     # -- lifecycle ----------------------------------------------------------
 
     def open(self) -> None:
-        """Open ADMISSION without the dispatcher (see class docstring)."""
+        """Open ADMISSION without the dispatcher (see class docstring).
+        With ``warm`` configured (ISSUE 11) this also launches the
+        background warmup runner — admission never waits on it; callers
+        that want the warm guarantee block on :meth:`warm_barrier`."""
         with self._cond:
             self._open = True
         self._sampler.prime()
+        if self._cfg.warm and self._warmup is None:
+            from ba_tpu.runtime import warmup as warmup_mod
+
+            self._warmup = warmup_mod.WarmupRunner(
+                self._exec_cache,
+                warmup_mod.service_plan(self._cfg),
+                # Health gate: the shed-tier view (derived from the
+                # obs/health pressure sampler) — warmup compiles only
+                # while the service reads healthy, so it can never be
+                # the thing that sheds live traffic.
+                gate=lambda: self._tier == 0 and not self._wedged,
+                registry=self._reg,
+            )
+            self._warmup.start()
+
+    def warm_barrier(self, timeout: float | None = None) -> bool:
+        """Block until the warmup pass attempted every planned
+        signature (True; False on timeout).  A service without warmup
+        is trivially warm."""
+        if self._warmup is None:
+            return True
+        return self._warmup.wait(timeout)
 
     def start(self) -> None:
         self.open()
@@ -464,6 +552,10 @@ class AgreementService:
             self._open = False
             self._drain = drain
             self._cond.notify_all()
+        if self._warmup is not None:
+            # Wind the background compiler down with the service; the
+            # daemon thread finishes its in-flight compile and exits.
+            self._warmup.stop()
         if self._thread is not None:
             self._thread.join(timeout)
         # Whatever is left (no dispatcher ever ran, or drain=False):
@@ -928,7 +1020,22 @@ class AgreementService:
             rounds_per_dispatch=self._cfg.rounds_per_dispatch,
             scenario=planes,
             exec_seam=self._seam,
+            executables=self._exec_cache,
         )
+        # Warm-serving accounting (ISSUE 11): every dispatch window that
+        # compiled ON the request path is a counted event — the "warm
+        # service never compiles on the request path" acceptance boolean
+        # is `serve_compile_on_request_path_total == 0` after the warm
+        # barrier, measured, not hoped.  With the cache active the same
+        # count is the compile-on-miss fallback tally (an unwarmed
+        # cohort's first request still served — it just paid a compile).
+        rpc = out["stats"].get("request_path_compiles", 0)
+        if rpc:
+            self._compile_rp_c.inc(rpc)
+            self._rpc_n += rpc
+            if self._exec_cache is not None:
+                self._warm_miss_c.inc(rpc)
+                self._warm_miss_n += rpc
         results = []
         for i, t in enumerate(live):
             dec = out["decisions"][:, i]
@@ -991,7 +1098,7 @@ class AgreementService:
     def stats(self) -> dict:
         with self._cond:
             depth = len(self._queue)
-        return {
+        out = {
             "open": self._open,
             "running": self.running(),
             "tier": self._tier,
@@ -1015,4 +1122,17 @@ class AgreementService:
                 if self._injector is not None
                 else 0
             ),
+            "compiles_on_request_path": self._rpc_n,
+            "warm": self._cfg.warm,
         }
+        if self._warmup is not None:
+            prog = self._warmup.progress()
+            out.update(
+                warmup_planned=prog["planned"],
+                warmup_warmed=prog["warmed"],
+                warmup_pending=prog["pending"],
+                warmup_errors=prog["errors"],
+                warmup_done=prog["done"],
+                warmup_misses=self._warm_miss_n,
+            )
+        return out

@@ -1,0 +1,375 @@
+"""AOT specialization warmup: compile the serving path's executables
+BEFORE traffic arrives (ISSUE 11 tentpole, piece 2).
+
+The executable cache (``obs/aotcache.py``) can make any megastep
+specialization warm — this module decides WHICH, and WHEN:
+
+- **What** (:func:`service_plan`): the union of two sets, deduped —
+
+  1. the **cross-run axes ledger**'s signature set
+     (``obs/instrument.ledger_signatures``): every compile signature
+     real traffic reached in previous processes, filtered to rows this
+     toolchain can reproduce (the env axes ARE part of the signature —
+     a stale-jaxlib row is unreproducible by construction) and to fns
+     with registered builders (``parallel.pipeline.AOT_SPECS``);
+  2. the **cohort-key bucket lattice** (:func:`bucket_lattice`): the
+     serving front-end buckets rosters to power-of-two capacities and
+     cohorts to power-of-two batch slots (``runtime/serve.py``), and
+     every cohort dispatches in ``rounds_per_dispatch`` windows — so
+     the reachable specialization space is finite and enumerable even
+     on a first-ever boot with an empty ledger.
+
+- **When**: in a BACKGROUND daemon thread (:class:`WarmupRunner`),
+  started by ``AgreementService.open()`` — admission and dispatch never
+  wait on it.  An unwarmed cohort's first request still works: the
+  engine compiles on miss exactly as before, and the service counts it
+  (``serve_compile_on_request_path_total``).  The runner is
+  **health-gated**: before each compile it polls its ``gate()``
+  (the service passes its shed-tier view, itself derived from the
+  ``obs/health.py`` sampler; standalone callers can use
+  :func:`health_gate`) and PAUSES while the gate reads pressure — a
+  warmup must never shed or delay live traffic, which the
+  warmup-never-sheds test pins.
+
+Every signature emits one ``{"event": "warmup", "v": 1}`` record
+(phases ``start`` / ``signature`` / ``done``), stamped with a
+deterministic per-pass ``run_id`` (sha over the plan), and the
+``serve_warmup_*`` instrument family tracks progress (the REPL's
+``serve stat`` prints it).
+
+HOST-TIER BY LINT CONTRACT (ba-lint BA301, mutation-checked like
+serve): this module's MODULE-LEVEL import closure never reaches
+``ba_tpu.core``/``ba_tpu.ops`` — plan construction runs jax-free; the
+builders (which need the jitted trees) are imported lazily from the
+runner thread.
+
+``BA_TPU_WARM=1`` turns the service's warmup on
+(``ServeConfig.from_env``); ``BA_TPU_AOT_CACHE`` places (or disables)
+the persistent entry directory (``obs/aotcache.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ba_tpu import obs
+from ba_tpu.utils import metrics as _metrics
+
+WARM_ENV = "BA_TPU_WARM"
+
+# The fns the warmup pass knows how to rebuild from a ledger row — the
+# keys of ``parallel.pipeline.AOT_SPECS``, spelled here so plan
+# construction stays jax-free (a drifted name simply never matches a
+# ledger row; the builder lookup below would raise loudly on a plan
+# that names an unknown fn).
+WARM_FNS = ("coalesced_megastep", "pipeline_megastep", "scenario_megastep")
+
+
+def builder_for(fn: str):
+    """The axes -> (jitted, abstract args, kwargs) builder for ``fn``
+    (lazy: the builders live with the jitted trees in
+    ``parallel/pipeline.py``)."""
+    if fn not in WARM_FNS:
+        raise ValueError(f"no AOT builder for fn {fn!r} (know {WARM_FNS})")
+
+    def build(axes: dict):
+        from ba_tpu.parallel import pipeline
+
+        return pipeline.AOT_SPECS[fn](axes)
+
+    return build
+
+
+def _axes_key(fn: str, axes: dict) -> str:
+    return fn + ":" + json.dumps(axes, sort_keys=True, default=str)
+
+
+def bucket_lattice(
+    max_batch: int,
+    rounds_per_dispatch: int,
+    *,
+    capacities=(4,),
+    rounds: int | None = None,
+    m: int = 1,
+    scenarios=(False,),
+) -> list:
+    """The serving dispatcher's reachable coalesced specializations:
+    ``(fn, axes)`` pairs over every power-of-two batch bucket up to the
+    config's bucketed ``max_batch``, each capacity bucket, and each
+    dispatch-window size.
+
+    Windows are ``rounds_per_dispatch`` plus — when ``rounds`` names the
+    expected request length — the clipped first window and the ragged
+    remainder (``rounds % rounds_per_dispatch``), the exact chunking
+    ``coalesced_sweep`` performs.  Without a ``rounds`` hint only the
+    steady-state window warms; a cohort with a ragged tail then pays one
+    counted compile-on-miss for its remainder window.
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch={max_batch} must be >= 1")
+    if rounds_per_dispatch < 1:
+        raise ValueError(
+            f"rounds_per_dispatch={rounds_per_dispatch} must be >= 1"
+        )
+    buckets = [1]
+    while buckets[-1] < max_batch:
+        buckets.append(buckets[-1] * 2)
+    windows = {rounds_per_dispatch}
+    if rounds is not None:
+        if rounds < 1:
+            raise ValueError(f"rounds={rounds} must be >= 1")
+        windows.add(min(rounds, rounds_per_dispatch))
+        if rounds % rounds_per_dispatch:
+            windows.add(rounds % rounds_per_dispatch)
+    plan = []
+    for scenario in scenarios:
+        for cap in capacities:
+            if cap < 1:
+                raise ValueError(f"capacity {cap} must be >= 1")
+            for batch in buckets:
+                for window in sorted(windows):
+                    plan.append(
+                        (
+                            "coalesced_megastep",
+                            {
+                                "batch": batch,
+                                "capacity": cap,
+                                "rounds": window,
+                                "m": m,
+                                "max_liars": None,
+                                # Literal 1 = coalesced_sweep's unroll
+                                # default (serve never overrides it); if
+                                # serving ever grows an unroll dial this
+                                # must track min(unroll, window) or warm
+                                # lookups silently stop matching.
+                                "unroll": 1,
+                                "scenario": bool(scenario),
+                            },
+                        )
+                    )
+    return plan
+
+
+def ledger_replay_set(fns=WARM_FNS) -> list:
+    """Warmable ``(fn, axes)`` pairs out of the cross-run axes ledger:
+    rows of known megastep fns whose env axes match THIS process's
+    toolchain (a mismatched row cannot be reproduced — the versions are
+    part of the signature), with the env axes and the ``run_id``
+    provenance rider stripped back off into the engine's axes dict.
+    Sharded rows (``data > 1``) are skipped: a sharded executable has no
+    portable serialized form (``pipeline_aot_spec`` documents it).
+    Empty when no ledger is configured."""
+    from ba_tpu.obs import instrument
+
+    env = instrument.ledger_env_axes()
+    out = []
+    for fn, sigs in instrument.ledger_signatures().items():
+        if fn not in fns:
+            continue
+        for sig in sigs:
+            core = {k: v for k, v in sig.items() if k != "run_id"}
+            if env and any(core.get(k) != v for k, v in env.items()):
+                continue
+            axes = {k: v for k, v in core.items() if k not in env}
+            if axes.get("data", 1) != 1:
+                continue
+            out.append((fn, axes))
+    return out
+
+
+def service_plan(config) -> list:
+    """The ``AgreementService`` warmup plan: ledger replay ∪ cohort
+    lattice, deduped in that order (real traffic's signatures first —
+    they are the ones most likely to be asked for again).  The lattice
+    covers BOTH scenario-nesses by default (``kind="scenario"`` is
+    first-class traffic — the shed ladder even privileges it);
+    ``warm_scenarios=False`` halves the pass for interactive-only
+    fleets."""
+    plan = ledger_replay_set()
+    plan += bucket_lattice(
+        config.max_batch,
+        config.rounds_per_dispatch,
+        capacities=config.warm_capacities,
+        rounds=config.warm_rounds,
+        m=config.m,
+        scenarios=(False, True) if config.warm_scenarios else (False,),
+    )
+    seen: set = set()
+    deduped = []
+    for fn, axes in plan:
+        key = _axes_key(fn, axes)
+        if key not in seen:
+            seen.add(key)
+            deduped.append((fn, axes))
+    return deduped
+
+
+def health_gate(max_occupancy: float | None = None, registry=None):
+    """A standalone warmup gate off the live health view
+    (``obs/health.py``): True while the engine's depth-occupancy window
+    reads idle (None) or below ``max_occupancy`` (default 1.0 — any
+    steadily-occupied pipeline defers warmup).  The serving front-end
+    uses its shed-tier view instead (same sampler underneath); this
+    exists for campaign-side callers warming ``pipeline_sweep``
+    specializations next to live work."""
+    limit = 1.0 if max_occupancy is None else max_occupancy
+    sampler = obs.health.HealthSampler(registry)
+    sampler.prime()
+
+    def gate() -> bool:
+        occ = sampler.sample().get("depth_occupancy")
+        return occ is None or occ < limit
+
+    return gate
+
+
+class WarmupRunner:
+    """The background warmup thread: replay ``plan`` (``(fn, axes)``
+    pairs) through ``cache.ensure``, health-gated, observable.
+
+    - ``gate()`` (optional): polled before each compile; False pauses
+      (``pause_s`` between polls) until it reads True or the runner is
+      stopped — live traffic always wins the processor.
+    - :meth:`wait` is the WARM BARRIER: block until every planned
+      signature was attempted (warmed or errored).
+    - Per-signature failures are counted and emitted, never raised: a
+      builder a future axes shape confuses must cost one cold compile
+      later, not the warmup pass.
+    """
+
+    def __init__(
+        self,
+        cache,
+        plan,
+        *,
+        gate=None,
+        registry=None,
+        run_id: str | None = None,
+        pause_s: float = 0.02,
+    ):
+        self._cache = cache
+        self._plan = list(plan)
+        self._gate = gate
+        self._pause_s = pause_s
+        self._reg = registry if registry is not None else (
+            obs.default_registry()
+        )
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.warmed = 0
+        self.errors = 0
+        self.loaded = 0
+        self.compiled = 0
+        # Deterministic per-pass id (the plan IS the identity): warmup
+        # records of the same service config correlate across restarts.
+        self.run_id = run_id or obs.flight.derive_run_id(
+            "warmup", *[_axes_key(fn, axes) for fn, axes in self._plan]
+        )
+        # serve_ prefix per the registry's service-metric rule: these
+        # ARE the serving dashboard's warmup block.
+        self._reg.gauge("serve_warmup_signatures").set(len(self._plan))
+        self._reg.gauge("serve_warmup_pending").set(len(self._plan))
+        self._warmed_c = self._reg.counter("serve_warmup_warmed_total")
+        self._errors_c = self._reg.counter("serve_warmup_errors_total")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="ba-tpu-warmup", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        """Ask the runner to wind down (it finishes the in-flight
+        compile — an XLA compile is not interruptible — then exits)."""
+        self._stop.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """The warm barrier: True once every planned signature was
+        attempted (False on timeout)."""
+        return self._done.wait(timeout)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def progress(self) -> dict:
+        return {
+            "planned": len(self._plan),
+            "warmed": self.warmed,
+            "pending": len(self._plan) - self.warmed - self.errors,
+            "errors": self.errors,
+            "loaded": self.loaded,
+            "compiled": self.compiled,
+            "done": self.done(),
+        }
+
+    # -- the runner thread ---------------------------------------------------
+
+    def _emit(self, phase: str, **fields) -> None:
+        _metrics.emit(
+            {
+                "event": "warmup",
+                "v": _metrics.SCHEMA_VERSION,
+                "phase": phase,
+                "run_id": self.run_id,
+                **fields,
+            }
+        )
+
+    def _run(self) -> None:
+        t0 = time.perf_counter()
+        self._emit("start", planned=len(self._plan))
+        obs.instant("warmup_start", planned=len(self._plan))
+        for fn, axes in self._plan:
+            if self._stop.is_set():
+                break
+            # The health gate: pause (never abandon) while live traffic
+            # holds pressure — tier decay or an idle queue resumes us.
+            while self._gate is not None and not self._gate():
+                if self._stop.wait(self._pause_s):
+                    break
+            if self._stop.is_set():
+                break
+            try:
+                info = self._cache.ensure(fn, axes, builder_for(fn))
+            except Exception as e:
+                self.errors += 1
+                self._errors_c.inc()
+                self._emit(
+                    "signature", fn=fn, axes=dict(axes), status="error",
+                    error=f"{type(e).__name__}: {e}",
+                )
+            else:
+                self.warmed += 1
+                self._warmed_c.inc()
+                if info["status"] == "loaded":
+                    self.loaded += 1
+                elif info["status"] == "compiled":
+                    self.compiled += 1
+                self._emit(
+                    "signature", fn=fn, axes=dict(axes),
+                    status=info["status"],
+                    wall_s=round(info.get("wall_s", 0.0), 6),
+                )
+            self._reg.gauge("serve_warmup_pending").set(
+                len(self._plan) - self.warmed - self.errors
+            )
+        self._emit(
+            "done",
+            planned=len(self._plan),
+            warmed=self.warmed,
+            loaded=self.loaded,
+            compiled=self.compiled,
+            errors=self.errors,
+            stopped=self._stop.is_set(),
+            wall_s=round(time.perf_counter() - t0, 6),
+        )
+        obs.instant(
+            "warmup_done", warmed=self.warmed, errors=self.errors
+        )
+        self._done.set()

@@ -54,7 +54,8 @@ EXCLUDE = {"BENCH_trajectory.json", "BENCH_detail.json"}
 
 _RATIO_KEY = re.compile(r"(speedup|_ratio|ratio_|overhead_frac|overhead_pct)")
 _ACCEPT_KEY = re.compile(
-    r"(within|bounded|bit_exact|_ok$|^ok$|recovery_within)"
+    r"(within|bounded|bit_exact|_ok$|^ok$|recovery_within"
+    r"|no_request_path_compiles)"  # ISSUE 11: the warm-serving boolean
 )
 
 

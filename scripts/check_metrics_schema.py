@@ -16,8 +16,11 @@ and assembled ``flight_summary`` emitters — plus (ISSUE 10) a short
 deterministic SERVE session (queue-full rejection, shed-tier
 transition, deadline expiry, two served cohorts) driving the real
 ``request``/``admission``/``shed`` emitters and the ``serve_*`` gauge
-family (prefix-rule-checked) — into a temp sink, then validates every
-line, including the typed shape of the device-tier, resilience, flight
+family (prefix-rule-checked) — plus (ISSUE 11) a WARM serve session
+(background AOT warmup → warm barrier → one warm-dispatched request,
+``compiles_on_request_path`` asserted 0) driving the real ``warmup``
+record emitters (run_id-stamped) and the ``serve_warmup_*`` gauges —
+into a temp sink, then validates every line, including the typed shape of the device-tier, resilience, flight
 and serving records, and the presence/shape of ``run_id`` on every
 record family that carries it.  Run by ``scripts/ci.sh`` before
 the tier-1 suite; standalone: ``JAX_PLATFORMS=cpu python
@@ -173,6 +176,42 @@ def main() -> int:
         except Exception as e:
             if type(e).__name__ != "DeadlineExceeded":
                 raise
+        # Warm-serving records (ISSUE 11): a WARM serve session — open()
+        # launches the background AOT warmup (one planned signature:
+        # max_batch=1, one window), the warm barrier drains it, and one
+        # request then dispatches off the precompiled executable —
+        # driving the real warmup start/signature/done emitters (run_id
+        # stamped on every one) and the serve_warmup_* gauge family the
+        # final snapshot must carry.  The executable cache persists into
+        # a temp dir so this check never touches user cache state.
+        import tempfile as _tempfile
+
+        with _tempfile.TemporaryDirectory() as aot_dir:
+            warm_svc = AgreementService(
+                ServeConfig(
+                    max_batch=1, max_queue=4, coalesce_window_s=0.001,
+                    rounds_per_dispatch=2, warm=True, warm_rounds=2,
+                    aot_cache=aot_dir,
+                )
+            )
+            warm_svc.open()
+            if not warm_svc.warm_barrier(timeout=300):
+                print("schema check: warm barrier timed out",
+                      file=sys.stderr)
+                return 1
+            warm_svc.start()
+            warm_svc.submit(
+                AgreementRequest(kind="run-rounds", n=4, seed=5, rounds=2)
+            ).result(timeout=300)
+            warm_stats = warm_svc.stats()
+            warm_svc.stop()
+        if warm_stats["compiles_on_request_path"] != 0:
+            print(
+                f"schema check: warm service compiled on the request "
+                f"path ({warm_stats['compiles_on_request_path']}x)",
+                file=sys.stderr,
+            )
+            return 1
 
         obs.default_registry().emit_snapshot(sink=sink, source="ci-check")
         sink.close()
@@ -437,6 +476,37 @@ def main() -> int:
                         file=sys.stderr,
                     )
                     bad += 1
+            elif rec.get("event") == "warmup":
+                # Warm-serving records (ISSUE 11): every phase carries
+                # the warmup pass's deterministic run_id; signature
+                # rows name their fn/axes and a known status.
+                ok_shape = (
+                    rec.get("phase") in ("start", "signature", "done")
+                    and _flight.valid_run_id(rec.get("run_id"))
+                )
+                if ok_shape and rec["phase"] == "start":
+                    ok_shape = isinstance(rec.get("planned"), int)
+                if ok_shape and rec["phase"] == "signature":
+                    ok_shape = (
+                        isinstance(rec.get("fn"), str)
+                        and isinstance(rec.get("axes"), dict)
+                        and rec.get("status")
+                        in ("compiled", "loaded", "cached", "error")
+                    )
+                if ok_shape and rec["phase"] == "done":
+                    ok_shape = (
+                        isinstance(rec.get("planned"), int)
+                        and isinstance(rec.get("warmed"), int)
+                        and isinstance(rec.get("errors"), int)
+                        and isinstance(rec.get("wall_s"), (int, float))
+                    )
+                if not ok_shape:
+                    print(
+                        f"schema check: line {i} malformed warmup: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
             elif rec.get("event") == "metrics_snapshot":
                 # Shard-labeled gauges (ISSUE 8): the engine stamps the
                 # device count and per-device carry/plane byte shares
@@ -461,6 +531,13 @@ def main() -> int:
                     "serve_queue_depth",
                     "serve_shed_tier",
                     "serve_window_s",
+                    # Warm-serving family (ISSUE 11): the warm session
+                    # above must have left its warmup gauges and the
+                    # request-path compile counter behind.
+                    "serve_warmup_signatures",
+                    "serve_warmup_pending",
+                    "serve_warmup_warmed_total",
+                    "serve_compile_on_request_path_total",
                 ):
                     snap = metrics_blk.get(g)
                     if not (
@@ -503,6 +580,7 @@ def main() -> int:
             "request",
             "admission",
             "shed",
+            "warmup",
         }
         if not want <= events:
             print(

@@ -160,6 +160,17 @@ mutate_and_expect BA301 runtime/serve.py \
     'from ba_tpu.core import om as _mut_core' || exit 1
 mutate_and_expect BA301 runtime/serve.py \
     'from ba_tpu.parallel import pipeline as _mut_engine' || exit 1
+# ISSUE 11: the warmup pass joined the module-level host-tier scope
+# (plan construction runs jax-free; AOT builders load lazily from the
+# runner thread), and the executable cache is an obs module — the
+# STRICTER obs rule covers even function-local core imports there.
+# Prove both extensions are live.
+mutate_and_expect BA301 runtime/warmup.py \
+    'from ba_tpu.core import om as _mut_core' || exit 1
+mutate_and_expect BA301 runtime/warmup.py \
+    'from ba_tpu.parallel import pipeline as _mut_engine' || exit 1
+mutate_and_expect BA301 obs/aotcache.py \
+    'from ba_tpu.core import om as _mut_core' || exit 1
 
 echo "== scenario spec round-trip =="
 # ISSUE 5: the committed campaign specs must load, validate, round-trip

@@ -1,0 +1,574 @@
+"""Warm-serving stack tests (ISSUE 11): the persistent executable cache
+(``obs/aotcache.py``), the background warmup pass
+(``runtime/warmup.py``), and the dispatcher integration.
+
+The contract under test, layer by layer:
+
+- warm-vs-cold BIT-EXACTNESS: a dispatch served from a precompiled
+  (or deserialized) executable is bit-identical to the jit path —
+  through the engine directly and through the service;
+- every degradation path reaches a fresh compile: cold miss (counted),
+  signature/version mismatch (eager invalidation, never a stale load),
+  corrupt entry (``.corrupt`` quarantine, the snapshot.py discipline);
+- the warmup thread is background + health-gated: it never sheds or
+  delays live traffic, and a gate reading pressure pauses it;
+- the new host-tier modules import jax-free (the BA301 contract,
+  runtime-proven).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import jax.random as jr  # noqa: E402
+
+from ba_tpu import obs  # noqa: E402
+from ba_tpu.core.state import SimState  # noqa: E402
+from ba_tpu.core.types import COMMAND_DTYPE  # noqa: E402
+from ba_tpu.obs import aotcache  # noqa: E402
+from ba_tpu.obs.registry import MetricsRegistry  # noqa: E402
+from ba_tpu.parallel.pipeline import (  # noqa: E402
+    AOT_SPECS,
+    coalesced_sweep,
+    fresh_copy,
+    pipeline_sweep,
+)
+from ba_tpu.runtime import warmup  # noqa: E402
+from ba_tpu.runtime.serve import (  # noqa: E402
+    AgreementRequest,
+    AgreementService,
+    ServeConfig,
+)
+
+B, CAP, ROUNDS, RPD = 2, 4, 8, 4
+
+COALESCED_AXES = {
+    "batch": B, "capacity": CAP, "rounds": RPD, "m": 1,
+    "max_liars": None, "unroll": 1, "scenario": False,
+}
+
+
+def mkstate(batch=B, cap=CAP):
+    faulty = np.zeros((batch, cap), np.bool_)
+    alive = np.ones((batch, cap), np.bool_)
+    faulty[0, 2] = True
+    return fresh_copy(
+        SimState(
+            order=jnp.asarray(
+                (np.arange(batch) % 2).astype(COMMAND_DTYPE)
+            ),
+            leader=jnp.zeros((batch,), jnp.int32),
+            faulty=jnp.asarray(faulty),
+            alive=jnp.asarray(alive),
+            ids=jnp.asarray(
+                np.tile(np.arange(1, cap + 1, dtype=np.int32), (batch, 1))
+            ),
+        )
+    )
+
+
+def slot_keys(batch=B):
+    return [jr.key(100 + i) for i in range(batch)]
+
+
+@pytest.fixture(scope="module")
+def warm_dir(tmp_path_factory):
+    """One ensured coalesced entry, shared by the read-path tests (a
+    fresh AOT compile per test would dominate the suite's budget)."""
+    d = str(tmp_path_factory.mktemp("aot"))
+    cache = aotcache.ExecutableCache(d)
+    info = cache.ensure(
+        "coalesced_megastep", COALESCED_AXES,
+        AOT_SPECS["coalesced_megastep"],
+    )
+    assert info["status"] == "compiled"
+    # Donation-alias evidence harvested at compile time (the loaded
+    # executable's own memory stats are empty — the documented trap).
+    assert info["alias_bytes"] > 0
+    return d
+
+
+# -- bit-exactness through the engine ----------------------------------------
+
+
+def test_warm_vs_cold_bit_exact(warm_dir):
+    ref = coalesced_sweep(
+        slot_keys(), mkstate(), ROUNDS, rounds_per_dispatch=RPD
+    )
+    cache = aotcache.ExecutableCache(warm_dir)
+    warm = coalesced_sweep(
+        slot_keys(), mkstate(), ROUNDS, rounds_per_dispatch=RPD,
+        executables=cache,
+    )
+    np.testing.assert_array_equal(warm["decisions"], ref["decisions"])
+    np.testing.assert_array_equal(warm["majorities"], ref["majorities"])
+    np.testing.assert_array_equal(warm["counters"], ref["counters"])
+    assert warm["stats"]["warm_dispatches"] == warm["stats"]["dispatches"]
+    assert warm["stats"]["request_path_compiles"] == 0
+    # The entry came off DISK in this cache instance — the persistence
+    # leg of the bit-exactness pin, not just the in-process memo.
+    assert cache.counts["loads"] == 1
+
+
+def test_cold_miss_falls_back_and_counts(tmp_path):
+    cache = aotcache.ExecutableCache(str(tmp_path / "empty"))
+    obs.reset_first_calls()
+    ref = coalesced_sweep(
+        slot_keys(), mkstate(), ROUNDS, rounds_per_dispatch=RPD
+    )
+    obs.reset_first_calls()
+    out = coalesced_sweep(
+        slot_keys(), mkstate(), ROUNDS, rounds_per_dispatch=RPD,
+        executables=cache,
+    )
+    # Served correctly through the jit fallback...
+    np.testing.assert_array_equal(out["decisions"], ref["decisions"])
+    np.testing.assert_array_equal(out["counters"], ref["counters"])
+    # ...and the misses/compiles are COUNTED, not silent.
+    assert out["stats"]["warm_dispatches"] == 0
+    assert out["stats"]["request_path_compiles"] >= 1
+    assert cache.counts["misses"] >= 1
+
+
+def test_signature_mismatch_invalidates_and_recompiles(tmp_path):
+    d = str(tmp_path)
+    cache = aotcache.ExecutableCache(d)
+    cache.ensure(
+        "coalesced_megastep", COALESCED_AXES,
+        AOT_SPECS["coalesced_megastep"],
+    )
+    path = aotcache.entry_path(d, "coalesced_megastep", COALESCED_AXES)
+    # Tamper the stored jaxlib version — the stale-toolchain scenario.
+    with open(path, "rb") as fh:
+        data = fh.read()
+    off = len(aotcache._MAGIC)
+    (hlen,) = struct.unpack(">I", data[off:off + 4])
+    header = json.loads(data[off + 4:off + 4 + hlen])
+    header["signature"]["jaxlib_version"] = "0.0.0-stale"
+    new_head = json.dumps(header, sort_keys=True, default=str).encode()
+    with open(path, "wb") as fh:
+        fh.write(aotcache._MAGIC)
+        fh.write(struct.pack(">I", len(new_head)))
+        fh.write(new_head)
+        fh.write(data[off + 4 + hlen:])
+    fresh = aotcache.ExecutableCache(d)
+    # Eager invalidation: never loaded, stale entry removed.
+    assert fresh.get("coalesced_megastep", COALESCED_AXES) is None
+    assert fresh.counts["invalidated"] == 1
+    assert not os.path.exists(path)
+    # The fallback is a fresh compile that re-persists the entry.
+    info = fresh.ensure(
+        "coalesced_megastep", COALESCED_AXES,
+        AOT_SPECS["coalesced_megastep"],
+    )
+    assert info["status"] == "compiled"
+    assert os.path.exists(path)
+
+
+def test_corrupt_entry_quarantines_and_recompiles(tmp_path):
+    d = str(tmp_path)
+    cache = aotcache.ExecutableCache(d)
+    cache.ensure(
+        "coalesced_megastep", COALESCED_AXES,
+        AOT_SPECS["coalesced_megastep"],
+    )
+    path = aotcache.entry_path(d, "coalesced_megastep", COALESCED_AXES)
+    with open(path, "rb") as fh:
+        data = fh.read()
+    with open(path, "wb") as fh:
+        # Valid magic + header, garbled payload: the deserialize (not
+        # the parse) is what must fail safely.
+        fh.write(data[: len(data) // 2])
+        fh.write(b"\x00garbage\x00" * 16)
+    fresh = aotcache.ExecutableCache(d)
+    assert fresh.get("coalesced_megastep", COALESCED_AXES) is None
+    assert fresh.counts["corrupt"] == 1
+    # The snapshot.py discipline: bytes kept for post-mortem at
+    # <entry>.corrupt, the family never trips on them twice.
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)
+    info = fresh.ensure(
+        "coalesced_megastep", COALESCED_AXES,
+        AOT_SPECS["coalesced_megastep"],
+    )
+    assert info["status"] == "compiled"
+    assert (
+        fresh.get("coalesced_megastep", COALESCED_AXES) is not None
+    )
+
+
+def test_call_time_failure_evicts_and_falls_back(warm_dir, tmp_path):
+    # An entry that LOADS but cannot RUN (stale-structure drift the
+    # load-time ladder cannot see) must cost one fallback, never a
+    # bricked signature: evicted from the memo, disk bytes quarantined,
+    # the jit path serves, and the event counts as a request-path
+    # compile rather than a warm dispatch.
+    import shutil
+
+    d = str(tmp_path)
+    src = aotcache.entry_path(warm_dir, "coalesced_megastep", COALESCED_AXES)
+    dst = aotcache.entry_path(d, "coalesced_megastep", COALESCED_AXES)
+    os.makedirs(d, exist_ok=True)
+    shutil.copy(src, dst)
+    cache = aotcache.ExecutableCache(d)
+
+    calls = {"n": 0}
+
+    def broken(*args, **kwargs):
+        calls["n"] += 1
+        raise RuntimeError("stale executable")
+
+    cache._mem[cache._key("coalesced_megastep", COALESCED_AXES)] = broken
+    ref = coalesced_sweep(
+        slot_keys(), mkstate(), RPD, rounds_per_dispatch=RPD
+    )
+    out = coalesced_sweep(
+        slot_keys(), mkstate(), RPD, rounds_per_dispatch=RPD,
+        executables=cache,
+    )
+    np.testing.assert_array_equal(out["decisions"], ref["decisions"])
+    np.testing.assert_array_equal(out["counters"], ref["counters"])
+    assert calls["n"] == 1
+    assert out["stats"]["warm_dispatches"] == 0
+    assert out["stats"]["request_path_compiles"] == 1
+    assert cache.counts["evicted"] == 1
+    assert os.path.exists(dst + ".corrupt") and not os.path.exists(dst)
+    # The signature is negative-marked: the next dispatch goes straight
+    # to the jit path without re-probing the quarantined entry.
+    out2 = coalesced_sweep(
+        slot_keys(), mkstate(), RPD, rounds_per_dispatch=RPD,
+        executables=cache,
+    )
+    np.testing.assert_array_equal(out2["decisions"], ref["decisions"])
+    assert out2["stats"]["warm_dispatches"] == 0
+
+
+def test_aot_warm_does_not_mask_jit_cold_accounting(tmp_path):
+    # ensure() stores a LEDGER row but must NOT mark the jit first-call
+    # classifier: an AOT compile never populates jit's cache, so a
+    # later cache-LESS dispatch of the same signature pays a real
+    # compile — and it must still COUNT as one.
+    axes = dict(COALESCED_AXES, batch=1)
+    cache = aotcache.ExecutableCache(str(tmp_path))
+    obs.reset_first_calls()
+    cache.ensure(
+        "coalesced_megastep", axes, AOT_SPECS["coalesced_megastep"]
+    )
+    out = coalesced_sweep(
+        slot_keys(1), mkstate(1), RPD, rounds_per_dispatch=RPD
+    )
+    assert out["stats"]["request_path_compiles"] == 1
+
+
+def test_pipeline_sweep_warm_opt_in(tmp_path):
+    axes = {
+        "batch": B, "capacity": CAP, "rounds": RPD, "m": 1,
+        "max_liars": None, "unroll": 1, "collect_decisions": True,
+        "counters": True, "data": 1, "scenario": False,
+    }
+    cache = aotcache.ExecutableCache(str(tmp_path))
+    cache.ensure("pipeline_megastep", axes, AOT_SPECS["pipeline_megastep"])
+    ref = pipeline_sweep(
+        jr.key(5), mkstate(), ROUNDS, rounds_per_dispatch=RPD,
+        collect_decisions=True, with_counters=True,
+    )
+    warm = pipeline_sweep(
+        jr.key(5), mkstate(), ROUNDS, rounds_per_dispatch=RPD,
+        collect_decisions=True, with_counters=True, executables=cache,
+    )
+    np.testing.assert_array_equal(warm["decisions"], ref["decisions"])
+    np.testing.assert_array_equal(warm["histograms"], ref["histograms"])
+    assert warm["counters"] == ref["counters"]
+    assert warm["stats"]["warm_dispatches"] == warm["stats"]["dispatches"]
+
+
+# -- the warmup runner --------------------------------------------------------
+
+
+def test_bucket_lattice_covers_cohort_space():
+    plan = warmup.bucket_lattice(8, 8, capacities=(4,), rounds=20)
+    axes = [a for fn, a in plan]
+    assert all(fn == "coalesced_megastep" for fn, _ in plan)
+    assert {a["batch"] for a in axes} == {1, 2, 4, 8}
+    # Windows: the steady-state dispatch plus the ragged remainder
+    # (20 % 8 == 4) — the exact chunking coalesced_sweep performs.
+    assert {a["rounds"] for a in axes} == {4, 8}
+    # Dedup + determinism: same config, same plan.
+    assert plan == warmup.bucket_lattice(8, 8, capacities=(4,), rounds=20)
+    with pytest.raises(ValueError):
+        warmup.bucket_lattice(0, 8)
+    with pytest.raises(ValueError):
+        warmup.builder_for("not_a_megastep")
+
+
+def test_ledger_replay_set_filters_toolchain(tmp_path):
+    from ba_tpu.obs import instrument
+
+    ledger = str(tmp_path / "ledger.json")
+    env = {"jax_version": jax.__version__, "jaxlib_version": "test-jl"}
+    try:
+        instrument.configure_compile_ledger(ledger, env_axes=env)
+        obs.reset_first_calls()
+        instrument.classify_compile(
+            "coalesced_megastep", dict(COALESCED_AXES)
+        )
+        # A row from a DIFFERENT toolchain, written straight into the
+        # file the way a previous process would have left it.
+        doc = json.load(open(ledger))
+        doc["fns"]["coalesced_megastep"].append(
+            {**COALESCED_AXES, "batch": 64,
+             "jax_version": "0.0.0", "jaxlib_version": "other"}
+        )
+        doc["fns"]["not_a_megastep"] = [
+            {**env, "batch": 1}
+        ]
+        json.dump(doc, open(ledger, "w"))
+        instrument.configure_compile_ledger(ledger, env_axes=env)
+        replay = warmup.ledger_replay_set()
+        # Exactly the reproducible row of a known fn survives, with the
+        # env axes (and run_id rider) stripped back off.
+        assert replay == [("coalesced_megastep", dict(COALESCED_AXES))]
+    finally:
+        instrument.configure_compile_ledger(None)
+        obs.reset_first_calls()
+
+
+def test_warmup_gate_pauses_until_healthy(warm_dir):
+    cache = aotcache.ExecutableCache(warm_dir)
+    healthy = {"v": False}
+    runner = warmup.WarmupRunner(
+        cache,
+        [("coalesced_megastep", dict(COALESCED_AXES))],
+        gate=lambda: healthy["v"],
+        registry=MetricsRegistry(),
+        pause_s=0.01,
+    )
+    runner.start()
+    # The gate reads pressure: the runner must PAUSE, not proceed.
+    assert not runner.wait(0.3)
+    assert runner.warmed == 0
+    healthy["v"] = True
+    assert runner.wait(60.0)
+    assert runner.progress()["warmed"] == 1
+    assert runner.progress()["pending"] == 0
+
+
+def test_warmup_runner_counts_errors_and_finishes(tmp_path):
+    cache = aotcache.ExecutableCache(str(tmp_path))
+    runner = warmup.WarmupRunner(
+        cache,
+        # A signature no builder can lower (capacity 0 state) — the
+        # runner must count it and keep going, never raise.
+        [("pipeline_megastep", {"batch": 1, "capacity": 4, "rounds": 2,
+                                "m": 1, "max_liars": None, "unroll": 1,
+                                "collect_decisions": False,
+                                "counters": False, "data": 8,
+                                "scenario": False})],
+        registry=MetricsRegistry(),
+    )
+    runner.start()
+    assert runner.wait(60.0)
+    assert runner.progress()["errors"] == 1
+    assert runner.progress()["warmed"] == 0
+
+
+# -- the warm service ---------------------------------------------------------
+
+
+def _alone(req):
+    cap = 4
+    faulty = np.zeros((1, cap), np.bool_)
+    alive = np.zeros((1, cap), np.bool_)
+    alive[0, : req.n] = True
+    for i in req.faulty:
+        faulty[0, i] = True
+    state = fresh_copy(
+        SimState(
+            order=jnp.full(
+                (1,), 1 if req.order == "attack" else 0, COMMAND_DTYPE
+            ),
+            leader=jnp.zeros((1,), jnp.int32),
+            faulty=jnp.asarray(faulty),
+            alive=jnp.asarray(alive),
+            ids=jnp.asarray(np.arange(1, cap + 1, dtype=np.int32)[None, :]),
+        )
+    )
+    return coalesced_sweep(
+        [jr.key(req.seed)], state, req.rounds, rounds_per_dispatch=RPD
+    )
+
+
+def test_service_warm_zero_request_path_compiles(warm_dir):
+    obs.reset_first_calls()
+    svc = AgreementService(
+        ServeConfig(
+            max_batch=2, max_queue=8, coalesce_window_s=0.002,
+            rounds_per_dispatch=RPD, warm=True, warm_rounds=ROUNDS,
+            aot_cache=warm_dir,
+        ),
+        registry=MetricsRegistry(),
+    )
+    svc.open()
+    assert svc.warm_barrier(timeout=300)
+    svc.start()
+    reqs = [
+        AgreementRequest(kind="run-rounds", n=4, seed=41, rounds=ROUNDS),
+        AgreementRequest(
+            kind="run-rounds", n=4, faulty=(2,), seed=43, rounds=ROUNDS
+        ),
+    ]
+    tickets = [svc.submit(r) for r in reqs]
+    outs = [t.result(timeout=300) for t in tickets]
+    # Scenario cohorts are first-class warm traffic too (the default
+    # lattice covers scenario=True): a post-barrier scenario request
+    # must also dispatch without a request-path compile.
+    from ba_tpu.scenario import from_dict
+
+    spec = from_dict(
+        {"name": "warmtest", "rounds": ROUNDS,
+         "events": [{"round": 2, "kill": [3]}]}
+    )
+    scn = svc.submit(
+        AgreementRequest(kind="scenario", n=4, seed=49, spec=spec)
+    ).result(timeout=300)
+    stats = svc.stats()
+    svc.stop()
+    # Warm-vs-cold bit-exactness through the SERVICE.
+    for req, out in zip(reqs, outs):
+        ref = _alone(req)
+        assert out["decisions"] == [int(v) for v in ref["decisions"][:, 0]]
+        assert out["counters"] == {
+            n: int(v)
+            for n, v in zip(ref["counter_names"], ref["counters"][0])
+        }
+    assert len(scn["decisions"]) == ROUNDS and "leaders" in scn
+    # The acceptance boolean, measured: a warm service never compiled
+    # on the request path — interactive OR scenario.
+    assert stats["compiles_on_request_path"] == 0
+    assert stats["warmup_done"] and stats["warmup_errors"] == 0
+    assert stats["warmup_warmed"] == stats["warmup_planned"]
+
+
+def test_service_unwarmed_window_counts_miss(warm_dir):
+    # rounds=6 dispatches as windows 4+2; window 2 is NOT in the warm
+    # plan — the cohort must still serve (compile-on-miss) and the miss
+    # must be counted.
+    obs.reset_first_calls()
+    svc = AgreementService(
+        ServeConfig(
+            max_batch=2, max_queue=8, coalesce_window_s=0.002,
+            rounds_per_dispatch=RPD, warm=True, warm_rounds=ROUNDS,
+            aot_cache=warm_dir,
+        ),
+        registry=MetricsRegistry(),
+    )
+    svc.open()
+    assert svc.warm_barrier(timeout=300)
+    svc.start()
+    req = AgreementRequest(kind="run-rounds", n=4, seed=47, rounds=6)
+    out = svc.submit(req).result(timeout=300)
+    stats = svc.stats()
+    svc.stop()
+    ref = _alone(req)
+    assert out["decisions"] == [int(v) for v in ref["decisions"][:, 0]]
+    assert stats["compiles_on_request_path"] >= 1
+    assert stats["warmup_misses"] >= 1
+
+
+def test_warmup_never_sheds_live_traffic(tmp_path):
+    # A FRESH cache dir: the warmup thread pays real AOT compiles while
+    # live traffic flows.  The pin: no request is shed, the tier never
+    # leaves 0, and every result stays bit-exact.
+    reg = MetricsRegistry()
+    svc = AgreementService(
+        ServeConfig(
+            max_batch=2, max_queue=8, coalesce_window_s=0.002,
+            rounds_per_dispatch=RPD, warm=True, warm_rounds=ROUNDS,
+            aot_cache=str(tmp_path),
+        ),
+        registry=reg,
+    )
+    svc.start()  # warmup launches with the dispatcher already live
+    tiers = []
+    outs = []
+    reqs = []
+    for i in range(6):
+        req = AgreementRequest(
+            kind="run-rounds", n=4, seed=60 + i, rounds=ROUNDS
+        )
+        reqs.append(req)
+        outs.append(svc.submit(req).result(timeout=300))
+        tiers.append(svc.stats()["tier"])
+    assert svc.warm_barrier(timeout=300)
+    stats = svc.stats()
+    svc.stop()
+    assert stats["rejected"] == 0 and stats["failed"] == 0
+    assert tiers == [0] * len(tiers)
+    assert reg.get("serve_shed_tier").value == 0
+    for req, out in zip(reqs, outs):
+        ref = _alone(req)
+        assert out["decisions"] == [int(v) for v in ref["decisions"][:, 0]]
+
+
+# -- host-tier / REPL ---------------------------------------------------------
+
+
+def test_warmup_and_aotcache_import_jax_free():
+    # The BA301 host-tier contract, runtime-proven (the lint direction
+    # is mutation-checked in ci.sh): importing the warmup pass and the
+    # executable cache must not pull jax — plan construction runs on
+    # hosts without it.
+    code = (
+        "import sys; import ba_tpu.runtime.warmup; "
+        "import ba_tpu.obs.aotcache; "
+        "assert 'jax' not in sys.modules, 'warm stack import pulled jax'; "
+        "print('ok')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True
+    )
+    assert out.returncode == 0, out.stderr
+
+
+def test_repl_serve_start_warm(monkeypatch, warm_dir):
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+    from ba_tpu.runtime.backends import PyBackend
+
+    monkeypatch.setenv("BA_TPU_AOT_CACHE", warm_dir)
+    cluster = Cluster(4, PyBackend(), seed=0)
+    lines: list = []
+    out = lines.append
+    # batch=1 keeps the warmup plan at two signatures (one bucket x one
+    # window x scenario {off, on}) — the command surface is under test,
+    # not warmup breadth.
+    assert handle_command(cluster, "serve start warm=1 batch=1", out)
+    assert lines and lines[-1].startswith("serve: started") \
+        and "warm" in lines[-1]
+    svc = cluster._serve_service
+    assert svc.warm_barrier(timeout=300)
+    lines.clear()
+    assert handle_command(cluster, "serve stat", out)
+    stat = "\n".join(lines)
+    assert "serve_warmup_planned" in stat
+    assert "serve_warmup_pending 0" in stat
+    lines.clear()
+    assert handle_command(cluster, "serve warm=nonsense", out)
+    assert lines[-1].startswith("serve error:")
+    lines.clear()
+    assert handle_command(cluster, "serve start warm=oops", out)
+    assert lines[-1].startswith("serve error: already running") or (
+        "wants a int" in lines[-1]
+    )
+    lines.clear()
+    assert handle_command(cluster, "serve stop", out)
+    assert lines[-1].startswith("serve: stopped")
