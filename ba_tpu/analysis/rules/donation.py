@@ -81,6 +81,19 @@ KNOWN_DONATING = {
     "ba_tpu.parallel.shard.sharded_scenario_megastep": DonationSpec(
         frozenset([0, 1, 2]), ("state", "sched", "strategy")
     ),
+    # The Pallas megastep twins (ISSUE 13) mirror their XLA twins'
+    # donation contracts exactly; real donate_argnums decorators and
+    # def-line annotations exist there too — same belt-and-braces as
+    # the sharded rows above.
+    "ba_tpu.ops.scenario_step.pallas_scenario_megastep": DonationSpec(
+        frozenset([0, 1, 2]), ("state", "sched", "strategy")
+    ),
+    "ba_tpu.ops.scenario_step.pallas_pipeline_megastep": DonationSpec(
+        frozenset([0, 1]), ("state", "sched")
+    ),
+    "ba_tpu.ops.scenario_step.pallas_coalesced_megastep": DonationSpec(
+        frozenset([0, 1, 2]), ("state", "sched", "strategy")
+    ),
 }
 
 _DONATES_RE = re.compile(r"#\s*ba-lint:\s*donates\(([^)]*)\)")

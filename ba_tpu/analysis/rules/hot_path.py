@@ -30,16 +30,24 @@ import ast
 
 from ba_tpu.analysis.base import Rule, register
 
-HOT_TREE = "ba_tpu.parallel."
+# ISSUE 13 extended the hot tree beyond parallel/: the Pallas scenario
+# megastep (ops/scenario_step.py) IS the dispatch path when the kernel
+# engine is selected — its wrappers sit exactly where the XLA megasteps
+# do, so the same no-host-sync discipline applies (the other ops/
+# kernels are crypto-side and stay out).
+HOT_TREES = ("ba_tpu.parallel.", "ba_tpu.ops.scenario_step")
 # The round-loop modules: the ones whose steady-state statements run
 # once per round / per dispatch.  ISSUE 8 added the mesh scan core
 # (parallel/shard.py — the shard_map megasteps and the retire-time
 # host reduction both sit on the dispatch path); mesh/multihost stay
-# out as the package's sanctioned host-topology numpy users.
+# out as the package's sanctioned host-topology numpy users.  ISSUE 13
+# added the kernel megastep module (trace-time numpy map construction
+# is fine — the banned idioms are the conversion/drain calls).
 HOT_CONVERSION_MODULES = {
     "ba_tpu.parallel.pipeline",
     "ba_tpu.parallel.sweep",
     "ba_tpu.parallel.shard",
+    "ba_tpu.ops.scenario_step",
 }
 PIPELINE_MODULE = "ba_tpu.parallel.pipeline"
 
@@ -69,7 +77,7 @@ class HostSyncInHotPath(Rule):
     severity = "error"
 
     def check_module(self, mod, project):
-        if not mod.modname.startswith(HOT_TREE):
+        if not mod.modname.startswith(HOT_TREES):
             return
         seen: set = set()
 

@@ -44,6 +44,29 @@ def _coin(key: jax.Array, shape) -> jnp.ndarray:
     return coin_bits(key, shape)
 
 
+def round1_apply(
+    state: SimState, coins: jnp.ndarray,
+    strategies: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Round 1 from PRE-DRAWN coins (the coin-injected form, ISSUE 13):
+    the batched round math with the PRNG draw factored out, so callers
+    that must draw per-instance streams (``agreement_step``'s
+    per-instance keys) can vmap ONLY the tiny draw and run this body
+    batched — the strategy selects under vmap were the measured
+    XLA-CPU pathology (``BENCH_pallas_r13.json``'s A/B)."""
+    B, n = state.faulty.shape
+    if strategies is not None:
+        leader_strategy = jnp.take_along_axis(
+            strategies, state.leader[:, None], axis=1
+        )
+        coins = lie_values(leader_strategy, coins, jnp.arange(n)[None, :])
+    leader_onehot = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0
+    leader_faulty = jnp.take_along_axis(state.faulty, state.leader[:, None], axis=1)
+    received = jnp.where(leader_faulty, coins, state.order[:, None])
+    received = jnp.where(leader_onehot, state.order[:, None], received)
+    return received
+
+
 def round1_broadcast(
     key: jax.Array, state: SimState, strategies: jnp.ndarray | None = None
 ) -> jnp.ndarray:
@@ -57,17 +80,7 @@ def round1_broadcast(
     computed but masked out downstream — keeping the shape static for XLA.
     """
     B, n = state.faulty.shape
-    coins = _coin(key, (B, n))
-    if strategies is not None:
-        leader_strategy = jnp.take_along_axis(
-            strategies, state.leader[:, None], axis=1
-        )
-        coins = lie_values(leader_strategy, coins, jnp.arange(n)[None, :])
-    leader_onehot = jax.nn.one_hot(state.leader, n, dtype=jnp.int8) > 0
-    leader_faulty = jnp.take_along_axis(state.faulty, state.leader[:, None], axis=1)
-    received = jnp.where(leader_faulty, coins, state.order[:, None])
-    received = jnp.where(leader_onehot, state.order[:, None], received)
-    return received
+    return round1_apply(state, _coin(key, (B, n)), strategies)
 
 
 def round2_votes(
@@ -90,7 +103,17 @@ def round2_votes(
     hear from it (SURVEY.md Q3).
     """
     B, n = state.faulty.shape
-    coins = _coin(key, (B, n, n))
+    return round2_apply(state, received, _coin(key, (B, n, n)), strategies)
+
+
+def round2_apply(
+    state: SimState,
+    received: jnp.ndarray,
+    coins: jnp.ndarray,
+    strategies: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Round 2 from PRE-DRAWN coins (see :func:`round1_apply`)."""
+    n = state.faulty.shape[1]
     if strategies is not None:
         coins = lie_values(
             strategies[:, None, :], coins, jnp.arange(n)[None, :, None]
@@ -133,6 +156,21 @@ def om1_round(
     k1, k2 = jr.split(key)
     received = round1_broadcast(k1, state, strategies)
     answers = round2_votes(k2, state, received, strategies)
+    return tally_majorities(state, received, answers)
+
+
+def om1_round_from_coins(
+    state: SimState,
+    coins1: jnp.ndarray,
+    coins2: jnp.ndarray,
+    strategies: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """OM(1) from pre-drawn coin planes ([B, n] and [B, n, n]): the
+    batched round math of :func:`om1_round` with the draws factored out
+    — bit-identical when fed the same coins (``agreement_step`` vmaps
+    only the per-instance draw; tests pin the equivalence)."""
+    received = round1_apply(state, coins1, strategies)
+    answers = round2_apply(state, received, coins2, strategies)
     return tally_majorities(state, received, answers)
 
 

@@ -99,6 +99,39 @@ def or_coin_threshold8(k_cnt: jnp.ndarray, gate: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(gate, t, 0)
 
 
+def coin_words(key: jax.Array, size: int) -> jnp.ndarray:
+    """The packed uint32 word stream behind a ``coin_bits(key, shape)``
+    draw of ``size`` coins — one ``jr.bits`` call, no unpack.  Callers
+    that need a different unpack LAYOUT at the same bit mapping (see
+    :func:`unpack_coin_words`) draw here."""
+    return jr.bits(key, (-(-size // 32),), jnp.uint32)
+
+
+def unpack_coin_words(words, shape, dtype=COMMAND_DTYPE) -> jnp.ndarray:
+    """Row-major gather unpack of :func:`coin_words` — bit-exact with
+    ``coin_bits``'s mapping (coin ``e`` is bit ``e // nwords`` of word
+    ``e % nwords``), materialized coin-index-major (ISSUE 13).
+
+    ``coin_bits``'s [32, nwords] unpack is the fast orientation when
+    the coins feed ONE fused consumer; but when the coin plane feeds a
+    select tree (the strategy lie table), XLA-CPU fuses the transposing
+    unpack into every cube-sized consumer and the strided access
+    defeats vectorization — measured ~2.3x of the whole agreement
+    round (megastep_ab).  Gathering by a static coin->word index map
+    instead produces the plane directly in row-major order: same bits,
+    fusion-friendly layout.  ``words`` may carry leading batch axes
+    (the gather maps index the LAST axis).
+    """
+    import numpy as _host_np  # host-side static index maps (trace time)
+
+    size = math.prod(shape)
+    nwords = -(-size // 32)
+    e = _host_np.arange(size)
+    wmap = jnp.asarray((e % nwords).reshape(shape).astype(_host_np.int32))
+    bmap = jnp.asarray((e // nwords).reshape(shape).astype(_host_np.uint32))
+    return ((words[..., wmap] >> bmap) & 1).astype(dtype)
+
+
 def coin_bits(key: jax.Array, shape, dtype=COMMAND_DTYPE) -> jnp.ndarray:
     """iid fair coins of ``shape``: 0/1 in ``dtype`` (bool for masks).
 

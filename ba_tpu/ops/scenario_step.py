@@ -1,0 +1,767 @@
+"""Pallas TPU kernel: the ENTIRE mutating scenario round, fused (ISSUE 13).
+
+The flexible scenario path (``parallel/pipeline._scenario_scan``) runs
+each round as a chain of small XLA programs — event application, the
+lowest-alive-id election, two threefry coin draws, the strategy lie
+table, the OM(1) answer cube, three tallies and the counter fold — whose
+XLA-CPU form pays per-op materialization and (pre-ISSUE-13) the
+strategy select-chain pathology, leaving it ~27x behind the fused sweep
+kernel (``ops/sweep_step.py``) in rounds/dispatch-second
+(``BENCH_scenario_r8.json``).  This kernel runs ``rounds`` complete
+mutating rounds for the whole batch inside one ``pallas_call``:
+
+- every intermediate (state planes, coin words, the answer cube, the
+  per-round tallies) lives in VMEM/registers; the state planes are read
+  once and written once;
+- **in-kernel threefry2x32 counter mode**: the donated
+  :class:`~ba_tpu.parallel.pipeline.KeySchedule` threads through the
+  kernel's key/counter arguments, and the kernel reproduces jax's
+  ``fold_in`` → ``fold_in`` → ``split`` → ``bits`` derivation chain
+  EXACTLY (int32 add/xor/rotate lanes; logical shifts emulated with
+  arithmetic-shift + static masks so everything stays in the int32
+  lanes Mosaic likes) — so RANDOM and ADAPTIVE_SPLIT coins are
+  **bit-exact** against the XLA scan core under the same keys, which is
+  what lets one campaign cross engines mid-run (checkpoints, serving
+  cohorts, parity tests).  The word layout is the counter-mode pair
+  schedule of jax's ``threefry_2x32`` (odd sizes pad with one zero
+  count; ``coin_bits``'s bit-index-major unpack) — precomputed as
+  static index maps per (n) specialization, so the kernel does no
+  integer division;
+- strategies evaluate the SAME branch-free lie table the XLA path uses
+  (:func:`ba_tpu.scenario.strategies.lie_table` — one formulation, two
+  engines);
+- the per-round outputs (decision column, per-instance leaders, the
+  3-bin histogram, the cumulative counter block) park into register
+  accumulators via lane selects (the ``ops/sweep_step.py`` trick) and
+  land in one store after the round loop — no dynamic output stores.
+
+Three jitted wrappers mirror the XLA megasteps' signatures, return
+tuples and donation contracts exactly (``pallas_scenario_megastep`` /
+``pallas_pipeline_megastep`` / ``pallas_coalesced_megastep``), so the
+engine's dispatch loops swap callables without touching the depth-k
+retire discipline, the counter thread, or checkpoints.  House pattern:
+``interpret=True`` runs the kernel as jnp ops on CPU (CI pins
+bit-exactness there, tests/test_megastep.py); ``interpret=False``
+compiles through Mosaic on TPU — reachable via
+``pipeline_sweep(engine="pallas")`` / ``BA_TPU_ENGINE`` (the tunnel
+measurement rides the consolidated real-TPU pass, ROADMAP).
+
+SUPPORT ENVELOPE (the engine-select seam enforces it eagerly):
+OM(1) only (``m == 1`` — the dense EIG tree for m >= 2 stays on the
+XLA core), single device (mesh ``data == 1``), oral messages (the
+signed path host-signs between rounds and never enters the scenario
+scan).  Everything here is batch-local, so the VMEM budget is
+O(B * n^2) for the answer cube — the serving and scenario shapes the
+ROADMAP names; huge-batch campaigns stay on the XLA core via
+``engine="auto"`` fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ba_tpu.core.state import SimState
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
+from ba_tpu.scenario.strategies import lie_table
+
+LANES = 128
+SUBLANES = 8
+_INT_MAX = np.int32(np.iinfo(np.int32).max)
+# The counter block is at most SCENARIO_COUNTER_NAMES long (5); padded
+# to one sublane tile.  Spelled locally (ops must not import parallel).
+_CPAD = 8
+
+
+def _pad_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+# -- in-kernel threefry2x32 ---------------------------------------------------
+#
+# jax's threefry_2x32 on int32 lanes.  Additions wrap (two's complement
+# == uint32 mod 2^32), XOR is bitwise, and the rotate's logical right
+# shift is emulated as arithmetic-shift-then-mask (the shift amounts
+# are STATIC rotation constants, so the masks fold to literals) —
+# keeping the whole cipher in plain int32 vector ops.  Verified
+# word-exact against jax.random in tests/test_megastep.py.
+
+_ROTATIONS = ((13, 15, 26, 6), (17, 29, 16, 24))
+_PARITY = 0x1BD11BDA  # threefry key-schedule parity constant
+
+
+def _lshr(x, k: int):
+    """Logical right shift by STATIC k in int32 lanes."""
+    if k == 0:
+        return x
+    return (x >> k) & ((1 << (32 - k)) - 1)
+
+
+def _rotl(x, d: int):
+    return (x << d) | _lshr(x, 32 - d)
+
+
+def tf2x32(k0, k1, x0, x1):
+    """One threefry2x32 block: int32 key words + count words (any
+    mutually broadcastable shapes) -> the two int32 output words."""
+    ks2 = k0 ^ k1 ^ _PARITY
+    ks = (k0, k1, ks2)
+    x0 = x0 + k0
+    x1 = x1 + k1
+    for i in range(5):
+        for r in _ROTATIONS[i % 2]:
+            x0 = x0 + x1
+            x1 = _rotl(x1, r)
+            x1 = x0 ^ x1
+        x0 = x0 + ks[(i + 1) % 3]
+        x1 = x1 + ks[(i + 2) % 3] + (i + 1)
+    return x0, x1
+
+
+def _fold_in(k0, k1, data):
+    """jax's ``fold_in``: threefry of the key over the 32-bit data word
+    (count pair ``(0, data)`` — the uint32 ``threefry_seed`` layout)."""
+    zero = jnp.zeros_like(data)
+    return tf2x32(k0, k1, zero, data)
+
+
+def _split2(k0, k1):
+    """jax's ``split(key)`` -> two keys: counter-mode words over
+    ``iota(4)`` with the pair schedule (0,2)/(1,3); subkey ``a`` takes
+    the first output word of each pair, ``b`` the second."""
+    two = jnp.full_like(k0, 2)
+    three = jnp.full_like(k0, 3)
+    ya0, ya1 = tf2x32(k0, k1, jnp.zeros_like(k0), two)
+    yb0, yb1 = tf2x32(k0, k1, jnp.ones_like(k0), three)
+    return (ya0, yb0), (ya1, yb1)
+
+
+def _word_maps(size: int, shape: tuple) -> np.ndarray:
+    """Static counter/bit maps reproducing ``coin_bits``'s unpack for a
+    draw of ``size`` coins, laid out as ``shape`` (row-major).
+
+    Returns int32 ``[4, *shape]``: rows (c0, c1, sel, bit) where the
+    threefry word behind coin ``e`` is ``tf(key, c0, c1)[sel]`` and the
+    coin is bit ``bit`` of it — the pair schedule of jax's
+    ``threefry_2x32`` over ``iota(nwords)`` (odd word counts pair their
+    last count with a zero pad) composed with the bit-index-major
+    unpack of ``core/rng.coin_bits`` (coin e -> word ``e % nwords``,
+    bit ``e // nwords``).  Padded positions (beyond ``size``) clamp to
+    coin 0 — their values are masked off downstream, the clamp only
+    keeps the shift amounts in range.
+    """
+    nwords = -(-size // 32)
+    half = (nwords + (nwords % 2)) // 2
+    e = np.minimum(np.arange(int(np.prod(shape)), dtype=np.int64), size - 1)
+    w = e % nwords
+    bit = e // nwords
+    j = np.where(w < half, w, w - half)
+    c1 = np.where(j + half < nwords, j + half, 0)
+    sel = (w < half).astype(np.int64)  # 1 -> first output word
+    return np.stack([j, c1, sel, bit]).reshape((4,) + shape).astype(np.int32)
+
+
+def _coins(k0, k1, maps):
+    """Draw the mapped coin block: ``maps`` is a ``[4, ...]`` int32
+    array (:func:`_word_maps` rows, broadcastable against the key
+    words) -> int32 coins in {0, 1}."""
+    y0, y1 = tf2x32(k0, k1, maps[0], maps[1])
+    word = jnp.where(maps[2] == 1, y0, y1)
+    # Low bit survives the arithmetic shift for any bit index < 32.
+    return (word >> maps[3]) & 1
+
+
+# -- the kernel ---------------------------------------------------------------
+
+
+def _megastep_kernel(
+    *refs,
+    B: int,
+    n: int,
+    rounds: int,
+    scenario: bool,
+    slot_mode: bool,
+    with_counters: bool,
+):
+    """One fused dispatch: ``rounds`` mutating agreement rounds for the
+    whole [B, n] batch.  ``refs`` unpacks positionally in the order
+    :func:`_megastep_call` builds its operand list (statics decide
+    which refs exist).  All arithmetic is int32; every per-round output
+    parks into a lane-indexed register accumulator and stores once."""
+    it = iter(refs)
+    ctr_ref = next(it)  # SMEM [1]: the schedule counter at entry
+    maps1_ref = next(it)  # [8, n_pad] round-1 coin maps (4 live rows)
+    maps2_ref = next(it)  # [4, n_pad, n_pad] round-2 cube maps
+    order_ref = next(it)  # [B_pad, 1]
+    leader_ref = next(it)
+    k0_ref = next(it)  # [B_pad, 1] per-row base-key words
+    k1_ref = next(it)
+    idx_ref = next(it)  # [B_pad, 1] instance-index fold (0s in slot mode)
+    faulty_ref = next(it)  # [B_pad, n_pad]
+    alive_ref = next(it)
+    ids_ref = next(it)
+    strat_ref = next(it) if scenario else None
+    ctr_in_ref = next(it) if with_counters else None
+    if scenario:
+        ev_kill_ref = next(it)  # [rounds, B_pad, n_pad] each
+        ev_revive_ref = next(it)
+        ev_fset_ref = next(it)
+        ev_sset_ref = next(it)
+    out_alive_ref = next(it)
+    out_faulty_ref = next(it)
+    out_leader_ref = next(it)
+    out_strat_ref = next(it) if scenario else None
+    out_maj_ref = next(it) if slot_mode else None
+    out_dec_ref = next(it)  # [B_pad, R_pad]
+    out_lead_ref = next(it) if scenario else None
+    out_hist_ref = next(it) if not slot_mode else None  # [8, R_pad]
+    out_ctr_ref = next(it) if with_counters else None
+
+    B_pad, n_pad = faulty_ref.shape
+    R_pad = out_dec_ref.shape[1]
+    n_counters = 5 if scenario else 3
+
+    iota_j = jax.lax.broadcasted_iota(jnp.int32, (B_pad, n_pad), 1)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (B_pad, 1), 0)
+    valid_row = (iota_b < B).astype(jnp.int32)  # padded batch rows
+    lane_r = jax.lax.broadcasted_iota(jnp.int32, (1, R_pad), 1)
+    crow = jax.lax.broadcasted_iota(jnp.int32, (_CPAD, 1), 0)
+    eye = (
+        jax.lax.broadcasted_iota(jnp.int32, (1, n_pad, n_pad), 1)
+        == jax.lax.broadcasted_iota(jnp.int32, (1, n_pad, n_pad), 2)
+    ).astype(jnp.int32)
+    recv_i = jax.lax.broadcasted_iota(jnp.int32, (1, n_pad, 1), 1)
+
+    ctr0 = ctr_ref[0]
+    order = order_ref[:]
+    k0c, k1c, idxc = k0_ref[:], k1_ref[:], idx_ref[:]
+    maps1 = maps1_ref[0:4, :][:, None, :]  # [4, 1, n_pad]
+    maps2 = maps2_ref[:][:, None, :, :]  # [4, 1, n_pad, n_pad]
+
+    def _col(values):
+        """Stack up-to-_CPAD scalars into an [_CPAD, 1] column via row
+        selects (Mosaic has no scalar scatter; the rows are static)."""
+        col = jnp.zeros((_CPAD, 1), jnp.int32)
+        for r, v in enumerate(values):
+            col = jnp.where(crow == r, v, col)
+        return col
+
+    def body(rr, carry):
+        (alive, faulty, leader, strat, ctr_cum, maj_keep, acc) = carry
+
+        if scenario:
+            kill = ev_kill_ref[rr]
+            revive = ev_revive_ref[rr]
+            fset = ev_fset_ref[rr]
+            sset = ev_sset_ref[rr]
+            alive = jnp.maximum(alive * (1 - kill), revive)
+            faulty = jnp.where(fset >= 0, (fset > 0).astype(jnp.int32), faulty)
+            strat = jnp.where(sset >= 0, sset, strat)
+            lmask = (iota_j == leader).astype(jnp.int32)
+            leader_alive = jnp.sum(lmask * alive, axis=1, keepdims=True)
+            # elect_lowest_id as masked min + first-index-of-min (the
+            # argmin tie rule): all-dead rows elect index 0, like the
+            # XLA path's argmin over an all-big row.
+            masked = jnp.where(alive > 0, ids_ref[:], _INT_MAX)
+            rowmin = jnp.min(masked, axis=1, keepdims=True)
+            elected = jnp.min(
+                jnp.where(masked == rowmin, iota_j, n_pad),
+                axis=1,
+                keepdims=True,
+            )
+            leader = jnp.where(leader_alive > 0, leader, elected)
+
+        lmask = (iota_j == leader).astype(jnp.int32)
+        leader_faulty = jnp.sum(lmask * faulty, axis=1, keepdims=True)
+
+        # Round keys: fold_in(fold_in(base, ctr0 + rr), instance index)
+        # then split — jax's exact derivation chain, per row.
+        kr0, kr1 = _fold_in(k0c, k1c, jnp.full_like(k0c, ctr0) + rr)
+        ki0, ki1 = _fold_in(kr0, kr1, idxc)
+        (ka0, ka1), (kb0, kb1) = _split2(ki0, ki1)
+
+        # Round 1 (push): n coins per instance; faulty leader lies per
+        # recipient through the shared lie table, honest leader pushes
+        # the order, the leader itself always holds the true order.
+        coin1 = _coins(ka0, ka1, maps1)  # [B_pad, n_pad]
+        if scenario:
+            lstrat = jnp.sum(lmask * strat, axis=1, keepdims=True)
+            known, even_v, odd_v = lie_table(lstrat, jnp.int32)
+            coin1 = jnp.where(
+                known, jnp.where((iota_j & 1) == 0, even_v, odd_v), coin1
+            )
+        received = jnp.where(leader_faulty > 0, coin1, order)
+        received = jnp.where(lmask > 0, order, received)
+
+        # Round 2 (pull): the [B, n, n] answer cube — responder j lies
+        # to asker i with a fresh coin (or its strategy's table row);
+        # the diagonal is the general's own received command.
+        coin2 = _coins(kb0[:, None, :], kb1[:, None, :], maps2)
+        if scenario:
+            known2, ev2, ov2 = lie_table(strat[:, None, :], jnp.int32)
+            coin2 = jnp.where(
+                known2, jnp.where((recv_i & 1) == 0, ev2, ov2), coin2
+            )
+        lied = faulty[:, None, :] * (1 - eye)
+        answers = jnp.where(lied > 0, coin2, received[:, None, :])
+        weight = (alive * (1 - lmask))[:, None, :]
+        n_att = jnp.sum((answers == ATTACK) * weight, axis=2)
+        n_ret = jnp.sum((answers == RETREAT) * weight, axis=2)
+        maj = jnp.where(
+            n_att > n_ret,
+            jnp.int32(ATTACK),
+            jnp.where(n_ret > n_att, jnp.int32(RETREAT), jnp.int32(UNDEFINED)),
+        )
+        maj = jnp.where(lmask > 0, order, maj)
+
+        # Majority-of-majorities + the reference's 3f+1 thresholds
+        # (core/quorum.py formulas verbatim, incl. the zero-voter guard).
+        c_att = jnp.sum((maj == ATTACK) * alive, axis=1, keepdims=True)
+        c_ret = jnp.sum((maj == RETREAT) * alive, axis=1, keepdims=True)
+        c_und = jnp.sum((maj == UNDEFINED) * alive, axis=1, keepdims=True)
+        total = c_att + c_ret + c_und
+        needed = 2 * ((total - 1) // 3) + 1
+        needed = jnp.where(total <= 3, total - 1, needed)
+        needed = jnp.where(total == 1, 1, needed)
+        dec = jnp.where(
+            needed <= c_ret,
+            jnp.int32(RETREAT),
+            jnp.where(needed <= c_att, jnp.int32(ATTACK), jnp.int32(UNDEFINED)),
+        )
+        dec = jnp.where(total == 0, jnp.int32(UNDEFINED), dec)
+
+        # Per-instance property verdicts shared by both counter modes.
+        big = jnp.int32(127)  # the XLA delta's int8 sentinel
+        lt = alive * (1 - lmask)
+        mmax = jnp.max(jnp.where(lt > 0, maj, -big), axis=1, keepdims=True)
+        mmin = jnp.min(jnp.where(lt > 0, maj, big), axis=1, keepdims=True)
+        disagree = (mmax != mmin) & (
+            jnp.sum(lt, axis=1, keepdims=True) > 0
+        )
+        traitor = jnp.sum(faulty * alive, axis=1, keepdims=True) > 0
+        equivocation = (disagree & traitor).astype(jnp.int32)
+        if scenario:
+            hlt = lt * (1 - faulty)
+            hmax = jnp.max(jnp.where(hlt > 0, maj, -big), axis=1, keepdims=True)
+            hmin = jnp.min(jnp.where(hlt > 0, maj, big), axis=1, keepdims=True)
+            ic1 = (
+                (hmax != hmin)
+                & (jnp.sum(hlt, axis=1, keepdims=True) > 0)
+            ).astype(jnp.int32)
+            disobey = (
+                jnp.sum(hlt * (maj != order), axis=1, keepdims=True) > 0
+            )
+            ic2 = ((leader_faulty == 0) & disobey).astype(jnp.int32)
+
+        park = lane_r == rr
+        (acc_dec, acc_lead, acc_hist, acc_ctr) = acc
+        acc_dec = jnp.where(park, dec, acc_dec)
+        if scenario:
+            acc_lead = jnp.where(park, leader, acc_lead)
+        if slot_mode:
+            if with_counters:
+                cols = [
+                    (dec == UNDEFINED).astype(jnp.int32),
+                    jnp.ones_like(dec),  # one instance: always unanimous
+                    equivocation,
+                ]
+                if scenario:
+                    cols += [ic1, ic2]
+                ctr_cum = [c + d for c, d in zip(ctr_cum, cols)]
+                acc_ctr = [
+                    jnp.where(park, c, a) for c, a in zip(ctr_cum, acc_ctr)
+                ]
+            maj_keep = maj
+        else:
+            h0 = jnp.sum(valid_row * (dec == RETREAT), keepdims=True)
+            h1 = jnp.sum(valid_row * (dec == ATTACK), keepdims=True)
+            h2 = jnp.sum(valid_row * (dec == UNDEFINED), keepdims=True)
+            acc_hist = jnp.where(park, _col([h0, h1, h2]), acc_hist)
+            if with_counters:
+                qf = jnp.sum(valid_row * (dec == UNDEFINED), keepdims=True)
+                unanimous = (
+                    jnp.maximum(jnp.maximum(h0, h1), h2) == B
+                ).astype(jnp.int32)
+                eq = jnp.sum(valid_row * equivocation, keepdims=True)
+                deltas = [qf, unanimous, eq]
+                if scenario:
+                    deltas += [
+                        jnp.sum(valid_row * ic1, keepdims=True),
+                        jnp.sum(valid_row * ic2, keepdims=True),
+                    ]
+                ctr_cum = ctr_cum + _col(deltas)
+                acc_ctr = jnp.where(park, ctr_cum, acc_ctr)
+
+        acc = (acc_dec, acc_lead, acc_hist, acc_ctr)
+        return (alive, faulty, leader, strat, ctr_cum, maj_keep, acc)
+
+    zero_plane = jnp.zeros((B_pad, n_pad), jnp.int32)
+    zero_br = jnp.zeros((B_pad, R_pad), jnp.int32)
+    if with_counters:
+        if slot_mode:
+            ctr_init = [
+                ctr_in_ref[:, c : c + 1] for c in range(n_counters)
+            ]
+            acc_ctr0 = [zero_br for _ in range(n_counters)]
+        else:
+            ctr_init = ctr_in_ref[:]  # [_CPAD, 1]
+            acc_ctr0 = jnp.zeros((_CPAD, R_pad), jnp.int32)
+    else:
+        ctr_init, acc_ctr0 = jnp.zeros((1, 1), jnp.int32), zero_br
+    carry0 = (
+        alive_ref[:],
+        faulty_ref[:],
+        leader_ref[:],
+        strat_ref[:] if scenario else zero_plane,
+        ctr_init,
+        jnp.full((B_pad, n_pad), UNDEFINED, jnp.int32),
+        (
+            zero_br,  # decisions
+            zero_br,  # leaders
+            jnp.zeros((_CPAD, R_pad), jnp.int32),  # histogram bins
+            acc_ctr0,
+        ),
+    )
+    alive, faulty, leader, strat, _, maj_keep, acc = jax.lax.fori_loop(
+        0, rounds, body, carry0
+    )
+    acc_dec, acc_lead, acc_hist, acc_ctr = acc
+
+    out_alive_ref[:] = alive
+    out_faulty_ref[:] = faulty
+    out_leader_ref[:] = leader
+    if scenario:
+        out_strat_ref[:] = strat
+        out_lead_ref[:] = acc_lead
+    if slot_mode:
+        out_maj_ref[:] = maj_keep
+    out_dec_ref[:] = acc_dec
+    if not slot_mode:
+        out_hist_ref[:] = acc_hist
+    if with_counters:
+        if slot_mode:
+            for c in range(n_counters):
+                out_ctr_ref[c] = acc_ctr[c]
+        else:
+            out_ctr_ref[:] = acc_ctr
+
+
+def _key_cols(key_data, B: int, B_pad: int, slot_mode: bool):
+    """The per-row base-key word columns ([B_pad, 1] int32 x2) from a
+    KeySchedule's raw data ((2,) shared base, or [B, 2] per-slot)."""
+    kd = jax.lax.bitcast_convert_type(key_data, jnp.int32)
+    if slot_mode:
+        k0 = jnp.pad(kd[:, 0], (0, B_pad - B))[:, None]
+        k1 = jnp.pad(kd[:, 1], (0, B_pad - B))[:, None]
+    else:
+        k0 = jnp.broadcast_to(kd[0], (B_pad, 1)).astype(jnp.int32)
+        k1 = jnp.broadcast_to(kd[1], (B_pad, 1)).astype(jnp.int32)
+    return k0, k1
+
+
+def _megastep_call(
+    state: SimState,
+    sched,
+    strategy,
+    counters,
+    events,
+    *,
+    rounds: int,
+    scenario: bool,
+    slot_mode: bool,
+    with_counters: bool,
+    interpret: bool,
+):
+    """Trace-time: pad, stage the static coin maps, run the kernel, and
+    un-pad.  Returns ``(state, leaders[R,B] | None, maj[B,n] | None,
+    decisions[R,B], histograms[R,3] | None, counter_rows)`` — the
+    wrappers below reshape into their XLA twins' exact tuples."""
+    B, n = state.faulty.shape
+    B_pad = _pad_up(max(B, 1), SUBLANES)
+    n_pad = _pad_up(max(n, 1), LANES)
+    R_pad = _pad_up(rounds, LANES)
+    n_counters = 5 if scenario else 3
+
+    def pad2(x, fill=0):
+        return jnp.pad(
+            x.astype(jnp.int32),
+            ((0, B_pad - B), (0, n_pad - n)),
+            constant_values=fill,
+        )
+
+    def pad1(x):
+        return jnp.pad(x.astype(jnp.int32), (0, B_pad - B))[:, None]
+
+    maps1 = np.zeros((SUBLANES, n_pad), np.int32)
+    maps1[0:4, :n] = _word_maps(n, (n,))
+    maps2 = np.zeros((4, n_pad, n_pad), np.int32)
+    maps2[:, :n, :n] = _word_maps(n * n, (n, n))
+
+    k0, k1 = _key_cols(sched.key_data, B, B_pad, slot_mode)
+    idx = np.zeros((B_pad, 1), np.int32)
+    if not slot_mode:
+        # The campaign engine folds the GLOBAL instance index; the
+        # kernel is single-device, so that is just arange(B).
+        idx[:B, 0] = np.arange(B)
+
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    operands = [
+        jnp.reshape(sched.counter, (1,)).astype(jnp.int32),
+        jnp.asarray(maps1),
+        jnp.asarray(maps2),
+        pad1(state.order),
+        pad1(state.leader),
+        k0,
+        k1,
+        jnp.asarray(idx),
+        pad2(state.faulty),
+        pad2(state.alive),
+        pad2(state.ids),
+    ]
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + [vmem] * 10
+    if scenario:
+        operands.append(pad2(strategy))
+        in_specs.append(vmem)
+    if with_counters:
+        if slot_mode:
+            cpad = jnp.pad(
+                counters.astype(jnp.int32),
+                ((0, B_pad - B), (0, _CPAD - n_counters)),
+            )
+        else:
+            cpad = jnp.pad(
+                counters.astype(jnp.int32), (0, _CPAD - n_counters)
+            )[:, None]
+        operands.append(cpad)
+        in_specs.append(vmem)
+    if scenario:
+        for name, fill in (
+            ("kill", 0), ("revive", 0), ("set_faulty", -1),
+            ("set_strategy", -1),
+        ):
+            plane = events[name].astype(jnp.int32)
+            operands.append(
+                jnp.pad(
+                    plane,
+                    ((0, 0), (0, B_pad - B), (0, n_pad - n)),
+                    constant_values=fill,
+                )
+            )
+            in_specs.append(vmem)
+
+    S = jax.ShapeDtypeStruct
+    out_shape = [
+        S((B_pad, n_pad), jnp.int32),  # alive
+        S((B_pad, n_pad), jnp.int32),  # faulty
+        S((B_pad, 1), jnp.int32),  # leader
+    ]
+    if scenario:
+        out_shape.append(S((B_pad, n_pad), jnp.int32))  # strategy
+    if slot_mode:
+        out_shape.append(S((B_pad, n_pad), jnp.int32))  # majorities
+    out_shape.append(S((B_pad, R_pad), jnp.int32))  # decisions
+    if scenario:
+        out_shape.append(S((B_pad, R_pad), jnp.int32))  # leaders
+    if not slot_mode:
+        out_shape.append(S((_CPAD, R_pad), jnp.int32))  # histograms
+    if with_counters:
+        out_shape.append(
+            S((n_counters, B_pad, R_pad), jnp.int32)
+            if slot_mode
+            else S((_CPAD, R_pad), jnp.int32)
+        )
+
+    outs = pl.pallas_call(
+        functools.partial(
+            _megastep_kernel,
+            B=B,
+            n=n,
+            rounds=rounds,
+            scenario=scenario,
+            slot_mode=slot_mode,
+            with_counters=with_counters,
+        ),
+        grid=(1,),
+        in_specs=in_specs,
+        out_specs=[vmem] * len(out_shape),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)
+
+    it = iter(outs)
+    alive = next(it)[:B, :n] > 0
+    faulty = next(it)[:B, :n] > 0
+    leader = next(it)[:B, 0]
+    new_state = SimState(state.order, leader, faulty, alive, state.ids)
+    strat_out = (
+        next(it)[:B, :n].astype(jnp.int8) if scenario else None
+    )
+    maj = (
+        next(it)[:B, :n].astype(COMMAND_DTYPE) if slot_mode else None
+    )
+    decisions = next(it)[:B, :rounds].T.astype(COMMAND_DTYPE)
+    leaders = next(it)[:B, :rounds].T if scenario else None
+    histograms = (
+        next(it)[:3, :rounds].T if not slot_mode else None
+    )
+    if with_counters:
+        raw = next(it)
+        if slot_mode:
+            counter_rows = jnp.transpose(raw[:, :B, :rounds], (2, 1, 0))
+        else:
+            counter_rows = raw[:n_counters, :rounds].T
+    else:
+        counter_rows = None
+    return new_state, strat_out, maj, decisions, leaders, histograms, counter_rows
+
+
+def _check_supported(m: int, fn: str) -> None:
+    if m != 1:
+        raise ValueError(
+            f"{fn} supports OM(1) only (m == 1, got m={m}); the m >= 2 "
+            f"dense EIG tree stays on the XLA scan core "
+            f"(engine='xla'/'auto')"
+        )
+
+
+def _advance(sched, rounds: int):
+    # Lazy import: pipeline imports this module for the engine seam.
+    from ba_tpu.parallel.pipeline import KeySchedule
+
+    return KeySchedule(sched.key_data, sched.counter + rounds)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "rounds", "m", "max_liars", "unroll", "collect_decisions",
+        "interpret",
+    ),
+    donate_argnums=(0, 1, 2),
+)
+def pallas_scenario_megastep(  # ba-lint: donates(state, sched, strategy)
+    state: SimState,
+    sched,
+    strategy: jax.Array,
+    counters: jax.Array,
+    events: dict,
+    *,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+    interpret: bool = False,
+):
+    """The Pallas twin of ``parallel.pipeline.scenario_megastep``: same
+    arguments, same donation contract (state/sched/strategy consumed),
+    same return tuple ``(state, sched, strategy, histograms, leaders,
+    counter_rounds[, decisions])`` — bit-exact against the XLA scan
+    core under the same KeySchedule (tests/test_megastep.py pins every
+    output incl. the RANDOM coins).  ``unroll`` is accepted for
+    signature parity and ignored: the kernel's round loop is already
+    one fused dispatch.  ``max_liars`` likewise (OM(1) never reads it).
+    """
+    _check_supported(m, "pallas_scenario_megastep")
+    del max_liars, unroll
+    new_state, strat_out, _, decisions, leaders, histograms, rows = (
+        _megastep_call(
+            state, sched, strategy, counters, events,
+            rounds=rounds, scenario=True, slot_mode=False,
+            with_counters=True, interpret=interpret,
+        )
+    )
+    out = (_advance(sched, rounds), strat_out, histograms, leaders, rows)
+    if collect_decisions:
+        out += (decisions,)
+    return (new_state, *out)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "rounds", "m", "max_liars", "unroll", "collect_decisions",
+        "interpret",
+    ),
+    donate_argnums=(0, 1),
+)
+def pallas_pipeline_megastep(  # ba-lint: donates(state, sched)
+    state: SimState,
+    sched,
+    *,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+    counters: jax.Array | None = None,
+    interpret: bool = False,
+):
+    """The Pallas twin of ``parallel.pipeline.pipeline_megastep`` (the
+    plain non-mutating sweep): same signature, donation and return
+    tuple ``(state, sched, histograms[, decisions][, counter_rounds])``.
+    The kernel simply runs with no event planes and no strategy plane —
+    the RANDOM coin path, bit-exact vs the XLA core."""
+    _check_supported(m, "pallas_pipeline_megastep")
+    del max_liars, unroll
+    new_state, _, _, decisions, _, histograms, rows = _megastep_call(
+        state, sched, None, counters, None,
+        rounds=rounds, scenario=False, slot_mode=False,
+        with_counters=counters is not None, interpret=interpret,
+    )
+    out = (new_state, _advance(sched, rounds), histograms)
+    if collect_decisions:
+        out += (decisions,)
+    if counters is not None:
+        out += (rows,)
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rounds", "m", "max_liars", "unroll", "scenario",
+                     "interpret"),
+    donate_argnums=(0, 1, 2),
+)
+def pallas_coalesced_megastep(  # ba-lint: donates(state, sched, strategy)
+    state: SimState,
+    sched,
+    strategy: jax.Array | None,
+    slot_counters: jax.Array,
+    events: dict | None,
+    *,
+    rounds: int,
+    m: int = 1,
+    max_liars: int | None = None,
+    unroll: int = 1,
+    scenario: bool = False,
+    interpret: bool = False,
+):
+    """The Pallas twin of ``parallel.pipeline.coalesced_megastep`` (the
+    serving batch): per-slot base keys folding instance index 0,
+    per-slot counter blocks, the carried final-round majorities — same
+    signature, donation and return tuple ``(state, sched, strategy,
+    last_majorities, decisions, counter_rows[, leaders])``, so every
+    slot stays bit-identical to its own B=1 run whichever engine the
+    cohort resolved to."""
+    _check_supported(m, "pallas_coalesced_megastep")
+    del max_liars, unroll
+    new_state, strat_out, maj, decisions, leaders, _, rows = (
+        _megastep_call(
+            state, sched, strategy, slot_counters, events,
+            rounds=rounds, scenario=scenario, slot_mode=True,
+            with_counters=True, interpret=interpret,
+        )
+    )
+    out = (
+        new_state, _advance(sched, rounds),
+        strat_out if scenario else strategy, maj, decisions, rows,
+    )
+    if scenario:
+        out += (leaders,)
+    return out

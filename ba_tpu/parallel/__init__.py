@@ -26,15 +26,18 @@ from ba_tpu.parallel.mesh import make_mesh
 from ba_tpu.parallel.multihost import init_distributed, make_global_mesh, put_global
 from ba_tpu.parallel.pipeline import (
     COUNTER_NAMES,
+    ENGINES,
     SCENARIO_COUNTER_NAMES,
     CarryCheckpoint,
     KeySchedule,
     agreement_counters_init,
+    engine_support,
     fresh_copy,
     load_carry_checkpoint,
     make_key_schedule,
     pipeline_megastep,
     pipeline_sweep,
+    resolve_engine,
     round_keys,
     save_carry_checkpoint,
     scenario_counters_init,
@@ -57,6 +60,7 @@ __all__ = [
     "make_global_mesh",
     "put_global",
     "COUNTER_NAMES",
+    "ENGINES",
     "SCENARIO_COUNTER_NAMES",
     "CarryCheckpoint",
     "KeySchedule",
@@ -65,8 +69,10 @@ __all__ = [
     "load_carry_checkpoint",
     "make_key_schedule",
     "save_carry_checkpoint",
+    "engine_support",
     "pipeline_megastep",
     "pipeline_sweep",
+    "resolve_engine",
     "round_keys",
     "scenario_counters_init",
     "scenario_megastep",

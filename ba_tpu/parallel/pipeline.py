@@ -81,6 +81,130 @@ from ba_tpu.utils import snapshot as _snapshot
 # the histogram block), so BA101 and the no-blocking test stay clean.
 COUNTER_NAMES = ("quorum_failures", "unanimous_rounds", "equivocation_observed")
 
+# -- engine selection (ISSUE 13) ----------------------------------------------
+#
+# Two multi-round engines run the same round semantics: the XLA scan
+# cores in this module ("xla") and the fused Pallas megastep kernel
+# (ops/scenario_step.py — "pallas" compiles through Mosaic on TPU,
+# "interpret" runs the same kernel as jnp ops anywhere; both bit-exact
+# vs the scan cores incl. the threefry coin streams, which
+# tests/test_megastep.py pins).  `engine=` on the sweep entry points
+# selects per call; None reads BA_TPU_ENGINE (default "xla").  "auto"
+# prefers the Mosaic kernel where it is supported AND the platform is a
+# real TPU, silently-but-countedly falling back to the scan core
+# otherwise (stats["engine_fallback"] + the
+# pipeline_engine_fallback_total counter); an EXPLICIT "pallas"/
+# "interpret" on an unsupported combination raises eagerly, before any
+# buffer is donated.  The resolved value joins the compile-signature
+# axes, so an engine flip reads `"engine": ["xla", "pallas"]` in
+# recompile records and the cross-run ledger, and lands in the
+# `pipeline_engine` gauge as its ENGINE_IDS index.
+
+ENGINE_ENV = "BA_TPU_ENGINE"
+ENGINES = ("xla", "pallas", "interpret")
+ENGINE_IDS = {name: i for i, name in enumerate(ENGINES)}
+_ENGINE_REQUESTS = ENGINES + ("auto",)
+
+
+def engine_support(m: int = 1, n_shards: int = 1,
+                   signed: bool = False,
+                   meshed: bool = False) -> str | None:
+    """None when the Pallas megastep kernel covers this combination,
+    else the human-readable reason it cannot (the fallback table:
+    OM(1) only, no mesh, oral messages).  ``meshed`` covers the
+    mesh-with-data=1 case: EVERY mesh dispatch runs the
+    shard_map-wrapped XLA scan core, so a kernel request there would
+    otherwise record an engine that never ran."""
+    if signed:
+        return ("signed=True (the signed path host-signs between "
+                "rounds and never enters the scenario scan)")
+    if m != 1:
+        return f"m={m} (the dense EIG tree stays on the XLA scan core)"
+    if n_shards != 1 or meshed:
+        return (f"mesh data={n_shards} (every mesh dispatch runs the "
+                f"shard_map-wrapped XLA scan core; the kernel is "
+                f"single-device)")
+    return None
+
+
+def resolve_engine(engine: str | None, *, m: int = 1, n_shards: int = 1,
+                   signed: bool = False, meshed: bool = False):
+    """``(resolved, fallback_reason)`` for one sweep's engine request.
+
+    ``engine`` None reads ``BA_TPU_ENGINE`` (default ``"xla"``).
+    A CALL-SITE ``"pallas"``/``"interpret"`` raises eagerly on
+    unsupported combinations — the caller has not donated anything
+    yet.  The same token sourced from the ENV is a process-wide
+    preference, not a per-call demand: it falls back to ``"xla"`` with
+    the reason returned (counted, like ``"auto"``), so exporting
+    ``BA_TPU_ENGINE=pallas`` cannot break the mesh/EIG/signed paths it
+    never covered.  ``"pallas"`` off-TPU resolves to ``"interpret"``
+    (the house interpret= pattern: same kernel, jnp semantics), so the
+    RECORDED engine axis always names what actually ran.
+    """
+    explicit = engine is not None
+    requested = engine or os.environ.get(ENGINE_ENV) or "xla"
+    if requested not in _ENGINE_REQUESTS:
+        raise ValueError(
+            f"engine={requested!r} unknown (choose from "
+            f"{_ENGINE_REQUESTS}; None reads {ENGINE_ENV})"
+        )
+    if requested == "xla":
+        return "xla", None
+    reason = engine_support(m, n_shards, signed, meshed)
+    if requested == "auto":
+        if reason is not None:
+            return "xla", reason
+        platform = jax.devices()[0].platform
+        if platform != "tpu":
+            return "xla", (
+                f"platform={platform} (the Mosaic kernel engine is "
+                f"TPU-codegen; engine='interpret' forces the "
+                f"interpreter)"
+            )
+        return "pallas", None
+    if reason is not None:
+        if explicit:
+            raise ValueError(
+                f"engine={requested!r} unsupported: {reason}"
+            )
+        return "xla", reason  # env preference: counted fallback
+    if requested == "interpret":
+        return "interpret", None
+    if jax.devices()[0].platform == "tpu":
+        return "pallas", None
+    return "interpret", None
+
+
+def _engine_megasteps(engine: str):
+    """The (scenario_fn, plain_fn, coalesced_fn, extra_kwargs) tuple for
+    a RESOLVED engine — the one seam the dispatch loops swap callables
+    through.  Lazy kernel import: the XLA path must not pay for (or
+    depend on) the Pallas toolchain."""
+    if engine == "xla":
+        return scenario_megastep, pipeline_megastep, coalesced_megastep, {}
+    from ba_tpu.ops import scenario_step as _ss
+
+    return (
+        _ss.pallas_scenario_megastep,
+        _ss.pallas_pipeline_megastep,
+        _ss.pallas_coalesced_megastep,
+        {"interpret": engine == "interpret"},
+    )
+
+
+def _record_engine(reg, engine: str, fallback: str | None) -> None:
+    """One spelling of the engine bookkeeping: the `pipeline_engine`
+    gauge holds the ENGINE_IDS index of what actually ran (gauges are
+    numeric; the mapping is this module's ENGINES tuple, documented in
+    DESIGN.md), and a counted auto-fallback increments
+    `pipeline_engine_fallback_total`.  Set BEFORE the first dispatch so
+    a mid-campaign health sample reads THIS sweep's engine."""
+    reg.gauge("pipeline_engine").set(ENGINE_IDS[engine])
+    if fallback is not None:
+        reg.counter("pipeline_engine_fallback_total").inc()
+
+
 # Scenario campaigns (ISSUE 5) extend the block with per-round IC1/IC2
 # property verdicts — the Interactive Consistency conditions of the
 # Byzantine Generals paper, checked on device every round and drained at
@@ -962,11 +1086,32 @@ def _event_plane_specs(rounds: int, batch: int, capacity: int) -> dict:
     }
 
 
+def _engine_axis_kwargs(axes: dict, which: str) -> tuple:
+    """``(fn_override | None, extra kwargs)`` for one AOT spec's engine
+    axis (ISSUE 13): rows without the axis are pre-engine ledger rows —
+    the XLA core; kernel-engine rows lower the Pallas twin with its
+    interpret static, so a warm pallas cohort's executable is THE
+    executable the dispatch loop would have jit-compiled."""
+    engine = axes.get("engine", "xla")
+    if engine == "xla":
+        return None, {}
+    if engine not in ENGINES:
+        raise ValueError(f"unknown engine axis {engine!r} in AOT spec")
+    from ba_tpu.ops import scenario_step as _ss
+
+    fn = {
+        "coalesced": _ss.pallas_coalesced_megastep,
+        "pipeline": _ss.pallas_pipeline_megastep,
+        "scenario": _ss.pallas_scenario_megastep,
+    }[which]
+    return fn, {"interpret": engine == "interpret"}
+
+
 def coalesced_aot_spec(axes: dict):
     """``(jitted, args, kwargs)`` lowering one :func:`coalesced_megastep`
     specialization from its named axes signature (the serving
     dispatcher's dict: batch/capacity/rounds/m/max_liars/unroll/
-    scenario)."""
+    scenario/engine)."""
     S = jax.ShapeDtypeStruct
     B, n, nr = axes["batch"], axes["capacity"], axes["rounds"]
     scenario = bool(axes["scenario"])
@@ -978,8 +1123,9 @@ def coalesced_aot_spec(axes: dict):
     names = SCENARIO_COUNTER_NAMES if scenario else COUNTER_NAMES
     counters = S((B, len(names)), jnp.int32)
     events = _event_plane_specs(nr, B, n) if scenario else None
+    fn, extra = _engine_axis_kwargs(axes, "coalesced")
     return (
-        coalesced_megastep,
+        fn or coalesced_megastep,
         (_abstract_state(B, n), sched, strategy, counters, events),
         dict(
             rounds=nr,
@@ -987,6 +1133,7 @@ def coalesced_aot_spec(axes: dict):
             max_liars=axes["max_liars"],
             unroll=axes["unroll"],
             scenario=scenario,
+            **extra,
         ),
     )
 
@@ -1008,8 +1155,9 @@ def pipeline_aot_spec(axes: dict):
     counters = (
         S((len(COUNTER_NAMES),), jnp.int32) if axes["counters"] else None
     )
+    fn, extra = _engine_axis_kwargs(axes, "pipeline")
     return (
-        pipeline_megastep,
+        fn or pipeline_megastep,
         (_abstract_state(B, n), sched),
         dict(
             rounds=nr,
@@ -1018,6 +1166,7 @@ def pipeline_aot_spec(axes: dict):
             unroll=axes["unroll"],
             collect_decisions=axes["collect_decisions"],
             counters=counters,
+            **extra,
         ),
     )
 
@@ -1034,8 +1183,9 @@ def scenario_aot_spec(axes: dict):
     B, n, nr = axes["batch"], axes["capacity"], axes["rounds"]
     kshape, kdtype = _key_data_spec()
     sched = KeySchedule(key_data=S(kshape, kdtype), counter=S((), jnp.int32))
+    fn, extra = _engine_axis_kwargs(axes, "scenario")
     return (
-        scenario_megastep,
+        fn or scenario_megastep,
         (
             _abstract_state(B, n),
             sched,
@@ -1049,6 +1199,7 @@ def scenario_aot_spec(axes: dict):
             max_liars=axes["max_liars"],
             unroll=axes["unroll"],
             collect_decisions=axes["collect_decisions"],
+            **extra,
         ),
     )
 
@@ -1166,6 +1317,7 @@ def coalesced_sweep(  # ba-lint: donates(state)
     exec_seam=None,
     on_retire=None,
     executables=None,
+    engine: str | None = None,
 ):
     """Run a coalesced serving batch through the depth-k pipelined loop
     (ISSUE 10): B independent requests, one padded batch, bit-exact
@@ -1228,6 +1380,11 @@ def coalesced_sweep(  # ba-lint: donates(state)
         raise ValueError(
             f"rounds_per_dispatch={rounds_per_dispatch} must be >= 1"
         )
+    # Engine resolution (ISSUE 13): eager like the campaign path — an
+    # explicit kernel request that cannot serve this cohort raises
+    # before anything stages or donates; serving cohorts are always
+    # single-device, so only the m dial can exclude the kernel.
+    engine_resolved, engine_fallback = resolve_engine(engine, m=m)
     B, n = state.faulty.shape
     if len(slot_keys) != B:
         raise ValueError(
@@ -1304,16 +1461,20 @@ def coalesced_sweep(  # ba-lint: donates(state)
         m=m, max_liars=max_liars, depth=depth, unroll=unroll,
         is_scenario=is_scenario, exec_seam=exec_seam,
         on_retire=on_retire, run_id=rid, executables=executables,
+        engine_resolved=engine_resolved, engine_fallback=engine_fallback,
     )
     out["counter_names"] = list(names)
     out["stats"]["run_id"] = rid
+    out["stats"]["engine"] = engine_resolved
+    out["stats"]["engine_fallback"] = engine_fallback
     return out
 
 
 def _coalesced_loop(
     state, sched, strategy, counters, ev_planes, chunks, *,
     m, max_liars, depth, unroll, is_scenario, exec_seam, on_retire,
-    run_id=None, executables=None,
+    run_id=None, executables=None, engine_resolved="xla",
+    engine_fallback=None,
 ):
     """The coalesced driver's dispatch loop: the main engine's depth-k
     retire discipline, without scenario staging/checkpoint machinery
@@ -1323,9 +1484,12 @@ def _coalesced_loop(
     admission inputs) see serving traffic exactly like campaign
     traffic."""
     tracer = obs.default_tracer()
-    inst = _pipeline_instruments(obs.default_registry())
+    reg = obs.default_registry()
+    inst = _pipeline_instruments(reg)
     lat_h, lag_h, occ_h = inst["lat"], inst["lag"], inst["occ"]
     disp_c, ret_c, rounds_c = inst["disp"], inst["ret"], inst["rounds"]
+    _record_engine(reg, engine_resolved, engine_fallback)
+    _, _, coalesced_fn, engine_extra = _engine_megasteps(engine_resolved)
 
     inflight: collections.deque = collections.deque()
     retired = []
@@ -1365,6 +1529,7 @@ def _coalesced_loop(
             "max_liars": max_liars,
             "unroll": min(unroll, nr),
             "scenario": is_scenario,
+            "engine": engine_resolved,
         }
         ev = None
         if is_scenario:
@@ -1388,10 +1553,11 @@ def _coalesced_loop(
         ) as phase:
             with obs.xla.annotate("coalesced_dispatch", dispatch=d):
                 jit_call = functools.partial(
-                    coalesced_megastep,
+                    coalesced_fn,
                     state, sched, strategy, counters, ev,
                     rounds=nr, m=m, max_liars=max_liars,
                     unroll=min(unroll, nr), scenario=is_scenario,
+                    **engine_extra,
                 )
                 if exe is not None:
                     # The executable's call takes only the traced
@@ -1573,8 +1739,23 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
     on_rows=None,
     health_every: int | None = None,
     executables=None,
+    engine: str | None = None,
 ):
     """Run ``rounds`` sweep rounds through the depth-k pipelined engine.
+
+    ENGINE SELECTION (ISSUE 13): ``engine`` picks the megastep
+    implementation per the module-level table (``resolve_engine``):
+    ``"xla"`` (default via ``BA_TPU_ENGINE``) is the scan cores,
+    ``"pallas"``/``"interpret"`` the fused Pallas kernel
+    (``ops/scenario_step.py`` — bit-exact vs the scan cores incl. the
+    threefry coin streams), ``"auto"`` prefers the kernel on supported
+    combinations on real TPU and falls back silently-but-counted.
+    Explicit kernel requests on unsupported combinations (mesh
+    ``data > 1``, ``m >= 2``) raise eagerly, BEFORE any buffer is
+    donated.  The resolved value rides the compile-signature axes, the
+    ``pipeline_engine`` gauge and ``stats["engine"]``; everything else
+    — donation, depth-k retires, counters, checkpoints, resume — is
+    engine-agnostic (a campaign may resume under a different engine).
 
     Dispatches ``ceil(rounds / rounds_per_dispatch)`` donated megasteps
     (the last one sized to the remainder), keeping ``depth`` of them
@@ -1947,6 +2128,15 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
         # collapse to the canonical block — the sum is the invariant.
         counters = counters.sum(axis=0)
 
+    # Engine resolution (ISSUE 13): eager — an explicit kernel request
+    # on an unsupported combination must raise HERE, with nothing
+    # donated yet; an auto fallback resolves to the scan core and is
+    # counted below once stats exists.
+    engine_resolved, engine_fallback = resolve_engine(
+        engine, m=m, n_shards=n_shards, meshed=mesh is not None
+    )
+    scen_fn, plain_fn, _, engine_extra = _engine_megasteps(engine_resolved)
+
     span = rounds - start
     chunks = [rounds_per_dispatch] * (span // rounds_per_dispatch)
     if span % rounds_per_dispatch:
@@ -1996,6 +2186,7 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
     # buffers already carry the steady-state figures; the drain-time
     # set below recomputes on the final carry (same values).
     reg.gauge("pipeline_shards").set(n_shards)
+    _record_engine(reg, engine_resolved, engine_fallback)
     carry0 = (state, sched, counters, strategy)
     if mesh is not None:
         reg.gauge("pipeline_carry_bytes_per_shard").set(
@@ -2272,6 +2463,9 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
             "counters": with_counters,
             "data": n_shards,
             "scenario": scenario is not None,
+            # ISSUE 13: an engine flip at equal shapes is an EXPLAINED
+            # recompile — `"engine": ["xla", "pallas"]` in the record.
+            "engine": engine_resolved,
         }
         # Executable-cache consult (ISSUE 11, single-device only): a hit
         # dispatches the precompiled executable under a plain warm
@@ -2315,18 +2509,18 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
                                 exe, state, sched, strategy, counters, ev
                             ),
                             functools.partial(
-                                scenario_megastep,
+                                scen_fn,
                                 state, sched, strategy, counters, ev,
-                                **kwargs,
+                                **kwargs, **engine_extra,
                             ),
                             executables, "scenario_megastep", axes,
                             fell_back,
                         )
                     elif mesh is None:
                         call = functools.partial(
-                            scenario_megastep,
+                            scen_fn,
                             state, sched, strategy, counters, ev,
-                            **kwargs,
+                            **kwargs, **engine_extra,
                         )
                     else:
                         call = functools.partial(
@@ -2338,10 +2532,16 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
                         out = call()
                     else:
                         out = exec_seam(call, "dispatch", d, lo, hi)
-            if phase == "compile" and obs.xla.enabled() and mesh is None:
+            if (
+                phase == "compile" and obs.xla.enabled() and mesh is None
+                and engine_resolved == "xla"
+            ):
                 # Donated args keep their shape/dtype metadata after the
                 # dispatch consumes them, which is all abstractify reads
                 # (same contract the plain path relies on for kwargs).
+                # Kernel engines skip introspection: XLA's cost
+                # analysis reads a pallas_call as one opaque custom
+                # call, and the harvested numbers would be noise.
                 obs.xla.introspect(
                     scenario_megastep,
                     "scenario_megastep",
@@ -2374,14 +2574,16 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
                                 exe, state, sched, counters=counters
                             ),
                             functools.partial(
-                                pipeline_megastep, state, sched, **kwargs
+                                plain_fn, state, sched, **kwargs,
+                                **engine_extra,
                             ),
                             executables, "pipeline_megastep", axes,
                             fell_back,
                         )
                     elif mesh is None:
                         call = functools.partial(
-                            pipeline_megastep, state, sched, **kwargs
+                            plain_fn, state, sched, **kwargs,
+                            **engine_extra,
                         )
                     else:
                         call = functools.partial(
@@ -2392,7 +2594,10 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
                         out = call()
                     else:
                         out = exec_seam(call, "dispatch", d, lo, hi)
-            if phase == "compile" and obs.xla.enabled() and mesh is None:
+            if (
+                phase == "compile" and obs.xla.enabled() and mesh is None
+                and engine_resolved == "xla"
+            ):
                 # Device-tier artifact: AOT-harvest this specialization's
                 # cost/memory analysis (flops, bytes, donation-alias
                 # evidence).  The abstract signature is read off the
@@ -2524,6 +2729,8 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
             "shards": n_shards,
             "carry_bytes_per_shard": carry_bytes_per_shard,
             "health_samples": sampler.samples if sampler is not None else 0,
+            "engine": engine_resolved,
+            "engine_fallback": engine_fallback,
         },
     }
     if scenario is not None:
@@ -2586,7 +2793,8 @@ def scenario_sweep(  # ba-lint: donates(state)
     ``pipeline_sweep(..., scenario=block)`` with the round count read
     off the block, so every engine dial (``depth``,
     ``rounds_per_dispatch``, ``unroll``, ``mesh``, ``host_work``,
-    ``initial_strategy``, ``checkpoint_every``, ``resume``, ...) passes
+    ``initial_strategy``, ``checkpoint_every``, ``resume``,
+    ``engine``, ...) passes
     through unchanged (resuming: ``scenario_sweep(None, None, block,
     resume=ckpt)``).  DONATION: ``state`` is consumed exactly as in
     ``pipeline_sweep`` — thread the returned ``final_state``.

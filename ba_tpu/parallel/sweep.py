@@ -18,7 +18,9 @@ import jax.random as jr
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ba_tpu.core.eig import eig_round
-from ba_tpu.core.om import om1_round
+from ba_tpu.core.om import om1_round, om1_round_from_coins
+from ba_tpu.core.rng import coin_bits, coin_words, unpack_coin_words
+import ba_tpu.scenario.strategies as _strategies
 from ba_tpu.core.quorum import majority_counts, quorum_decision
 from ba_tpu.core.state import SimState
 from ba_tpu.parallel.multihost import put_global
@@ -189,7 +191,44 @@ def agreement_step(
         )
         return maj[0]
 
-    if strategies is None:
+    if m == 1 and not _strategies._impl_chain:
+        # OM(1) takes the COIN-INJECTED path (ISSUE 13): only the tiny
+        # per-instance draws run under vmap — split + the coin streams,
+        # exactly what the per-instance B=1 round would draw — and the
+        # round math runs BATCHED (om1_round_from_coins).  Bit-identical
+        # to vmapping the whole round (pinned), but the strategy lie
+        # selects under vmap were the measured ~2.3x-of-the-round
+        # XLA-CPU pathology the ROADMAP carried since ISSUE 5
+        # (megastep_ab's A/B legs re-measure both formulations).  On
+        # the strategies path the coins additionally unpack by GATHER
+        # (unpack_coin_words): coin_bits's transposing unpack, fused
+        # into the lie table's select tree, was most of that cost —
+        # same bits, row-major layout.  The legacy formulation stays
+        # reachable through strategies.chain_impl() (trace-time flag)
+        # as the A/B baseline.
+        n = state.faulty.shape[1]
+
+        if strategies is None:
+
+            def draw(k):
+                k1, k2 = jr.split(k)
+                return (
+                    coin_bits(k1, (1, n))[0],
+                    coin_bits(k2, (1, n, n))[0],
+                )
+
+            coins1, coins2 = jax.vmap(draw)(keys)
+        else:
+
+            def draw(k):
+                k1, k2 = jr.split(k)
+                return coin_words(k1, n), coin_words(k2, n * n)
+
+            w1, w2 = jax.vmap(draw)(keys)
+            coins1 = unpack_coin_words(w1, (n,))
+            coins2 = unpack_coin_words(w2, (n, n))
+        majorities = om1_round_from_coins(state, coins1, coins2, strategies)
+    elif strategies is None:
         majorities = jax.vmap(
             lambda k, o, l, f, a, i: one(k, o, l, f, a, i, None)
         )(

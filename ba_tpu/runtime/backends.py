@@ -281,7 +281,7 @@ class JaxBackend:
 
     def run_rounds(
         self, generals, leader_idx, order_code, seed, rounds,
-        host_work=None, executables=None,
+        host_work=None, executables=None, engine=None,
     ):
         """``rounds`` agreement rounds through the pipelined sweep engine.
 
@@ -308,6 +308,22 @@ class JaxBackend:
         import numpy as np
 
         if self.protocol != "om" or self.signed:
+            # Explicitly asking the kernel engine (ISSUE 13) to run a
+            # path that cannot be pipelined at all deserves a loud
+            # error, not the silent sequential fallback: the caller
+            # expressed an engine expectation the fallback would betray.
+            if engine in ("pallas", "interpret"):
+                from ba_tpu.parallel.pipeline import engine_support
+
+                raise ValueError(
+                    f"engine={engine!r} unsupported: "
+                    + (
+                        engine_support(signed=True)
+                        if self.signed
+                        else f"protocol={self.protocol!r} has no "
+                        f"pipelined path"
+                    )
+                )
             return None
 
         from ba_tpu.parallel.pipeline import (
@@ -339,6 +355,7 @@ class JaxBackend:
             with_counters=True,
             host_work=host_work,
             executables=executables,
+            engine=engine,
         )
         # Per-general block for the LAST round: recompute it from the same
         # key schedule (counter = rounds - 1).  Bit-exact with what the
@@ -380,6 +397,7 @@ class JaxBackend:
         mesh=None,
         health_every=None,
         executables=None,
+        engine=None,
     ):
         """A declarative scenario campaign on the B=1 interactive cluster.
 
@@ -474,6 +492,7 @@ class JaxBackend:
             mesh=mesh,
             health_every=health_every,
             executables=executables,
+            engine=engine,
         )
         if supervise:
             from ba_tpu.runtime.supervisor import supervised_sweep

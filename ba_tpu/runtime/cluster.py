@@ -315,6 +315,7 @@ class Cluster:
         fault_plan=None,
         mesh=None,
         health_every=None,
+        engine=None,
     ):
         """Run a declarative scenario campaign (ba_tpu.scenario) on this
         cluster: the whole ``g-kill``/``g-add``/``g-state`` REPL session
@@ -339,6 +340,10 @@ class Cluster:
         ``health_every`` (ISSUE 9) threads into the engine's live
         health sampler: one ``health_snapshot`` per N dispatches from
         the host_work overlap slot, zero added synchronization.
+        ``engine`` (ISSUE 13) picks the megastep implementation
+        (``xla`` / ``pallas`` / ``interpret`` / ``auto`` — the
+        engine-select seam in ``parallel/pipeline.py``); unsupported
+        requests surface the seam's one-line eager ValueError.
 
         The backend (``run_scenario``) compiles the spec against the
         current roster and drives the mutating megastep; afterwards the
@@ -376,6 +381,7 @@ class Cluster:
                 fault_plan=fault_plan,
                 mesh=mesh,
                 health_every=health_every,
+                engine=engine,
             )
         if res is None:
             return None

@@ -171,6 +171,19 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
         # (more devices than exist, a data axis the B=1 batch cannot
         # split) surfaces as one error line, never a traceback.
         args = [t for t in cmd[1:] if t]
+        engine = None
+        for tok in list(args):
+            # ISSUE 13: `engine=xla|pallas|interpret|auto` routes the
+            # campaign through the engine-select seam; an unsupported
+            # request surfaces as the engine's one-line eager error
+            # below, never a traceback.
+            if tok.startswith("engine="):
+                engine = tok[len("engine="):]
+                if not engine:
+                    out("scenario error: engine= wants one of "
+                        "xla|pallas|interpret|auto")
+                    return True
+                args.remove(tok)
         mesh_n = None
         for tok in list(args):
             if tok.startswith("mesh="):
@@ -197,7 +210,7 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             # and the user would only find out at resume time.
             out("scenario error: checkpoint path given without <every> "
                 "(usage: scenario <file> [<ckpt-path> <every>] "
-                "[supervise] [mesh=N])")
+                "[supervise] [mesh=N] [engine=...])")
             return True
         if len(args) > 3:
             # Like the path-without-<every> case: extra tokens mean the
@@ -205,7 +218,7 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             # loudly rather than silently dropping them.
             out("scenario error: too many arguments "
                 "(usage: scenario <file> [<ckpt-path> <every>] "
-                "[supervise] [mesh=N])")
+                "[supervise] [mesh=N] [engine=...])")
             return True
         if len(args) == 3:
             ck_path = args[1]
@@ -234,7 +247,7 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
                 mesh = make_mesh((mesh_n, 1), ("data", "node"))
             ran = cluster.run_scenario(
                 spec, checkpoint_every=ck_every, checkpoint_path=ck_path,
-                supervise=supervise, mesh=mesh,
+                supervise=supervise, mesh=mesh, engine=engine,
             )
         except (OSError, ValueError, ImportError, SupervisorError) as e:
             # ImportError: `mesh=N` on a jax-less install (PyBackend
@@ -299,13 +312,14 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             names = {"queue": ("max_queue", int),
                      "window": ("coalesce_window_s", float),
                      "batch": ("max_batch", int),
-                     "warm": ("warm", int)}
+                     "warm": ("warm", int),
+                     "engine": ("engine", str)}
             for tok in args[1:]:
                 key, sep, val = tok.partition("=")
                 if not sep or key not in names:
                     out(f"serve error: unknown option {tok!r} (usage: "
                         f"serve start [queue=N] [window=S] [batch=N] "
-                        f"[warm=0|1])")
+                        f"[warm=0|1] [engine=xla|pallas|interpret|auto])")
                     return True
                 field, cast = names[key]
                 try:

@@ -95,6 +95,10 @@ from ba_tpu.utils import metrics as _metrics
 
 REQUEST_KINDS = ("actual-order", "run-rounds", "scenario")
 ORDERS = ("attack", "retreat")
+# Engine request tokens (ISSUE 13) — the jax-free spelling of
+# parallel.pipeline's request set (this module must validate admissions
+# without touching the engine; the equality is test-pinned).
+ENGINE_TOKENS = ("xla", "pallas", "interpret", "auto")
 # Admission outcomes the `admission` record's `reason` field may carry.
 REJECT_REASONS = ("queue_full", "shed_interactive", "shed_all")
 
@@ -184,6 +188,13 @@ class ServeConfig:
     #                                 is known interactive-only)
     aot_cache: str | None = None   # executable-cache dir; None = the
     #                                 BA_TPU_AOT_CACHE / default dir
+    engine: str = "xla"            # ISSUE 13: the service's default
+    #                                 megastep engine (requests may
+    #                                 override per-request); resolved
+    #                                 by the engine-select seam at
+    #                                 dispatch time, part of the
+    #                                 cohort key so engines never
+    #                                 share a batch
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -227,6 +238,10 @@ class ServeConfig:
                 raise ValueError(
                     f"warm_capacities entry {cap!r} must be an int >= 1"
                 )
+        if self.engine not in ENGINE_TOKENS:
+            raise ValueError(
+                f"engine={self.engine!r} not in {ENGINE_TOKENS}"
+            )
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -244,6 +259,8 @@ class ServeConfig:
             env["default_deadline_s"] = None if raw == "" else float(raw)
         if "BA_TPU_WARM" in os.environ:
             env["warm"] = os.environ["BA_TPU_WARM"] not in ("", "0")
+        if os.environ.get("BA_TPU_ENGINE"):
+            env["engine"] = os.environ["BA_TPU_ENGINE"]
         env.update(overrides)
         return cls(**env)
 
@@ -302,6 +319,10 @@ class AgreementRequest:
     seed: int = 0
     rounds: int = 1
     spec: object = None
+    # ISSUE 13: per-request megastep engine override (None = the
+    # service's configured default).  Joins the cohort key — an engine
+    # request never coalesces into another engine's batch.
+    engine: str | None = None
 
 
 def validate_request(req: AgreementRequest) -> AgreementRequest:
@@ -322,6 +343,10 @@ def validate_request(req: AgreementRequest) -> AgreementRequest:
             raise ValueError(
                 f"faulty index {i!r} outside roster [0, {req.n})"
             )
+    if req.engine is not None and req.engine not in ENGINE_TOKENS:
+        raise ValueError(
+            f"engine={req.engine!r} not in {ENGINE_TOKENS}"
+        )
     if req.kind == "scenario":
         if req.spec is None:
             raise ValueError("kind='scenario' needs a spec")
@@ -341,12 +366,15 @@ def request_rounds(req: AgreementRequest) -> int:
     return req.spec.rounds if req.kind == "scenario" else req.rounds
 
 
-def cohort_key(req: AgreementRequest) -> tuple:
+def cohort_key(req: AgreementRequest, default_engine: str = "xla") -> tuple:
     """Requests sharing this key coalesce into one batch: same compiled
-    specialization (round count, padded capacity, scenario-ness) —
+    specialization (round count, padded capacity, scenario-ness, and —
+    ISSUE 13 — the effective engine request, so pallas and xla cohorts
+    never share a batch; the dispatcher passes its config's default) —
     orders, seeds, fault patterns and event planes are per-slot DATA."""
     return (
-        req.kind == "scenario", request_rounds(req), _capacity(req.n)
+        req.kind == "scenario", request_rounds(req), _capacity(req.n),
+        req.engine or default_engine,
     )
 
 
@@ -732,7 +760,7 @@ class AgreementService:
                 head = t
                 break
             if head is not None:
-                ckey = cohort_key(head.request)
+                ckey = cohort_key(head.request, self._cfg.engine)
                 cohort = [head]
                 window_end = time.perf_counter() + self._window_s
                 while len(cohort) < self._cfg.max_batch:
@@ -747,7 +775,8 @@ class AgreementService:
                             expired.append(t)
                         elif (
                             len(cohort) < self._cfg.max_batch
-                            and cohort_key(t.request) == ckey
+                            and cohort_key(t.request, self._cfg.engine)
+                            == ckey
                         ):
                             cohort.append(t)
                         else:
@@ -969,7 +998,9 @@ class AgreementService:
 
         import jax.numpy as jnp
 
-        is_scenario, rounds, cap = cohort_key(live[0].request)
+        is_scenario, rounds, cap, engine = cohort_key(
+            live[0].request, self._cfg.engine
+        )
         n_live = len(live)
         B = min(_batch_bucket(n_live), _batch_bucket(self._cfg.max_batch))
         # Filler slots replicate slot 0 under a fixed key: independent
@@ -1021,6 +1052,7 @@ class AgreementService:
             scenario=planes,
             exec_seam=self._seam,
             executables=self._exec_cache,
+            engine=engine,
         )
         # Warm-serving accounting (ISSUE 11): every dispatch window that
         # compiled ON the request path is a counted event — the "warm
@@ -1124,6 +1156,10 @@ class AgreementService:
             ),
             "compiles_on_request_path": self._rpc_n,
             "warm": self._cfg.warm,
+            # ISSUE 13: the configured default engine dial (per-request
+            # overrides ride the cohort key; what actually RAN is the
+            # engine's own pipeline_engine gauge + stats).
+            "engine": self._cfg.engine,
         }
         if self._warmup is not None:
             prog = self._warmup.progress()

@@ -94,6 +94,7 @@ def bucket_lattice(
     rounds: int | None = None,
     m: int = 1,
     scenarios=(False,),
+    engines=("xla",),
 ) -> list:
     """The serving dispatcher's reachable coalesced specializations:
     ``(fn, axes)`` pairs over every power-of-two batch bucket up to the
@@ -124,31 +125,38 @@ def bucket_lattice(
         if rounds % rounds_per_dispatch:
             windows.add(rounds % rounds_per_dispatch)
     plan = []
-    for scenario in scenarios:
-        for cap in capacities:
-            if cap < 1:
-                raise ValueError(f"capacity {cap} must be >= 1")
-            for batch in buckets:
-                for window in sorted(windows):
-                    plan.append(
-                        (
-                            "coalesced_megastep",
-                            {
-                                "batch": batch,
-                                "capacity": cap,
-                                "rounds": window,
-                                "m": m,
-                                "max_liars": None,
-                                # Literal 1 = coalesced_sweep's unroll
-                                # default (serve never overrides it); if
-                                # serving ever grows an unroll dial this
-                                # must track min(unroll, window) or warm
-                                # lookups silently stop matching.
-                                "unroll": 1,
-                                "scenario": bool(scenario),
-                            },
+    for engine in engines:
+        for scenario in scenarios:
+            for cap in capacities:
+                if cap < 1:
+                    raise ValueError(f"capacity {cap} must be >= 1")
+                for batch in buckets:
+                    for window in sorted(windows):
+                        plan.append(
+                            (
+                                "coalesced_megastep",
+                                {
+                                    "batch": batch,
+                                    "capacity": cap,
+                                    "rounds": window,
+                                    "m": m,
+                                    "max_liars": None,
+                                    # Literal 1 = coalesced_sweep's
+                                    # unroll default (serve never
+                                    # overrides it); if serving ever
+                                    # grows an unroll dial this must
+                                    # track min(unroll, window) or warm
+                                    # lookups silently stop matching.
+                                    "unroll": 1,
+                                    "scenario": bool(scenario),
+                                    # ISSUE 13: the engine is a compile
+                                    # axis — a warm lookup without it
+                                    # would never match the dispatch
+                                    # loop's signature.
+                                    "engine": engine,
+                                },
+                            )
                         )
-                    )
     return plan
 
 
@@ -175,8 +183,39 @@ def ledger_replay_set(fns=WARM_FNS) -> list:
             axes = {k: v for k, v in core.items() if k not in env}
             if axes.get("data", 1) != 1:
                 continue
+            # Pre-ISSUE-13 ledger rows carry no engine axis: they were
+            # XLA-core compiles, so upgrading them in place keeps a
+            # pre-upgrade ledger warming the post-upgrade dispatch
+            # signatures instead of going uniformly cold.
+            axes.setdefault("engine", "xla")
+            if axes["engine"] not in ("xla", "pallas", "interpret"):
+                continue
             out.append((fn, axes))
     return out
+
+
+def plan_engines(config) -> tuple:
+    """The engine axis values this service's dispatch loop can produce
+    (ISSUE 13): the XLA core always (the fallback every request can
+    land on), plus the RESOLVED kernel engine when the config asks for
+    one — so a ``BA_TPU_ENGINE=pallas`` service warms BOTH engines'
+    signatures.  Resolution needs the platform, hence the
+    function-local engine import — the default "xla" path stays
+    jax-free (plan construction's contract).  An unsupported kernel
+    request warms only the XLA core: its cohorts will error at
+    dispatch, and warming the error is not a thing."""
+    requested = getattr(config, "engine", "xla") or "xla"
+    if requested == "xla":
+        return ("xla",)
+    from ba_tpu.parallel.pipeline import resolve_engine
+
+    try:
+        resolved, _ = resolve_engine(requested, m=getattr(config, "m", 1))
+    except ValueError:
+        return ("xla",)
+    if resolved == "xla":
+        return ("xla",)
+    return ("xla", resolved)
 
 
 def service_plan(config) -> list:
@@ -195,6 +234,7 @@ def service_plan(config) -> list:
         rounds=config.warm_rounds,
         m=config.m,
         scenarios=(False, True) if config.warm_scenarios else (False,),
+        engines=plan_engines(config),
     )
     seen: set = set()
     deduped = []

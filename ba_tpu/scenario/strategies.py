@@ -7,7 +7,7 @@ BFT evaluation since, PBFT-style colluding traitors included) are
 driven by *coordinated* strategies.  This module upgrades the fault
 model: each general carries an int8 strategy id, and the send paths of
 ``core/om.py`` / ``core/eig.py`` / ``core/sm.py`` transform their
-existing coin tensors through one branch-free select — vmap/scan stay
+existing coin tensors through branch-free arithmetic — vmap/scan stay
 fused, and the RANDOM row is the identity on the coins, which is what
 keeps the legacy paths bit-exact (tests/test_scenario.py pins it).
 
@@ -34,6 +34,24 @@ applies these values under its existing ``faulty`` masks, so honest
 generals never lie regardless of their strategy id — and a faulty
 general still *tallies* honestly (SURVEY.md Q3 is untouched).
 
+FORMULATION (ISSUE 13): the original implementation was a chain of
+nested ``jnp.where`` selects — one per strategy row, each depending on
+the previous — which XLA-CPU lowers as a serial select chain it cannot
+vectorize across (the measured ~3x strategy-select pathology the
+ROADMAP carried since ISSUE 5).  The current form is a precomputed
+**lie table** (:func:`lie_table`): the per-strategy value planes build
+ONCE at strategy shape (one-hot masks into multiply-adds — tiny), and
+the cube-shaped send path pays exactly TWO selects (receiver-parity
+pick, then known-row vs coin) instead of the four-deep chain over the
+full answer cube.  The Pallas megastep kernel
+(``ops/scenario_step.py``) evaluates the SAME table in-kernel — one
+formulation, two engines.  The legacy select
+chains are kept verbatim (:func:`lie_values_chain`,
+:func:`send_gate_chain`) as the A/B baseline and parity oracle
+(``bench.py megastep_ab`` dispatches on ``BA_TPU_STRATEGY_CHAIN`` /
+:func:`chain_impl`); both formulations are bit-identical for coins in
+{0, 1} and any int8 strategy id, which tests/test_megastep.py pins.
+
 Import discipline: this module imports ONLY jax — never ``ba_tpu.core``
 (the core send paths import it, and a back-edge would cycle through the
 package inits).  The command codes are therefore pinned locally;
@@ -41,6 +59,9 @@ tests assert they match ``core.types``.
 """
 
 from __future__ import annotations
+
+import contextlib
+import os
 
 import jax.numpy as jnp
 
@@ -59,6 +80,76 @@ ADAPTIVE_SPLIT = 4
 
 STRATEGY_DTYPE = jnp.int8
 
+# Trace-time implementation dial for the megastep_ab bench: "chain"
+# re-traces the legacy nested-select formulation so the branch-free
+# rewrite can be A/B-measured in one process (the bench clears the
+# megastep jit caches between legs — a live program never re-traces on
+# a flag flip alone).  Read at TRACE time; anything but "chain" is the
+# branch-free table.
+_IMPL_ENV = "BA_TPU_STRATEGY_CHAIN"
+_impl_chain = os.environ.get(_IMPL_ENV, "") == "1"
+
+
+@contextlib.contextmanager
+def chain_impl(enabled: bool = True):
+    """Trace the LEGACY strategy formulation inside this context: the
+    nested select chains here AND the per-instance vmapped round in
+    ``parallel.sweep.agreement_step`` (the pre-ISSUE-13 structure the
+    two read together).  Bench A/B only — callers must clear the
+    affected jit caches so the flag is seen at trace time."""
+    global _impl_chain
+    prev = _impl_chain
+    _impl_chain = enabled
+    try:
+        yield
+    finally:
+        _impl_chain = prev
+
+
+def lie_table(strategy, dtype):
+    """The precomputed lie table at STRATEGY shape: ``(known, even_v,
+    odd_v)``.
+
+    ``known`` (bool) marks ids with a deterministic table row; the two
+    value planes are what such a sender says to even- and odd-indexed
+    receivers (receiver parity is ADAPTIVE_SPLIT's only receiver
+    dependence — every other row is receiver-free):
+
+    ========================  ======  =======  =======
+    strategy                  known   even_v   odd_v
+    ========================  ======  =======  =======
+    RANDOM / unknown ids      False   (coin)   (coin)
+    COLLUDE_ATTACK            True    ATTACK   ATTACK
+    COLLUDE_RETREAT           True    RETREAT  RETREAT
+    SILENT                    True    UNDEF    UNDEF
+    ADAPTIVE_SPLIT            True    ATTACK   RETREAT
+    ========================  ======  =======  =======
+
+    The table is built ONCE at the (small) strategy shape — one-hot
+    masks into multiply-adds, no cube-sized work — so the cube-shaped
+    caller pays exactly TWO selects (parity pick + known/coin pick)
+    where the legacy formulation paid a four-deep select chain over the
+    full answer cube.  Unknown ids read ``known = False`` — the chain's
+    fall-through to the coin.  Shared verbatim by the XLA send paths
+    and the Pallas megastep kernel (``ops/scenario_step.py``), which
+    evaluates the same table in int32 lanes.
+    """
+    m1 = (strategy == COLLUDE_ATTACK).astype(dtype)
+    m3 = (strategy == SILENT).astype(dtype)
+    m4 = (strategy == ADAPTIVE_SPLIT).astype(dtype)
+    known = (
+        m1 + (strategy == COLLUDE_RETREAT).astype(dtype) + m3 + m4
+    ) > 0
+    # COLLUDE_RETREAT's value rows are RETREAT == 0: the row exists
+    # only through `known` (both planes already default to 0).
+    even_v = (
+        m1 * jnp.asarray(_ATTACK, dtype)
+        + m3 * jnp.asarray(_UNDEFINED, dtype)
+        + m4 * jnp.asarray(_ATTACK, dtype)
+    )
+    odd_v = even_v - m4 * jnp.asarray(_ATTACK - _RETREAT, dtype)
+    return known, even_v, odd_v
+
 
 def lie_values(strategy, coins, receiver_index) -> jnp.ndarray:
     """Per-message lie values for ORAL sends (OM answer cubes, EIG relay
@@ -71,22 +162,22 @@ def lie_values(strategy, coins, receiver_index) -> jnp.ndarray:
     ``faulty`` masks exactly where the raw coins used to go.  All-RANDOM
     strategies return ``coins`` unchanged (bit-exact legacy parity).
 
+    Two cube-sized selects over the precomputed :func:`lie_table` —
+    the branch-free replacement for the legacy four-deep select chain
+    (``lie_values_chain``); bit-identical for coins in {0, 1} and any
+    int8 strategy id (test-pinned).
+
     Every constant is staged in ``coins.dtype`` up front: a python-int
-    constant in a ``where`` silently promotes the whole select chain to
-    int32, and the resulting per-element int8<->int32 converts in the
+    constant in this arithmetic silently promotes the whole expression
+    to int32, and the resulting per-element int8<->int32 converts in the
     send-cube's innermost loop cost ~3x wall clock on the CPU backend
     (measured while landing ISSUE 5) against +40% nominal flops.
     """
-    attack = jnp.asarray(_ATTACK, coins.dtype)
-    retreat = jnp.asarray(_RETREAT, coins.dtype)
-    undefined = jnp.asarray(_UNDEFINED, coins.dtype)
-    split = jnp.where((receiver_index & 1) == 0, attack, retreat)
-    v = coins
-    v = jnp.where(strategy == COLLUDE_ATTACK, attack, v)
-    v = jnp.where(strategy == COLLUDE_RETREAT, retreat, v)
-    v = jnp.where(strategy == SILENT, undefined, v)
-    v = jnp.where(strategy == ADAPTIVE_SPLIT, split, v)
-    return v
+    if _impl_chain:
+        return lie_values_chain(strategy, coins, receiver_index)
+    known, even_v, odd_v = lie_table(strategy, coins.dtype)
+    table_v = jnp.where((receiver_index & 1) == 0, even_v, odd_v)
+    return jnp.where(known, table_v, coins)
 
 
 def send_gate(strategy, coins, receiver_index, value_index) -> jnp.ndarray:
@@ -103,7 +194,57 @@ def send_gate(strategy, coins, receiver_index, value_index) -> jnp.ndarray:
     ``coins`` unchanged.  The chain-length soundness bound and the
     "sender must hold the value" mask stay with the caller — a gate can
     only restrict what the exact model already allowed.
+
+    Branch-free like :func:`lie_values`: disjoint strategy masks turn
+    the select chain into one AND/OR tree (SILENT contributes nothing —
+    its gate is constant False, expressed by masking the coin off
+    through ``known`` without adding a term).
     """
+    if _impl_chain:
+        return send_gate_chain(strategy, coins, receiver_index, value_index)
+    is_attack = value_index == 1
+    m1 = strategy == COLLUDE_ATTACK
+    m2 = strategy == COLLUDE_RETREAT
+    m3 = strategy == SILENT
+    m4 = strategy == ADAPTIVE_SPLIT
+    known = m1 | m2 | m3 | m4
+    split = (receiver_index % 2 == 0) == is_attack
+    return (
+        (coins & ~known)
+        | (m1 & is_attack)
+        | (m2 & ~is_attack)
+        | (m4 & split)
+    )
+
+
+# -- legacy select-chain formulation ------------------------------------------
+#
+# The pre-ISSUE-13 implementations, kept verbatim: the megastep_ab
+# bench's baseline leg (what the strategy cost looked like before the
+# rewrite) and the parity oracle the branch-free table is pinned
+# against.  Semantically identical by construction — same fall-through
+# for unknown ids, same value set — never called on a hot path unless
+# BA_TPU_STRATEGY_CHAIN=1 / chain_impl() re-traces it deliberately.
+
+
+def lie_values_chain(strategy, coins, receiver_index) -> jnp.ndarray:
+    """The nested-select formulation of :func:`lie_values` (A/B
+    baseline; bit-identical outputs)."""
+    attack = jnp.asarray(_ATTACK, coins.dtype)
+    retreat = jnp.asarray(_RETREAT, coins.dtype)
+    undefined = jnp.asarray(_UNDEFINED, coins.dtype)
+    split = jnp.where((receiver_index & 1) == 0, attack, retreat)
+    v = coins
+    v = jnp.where(strategy == COLLUDE_ATTACK, attack, v)
+    v = jnp.where(strategy == COLLUDE_RETREAT, retreat, v)
+    v = jnp.where(strategy == SILENT, undefined, v)
+    v = jnp.where(strategy == ADAPTIVE_SPLIT, split, v)
+    return v
+
+
+def send_gate_chain(strategy, coins, receiver_index, value_index) -> jnp.ndarray:
+    """The nested-select formulation of :func:`send_gate` (A/B
+    baseline; bit-identical outputs)."""
     is_attack = value_index == 1
     split = (receiver_index % 2 == 0) == is_attack
     g = coins

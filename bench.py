@@ -2787,6 +2787,157 @@ def _obs_finalize(obs_dir: str, platform: str) -> None:
         f.write(reg.prometheus_text())
 
 
+def bench_megastep_ab(jax, jnp, jr):
+    """ISSUE 13: the one-kernel mutating round A/B — three legs over an
+    IDENTICAL strategy-mixed churn campaign, bit-exactness asserted
+    between every pair before any timing is believed:
+
+    1. ``xla_chain``      — the XLA scan core with the PRE-ISSUE-13
+       nested-select strategy formulation (``strategies.chain_impl()``
+       re-traces it; the megastep jit cache is cleared so the flag is
+       seen at trace time).  The historical baseline.
+    2. ``xla_branchfree`` — the XLA scan core with the branch-free
+       lie-table strategies (today's default).  chain -> branchfree is
+       the CPU-measurable part of the ISSUE: the select-chain
+       pathology removed at equal semantics.
+    3. ``kernel``         — the fused Pallas megastep
+       (``ops/scenario_step.py``) via ``engine="pallas"``: Mosaic on a
+       real TPU (the raw-speed goal's leg — <4x vs the fused sweep
+       kernel rides the consolidated tunnel pass), the interpreter
+       elsewhere (the leg still proves end-to-end dispatch + bit
+       parity; its CPU wall clock is the INTERPRETER's and is reported
+       as such, never as kernel speed).
+
+    All three legs run the same ``scenario_sweep`` driver — depth-k
+    retires, donated carries, staged planes — so the deltas are the
+    round formulation only.  Campaign: every strategy id present, ~2%
+    kills + 1% revives + strategy churn per round.
+    """
+    import numpy as np
+
+    from ba_tpu.parallel import fresh_copy, make_sweep_state, scenario_sweep
+    from ba_tpu.parallel.pipeline import scenario_megastep
+    from ba_tpu.scenario import strategies as strat_mod
+    from ba_tpu.scenario.compile import ScenarioBlock
+
+    # The scenario_sweep production shape (BENCH_scenario_r8.json):
+    # the strategy pathology only shows where the answer cube is real
+    # work — small shapes are dispatch-overhead-dominated and read ~1x.
+    batch = int(os.environ.get("BA_TPU_BENCH_MEGA_BATCH", 2048))
+    cap = int(os.environ.get("BA_TPU_BENCH_MEGA_CAP", 64))
+    rounds = int(os.environ.get("BA_TPU_BENCH_MEGA_ROUNDS", 64))
+    per_dispatch = int(os.environ.get("BA_TPU_BENCH_MEGA_KPD", 8))
+    depth = int(os.environ.get("BA_TPU_PIPELINE_DEPTH", 2))
+    reps = 3
+
+    state = make_sweep_state(make_key(40), batch, cap)
+    rng = np.random.default_rng(41)
+    strat0 = jnp.asarray(rng.integers(0, 5, (batch, cap)).astype(np.int8))
+    block = ScenarioBlock(
+        kill=rng.random((rounds, batch, cap)) < 0.02,
+        revive=rng.random((rounds, batch, cap)) < 0.01,
+        set_faulty=np.full((rounds, batch, cap), -1, np.int8),
+        set_strategy=np.where(
+            rng.random((rounds, batch, cap)) < 0.05,
+            rng.integers(0, 5, (rounds, batch, cap)), -1
+        ).astype(np.int8),
+    )
+
+    def run(st, engine):
+        return scenario_sweep(
+            make_key(42), st, block, initial_strategy=strat0,
+            depth=depth, rounds_per_dispatch=per_dispatch,
+            collect_decisions=True, engine=engine,
+        )
+
+    def leg_outputs(out):
+        return (
+            out["decisions"], out["leaders"], out["histograms"],
+            out["counters_per_round"],
+            np.asarray(out["final_strategy"]),
+        )
+
+    def identical(a, b):
+        return all(
+            np.array_equal(x, y) for x, y in zip(leg_outputs(a), leg_outputs(b))
+        )
+
+    # 3 warm/verify runs + 5 per rep (chain re-trace warm, chain timed,
+    # branch-free re-trace warm, branch-free timed, kernel timed).
+    n_states = 3 + 5 * reps
+    states = [fresh_copy(state) for _ in range(n_states)]
+    si = iter(states)
+
+    # Warm every leg off the clock (compiles + verification outputs).
+    # The chain leg re-traces the legacy formulation: the flag is read
+    # at trace time, so the megastep cache clears around it — and again
+    # after, so the branch-free legs never reuse a chain trace.
+    scenario_megastep.clear_cache()
+    with strat_mod.chain_impl():
+        out_chain = run(next(si), "xla")
+    scenario_megastep.clear_cache()
+    out_bf = run(next(si), "xla")
+    out_kernel = run(next(si), "pallas")
+    kernel_engine = out_kernel["stats"]["engine"]
+    bit_chain = identical(out_chain, out_bf)
+    bit_kernel = identical(out_kernel, out_bf)
+    assert bit_chain, "chain vs branch-free diverged — A/B is meaningless"
+    assert bit_kernel, (
+        "kernel engine vs XLA core diverged — A/B is meaningless"
+    )
+
+    t = {"xla_chain": float("inf"), "xla_branchfree": float("inf"),
+         "kernel": float("inf")}
+    for _ in range(reps):  # interleaved: window drift cancels
+        scenario_megastep.clear_cache()
+        with strat_mod.chain_impl():
+            run(next(si), "xla")  # chain re-trace compile, off the clock
+            t0 = time.perf_counter()
+            run(next(si), "xla")
+            t["xla_chain"] = min(t["xla_chain"], time.perf_counter() - t0)
+        scenario_megastep.clear_cache()
+        run(next(si), "xla")  # branch-free re-trace, off the clock
+        t0 = time.perf_counter()
+        run(next(si), "xla")
+        t["xla_branchfree"] = min(
+            t["xla_branchfree"], time.perf_counter() - t0
+        )
+        t0 = time.perf_counter()
+        run(next(si), "pallas")
+        t["kernel"] = min(t["kernel"], time.perf_counter() - t0)
+
+    rps = {k: round(batch * rounds / v, 1) for k, v in t.items()}
+    return {
+        "rounds_per_sec": rps["xla_branchfree"],
+        "chain_rounds_per_sec": rps["xla_chain"],
+        "kernel_rounds_per_sec": rps["kernel"],
+        "kernel_engine": kernel_engine,
+        "branchfree_speedup_vs_chain": round(
+            t["xla_chain"] / t["xla_branchfree"], 3
+        ),
+        "kernel_ratio_vs_branchfree": round(
+            t["xla_branchfree"] / t["kernel"], 3
+        ),
+        "bit_exact_chain_vs_branchfree": bool(bit_chain),
+        "bit_exact_kernel_vs_xla": bool(bit_kernel),
+        "batch": batch, "n_max": cap, "rounds": rounds,
+        "rounds_per_dispatch": per_dispatch, "depth": depth,
+        "elapsed_s": round(t["xla_branchfree"], 4),
+        "bound": "round formulation only: identical campaign, driver, "
+                 "schedule and outputs on all three legs — chain vs "
+                 "branch-free isolates the strategy select-chain "
+                 "pathology; the kernel leg is Mosaic on TPU and the "
+                 "Pallas INTERPRETER elsewhere (kernel_engine names "
+                 "which ran)",
+        "note": "kernel_ratio_vs_branchfree on a CPU host measures the "
+                "interpreter, not the kernel — the <4x "
+                "flexible-vs-fused raw-speed goal is a TPU number and "
+                "rides the consolidated tunnel measurement pass "
+                "(ROADMAP); bit-exactness of all three legs is asserted "
+                "before any timing is reported",
+    }
+
+
 CONFIGS = {
     # Latency-sensitive configs first: dispatch through the TPU tunnel gets
     # noticeably slower once the big Ed25519-verify programs have run
@@ -2799,6 +2950,7 @@ CONFIGS = {
     "failover_sweep": bench_failover_sweep,
     "pipeline_sweep": bench_pipeline_sweep,
     "scenario_sweep": bench_scenario_sweep,
+    "megastep_ab": bench_megastep_ab,
     "scenario_long": bench_scenario_long,
     "resilience": bench_resilience,
     "serving": bench_serving,
@@ -2813,15 +2965,17 @@ CONFIGS = {
 # fresh jax import + compile, multichip spawns forced-8-device
 # children (the device count must precede jax init), serving runs
 # a deliberately-overloaded client-fleet drill (thread storms, 50 ms
-# stalls per dispatch), and serving_warm pays a full AOT warmup pass
-# plus a deliberately-cold comparison leg — all opt in explicitly:
-# `--configs scenario_long` / `resilience` / `multichip` / `serving` /
-# `serving_warm`.
+# stalls per dispatch), serving_warm pays a full AOT warmup pass
+# plus a deliberately-cold comparison leg, and megastep_ab re-traces
+# the legacy strategy formulation per rep + runs the Pallas interpreter
+# leg (minutes of compile/interpretation by design) — all opt in
+# explicitly: `--configs scenario_long` / `resilience` / `multichip` /
+# `serving` / `serving_warm` / `megastep_ab`.
 DEFAULT_CONFIGS = [
     n for n in CONFIGS
     if n not in (
         "scenario_long", "resilience", "multichip", "serving",
-        "serving_warm",
+        "serving_warm", "megastep_ab",
     )
 ]
 

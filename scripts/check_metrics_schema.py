@@ -61,13 +61,25 @@ def main() -> int:
         from ba_tpu.parallel import make_sweep_state, pipeline_sweep
 
         obs.reset_first_calls()
+        # engine="xla" pinned EXPLICITLY on the baseline legs: with an
+        # inherited BA_TPU_ENGINE the env default would move both
+        # baselines onto the kernel engine and the engine-flip
+        # assertion below would never see ["xla", "interpret"].
         pipeline_sweep(
             jr.key(0), make_sweep_state(jr.key(1), 4, 4), 2,
-            with_counters=True,
+            with_counters=True, engine="xla",
         )
         pipeline_sweep(
             jr.key(2), make_sweep_state(jr.key(3), 4, 8), 2,
-            with_counters=True,
+            with_counters=True, engine="xla",
+        )
+        # Engine-axis records (ISSUE 13): the SAME shapes through the
+        # Pallas kernel (interpret mode — any host) force a recompile
+        # whose ONLY changed axis is the engine: the explainer must
+        # read `"engine": ["xla", "interpret"]`, type-checked below.
+        pipeline_sweep(
+            jr.key(2), make_sweep_state(jr.key(3), 4, 8), 2,
+            with_counters=True, engine="interpret",
         )
         # Streaming-engine records (ISSUE 6): a tiny sparse campaign
         # with checkpoint_every drives the real scenario_checkpoint
@@ -222,6 +234,7 @@ def main() -> int:
             return 1
         bad = 0
         events = set()
+        engine_flips = []  # ISSUE 13: recompile records' engine-axis pairs
         from ba_tpu.obs import flight as _flight
 
         def _num_or_null(v):
@@ -301,6 +314,23 @@ def main() -> int:
                         file=sys.stderr,
                     )
                     bad += 1
+                elif "engine" in changed:
+                    # ISSUE 13: the engine axis is a string pair out of
+                    # the engine-request set (old may be null on a
+                    # cross-process diff against a pre-engine row).
+                    pair = changed["engine"]
+                    if not all(
+                        v is None or v in ("xla", "pallas", "interpret")
+                        for v in pair
+                    ):
+                        print(
+                            f"schema check: line {i} malformed engine "
+                            f"axis: {line[:160]}",
+                            file=sys.stderr,
+                        )
+                        bad += 1
+                    else:
+                        engine_flips.append(pair)
             elif rec.get("event") == "recovery":
                 if not (
                     rec.get("fault") in ("transient", "fatal", "oom")
@@ -586,6 +616,16 @@ def main() -> int:
             print(
                 f"schema check: expected events {want - events} missing "
                 f"(got {sorted(map(str, events))})",
+                file=sys.stderr,
+            )
+            bad += 1
+        if ["xla", "interpret"] not in engine_flips:
+            # The interpret campaign above re-specialized at equal
+            # shapes: the explainer must have attributed it to the
+            # engine axis, and to exactly that flip.
+            print(
+                f"schema check: no recompile record explained the "
+                f"engine flip (saw {engine_flips})",
                 file=sys.stderr,
             )
             bad += 1

@@ -138,6 +138,12 @@ mutate_and_expect BA301 core/om.py \
 mutate_and_expect BA101 parallel/shard.py \
     'def _mut101_shard(x):
     return x.block_until_ready()' || exit 1
+# ISSUE 13: the Pallas scenario megastep (ops/scenario_step.py) is the
+# dispatch path when the kernel engine is selected and joined the
+# BA101 hot-path scope — prove that extension is live too.
+mutate_and_expect BA101 ops/scenario_step.py \
+    'def _mut101_megastep(x):
+    return x.block_until_ready()' || exit 1
 # ISSUE 9: BA301 grew the symmetric host-tier scope — obs modules
 # (the flight recorder and health sampler in particular) must never
 # import through ba_tpu.core/ba_tpu.ops.  Prove the direction is live.

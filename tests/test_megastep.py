@@ -1,0 +1,495 @@
+"""Pallas scenario megastep + engine selection (ISSUE 13 tentpole,
+ba_tpu/ops/scenario_step.py + the engine seam in parallel/pipeline.py).
+
+The load-bearing contracts, each pinned independently:
+
+1. **In-kernel threefry** — the kernel's int32 threefry2x32 reproduces
+   jax.random's ``fold_in``/``split``/``bits`` word-for-word (the
+   derivation chain the bit-exactness contract stands on).
+2. **Parity, bit-exact** — a fuzz sweep of random strategy mixes (all
+   five strategies) with kills/revives/fault-flips mid-campaign pins
+   decisions, leaders, histograms, every counter row, the final
+   strategy plane and the schedule cursor BIT-IDENTICAL across engines
+   (xla vs the kernel in interpret mode), for the campaign, plain, and
+   coalesced (per-slot key) paths — including RANDOM coins under the
+   same keys.
+3. **Branch-free strategy table** — the lie-table rewrite is
+   bit-identical to the legacy select chains, at the function level and
+   through a whole campaign re-traced under ``chain_impl()``.
+4. **Engine selection** — explicit unsupported combinations error
+   eagerly (mesh, m >= 2, signed via the backend); ``auto`` falls back
+   silently-but-counted; the resolved engine rides compile-signature
+   axes (a flip is an explained recompile) and the pipeline_engine
+   gauge; serving cohorts never coalesce across engines and the warmup
+   lattice covers both engines when a kernel engine is configured.
+5. **Engine invariants survive** — the depth-k no-blocking
+   dispatch-count proof re-runs with ``engine="interpret"`` under full
+   supervision, and a campaign checkpointed under one engine resumes
+   bit-exactly under the other.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import pytest
+
+from ba_tpu import obs
+from ba_tpu.parallel import (
+    ENGINES,
+    SCENARIO_COUNTER_NAMES,
+    engine_support,
+    fresh_copy as _fresh,
+    make_mesh,
+    make_sweep_state,
+    pipeline_sweep,
+    resolve_engine,
+    scenario_sweep,
+)
+from ba_tpu.parallel.pipeline import (
+    ENGINE_IDS,
+    _ENGINE_REQUESTS,
+    coalesced_aot_spec,
+    coalesced_sweep,
+    scenario_aot_spec,
+)
+from ba_tpu.ops import scenario_step as ss
+from ba_tpu.scenario import strategies as strat_mod
+from ba_tpu.scenario.compile import ScenarioBlock, block_from_kills
+
+
+def _u32(x):
+    return np.asarray(x).astype(np.uint32)
+
+
+def _tf_np(kernel_out):
+    return _u32(np.asarray(kernel_out))
+
+
+# -- 1. in-kernel threefry ----------------------------------------------------
+
+
+def test_kernel_threefry_matches_jax_fold_in_split_bits():
+    key = jr.key(1234)
+    kd = np.asarray(jr.key_data(key)).view(np.int32)
+    k0 = jnp.asarray(kd[0])
+    k1 = jnp.asarray(kd[1])
+    for d in (0, 1, 7, 512, 2**31 - 1):
+        want = _u32(jr.key_data(jr.fold_in(key, d)))
+        g0, g1 = ss._fold_in(k0, k1, jnp.int32(np.int64(d) & 0x7FFFFFFF))
+        got = np.array([_tf_np(g0), _tf_np(g1)])
+        if d < 2**31:  # int32-representable data words
+            np.testing.assert_array_equal(got, want)
+    ka, kb = jr.split(key)
+    (a0, b0), (a1, b1) = ss._split2(k0, k1)
+    np.testing.assert_array_equal(
+        np.array([_tf_np(a0), _tf_np(b0)]), _u32(jr.key_data(ka))
+    )
+    np.testing.assert_array_equal(
+        np.array([_tf_np(a1), _tf_np(b1)]), _u32(jr.key_data(kb))
+    )
+    # Counter-mode WORDS through the static maps, odd and even word
+    # counts: a draw of 32*s coins uses exactly s words, and coins
+    # 0..s-1 unpack bit 0 of words 0..s-1 — so the map slice [:, :s]
+    # is the word schedule itself.
+    for s in (1, 2, 3, 5, 31, 32, 33, 81):
+        # Deliberate same-key redraws: each size's words must come from
+        # the SAME stream the kernel maps reproduce.
+        want = np.asarray(jr.bits(key, (s,), jnp.uint32))  # ba-lint: disable=BA202
+        maps = jnp.asarray(ss._word_maps(32 * s, (32 * s,))[:, :s])
+        y0, y1 = ss.tf2x32(k0, k1, maps[0], maps[1])
+        words = _u32(jnp.where(maps[2] == 1, y0, y1))
+        np.testing.assert_array_equal(words, want)
+
+
+# -- 2. parity fuzz across engines --------------------------------------------
+
+
+def _random_campaign(rng, B, n, R):
+    """A strategy-mixed campaign: all five strategies present, kills,
+    revives and fault flips mid-campaign."""
+    strat0 = rng.integers(0, 5, (B, n)).astype(np.int8)
+    events = {
+        "kill": jnp.asarray(rng.random((R, B, n)) < 0.08),
+        "revive": jnp.asarray(rng.random((R, B, n)) < 0.05),
+        "set_faulty": jnp.asarray(
+            np.where(rng.random((R, B, n)) < 0.1,
+                     rng.integers(0, 2, (R, B, n)), -1).astype(np.int8)
+        ),
+        "set_strategy": jnp.asarray(
+            np.where(rng.random((R, B, n)) < 0.15,
+                     rng.integers(0, 5, (R, B, n)), -1).astype(np.int8)
+        ),
+    }
+    block = ScenarioBlock(**events)
+    return jnp.asarray(strat0), block
+
+
+def _assert_campaign_identical(a, b):
+    np.testing.assert_array_equal(a["decisions"], b["decisions"])
+    np.testing.assert_array_equal(a["leaders"], b["leaders"])
+    np.testing.assert_array_equal(a["histograms"], b["histograms"])
+    np.testing.assert_array_equal(
+        a["counters_per_round"], b["counters_per_round"]
+    )
+    assert a["counters"] == b["counters"]
+    assert set(a["counters"]) == set(SCENARIO_COUNTER_NAMES)
+    np.testing.assert_array_equal(
+        np.asarray(a["final_strategy"]), np.asarray(b["final_strategy"])
+    )
+    for f in ("order", "leader", "faulty", "alive", "ids"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a["final_state"], f)),
+            np.asarray(getattr(b["final_state"], f)),
+        )
+    assert int(a["final_schedule"].counter) == int(b["final_schedule"].counter)
+    np.testing.assert_array_equal(
+        _u32(a["final_schedule"].key_data), _u32(b["final_schedule"].key_data)
+    )
+
+
+@pytest.mark.parametrize("seed,B,n,R,kpd", [
+    (0, 4, 5, 6, 2),
+    (1, 8, 9, 7, 3),
+    (2, 3, 33, 5, 5),   # multi-word round-1 coins
+    (3, 9, 16, 9, 4),   # padding on both axes
+])
+def test_scenario_parity_fuzz_xla_vs_interpret(seed, B, n, R, kpd):
+    rng = np.random.default_rng(seed)
+    state = make_sweep_state(jr.key(100 + seed), B, n)
+    strat0, block = _random_campaign(rng, B, n, R)
+    key = jr.key(200 + seed)
+    a = scenario_sweep(
+        key, _fresh(state), block, initial_strategy=strat0,
+        rounds_per_dispatch=kpd, collect_decisions=True, engine="xla",
+    )
+    b = scenario_sweep(
+        key, _fresh(state), block, initial_strategy=strat0,
+        rounds_per_dispatch=kpd, collect_decisions=True,
+        engine="interpret",
+    )
+    assert a["stats"]["engine"] == "xla"
+    assert b["stats"]["engine"] == "interpret"
+    _assert_campaign_identical(a, b)
+
+
+def test_plain_pipeline_parity_xla_vs_interpret():
+    state = make_sweep_state(jr.key(7), 10, 12)
+    kw = dict(
+        with_counters=True, collect_decisions=True, rounds_per_dispatch=3
+    )
+    a = pipeline_sweep(jr.key(8), _fresh(state), 8, engine="xla", **kw)
+    b = pipeline_sweep(jr.key(8), _fresh(state), 8, engine="interpret", **kw)
+    np.testing.assert_array_equal(a["decisions"], b["decisions"])
+    np.testing.assert_array_equal(a["histograms"], b["histograms"])
+    np.testing.assert_array_equal(
+        a["counters_per_round"], b["counters_per_round"]
+    )
+    assert a["counters"] == b["counters"]
+
+
+def test_coalesced_parity_xla_vs_interpret_plain_and_scenario():
+    rng = np.random.default_rng(5)
+    B, n, R = 4, 6, 6
+    keys = [jr.key(40 + i) for i in range(B)]
+    state = make_sweep_state(jr.key(41), B, n)
+    a = coalesced_sweep(keys, _fresh(state), R, rounds_per_dispatch=2,
+                        engine="xla")
+    b = coalesced_sweep(keys, _fresh(state), R, rounds_per_dispatch=2,
+                        engine="interpret")
+    for f in ("decisions", "counters", "majorities"):
+        np.testing.assert_array_equal(a[f], b[f])
+    strat0, block = _random_campaign(rng, B, n, R)
+    sa = coalesced_sweep(keys, _fresh(state), R, rounds_per_dispatch=3,
+                         scenario=block, initial_strategy=strat0,
+                         engine="xla")
+    sb = coalesced_sweep(keys, _fresh(state), R, rounds_per_dispatch=3,
+                         scenario=block, initial_strategy=strat0,
+                         engine="interpret")
+    for f in ("decisions", "counters", "majorities", "leaders"):
+        np.testing.assert_array_equal(sa[f], sb[f])
+
+
+# -- 3. branch-free strategy table --------------------------------------------
+
+
+def test_lie_table_bit_identical_to_select_chain():
+    rng = np.random.default_rng(11)
+    strat = jnp.asarray(rng.integers(-3, 8, (4, 1, 7)), jnp.int8)
+    coins = jnp.asarray(rng.integers(0, 2, (4, 7, 7)), jnp.int8)
+    ridx = jnp.arange(7)[None, :, None]
+    new = strat_mod.lie_values(strat, coins, ridx)
+    old = strat_mod.lie_values_chain(strat, coins, ridx)
+    assert new.dtype == old.dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(new), np.asarray(old))
+    gc = jnp.asarray(rng.integers(0, 2, (4, 7, 7, 2)), bool)
+    vidx = jnp.arange(2)[None, None, None, :]
+    sg = strat_mod.send_gate(strat[..., None], gc, ridx[..., None], vidx)
+    sgc = strat_mod.send_gate_chain(strat[..., None], gc, ridx[..., None], vidx)
+    assert sg.dtype == sgc.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(sg), np.asarray(sgc))
+
+
+def test_chain_impl_retrace_matches_branch_free_campaign():
+    # The megastep_ab bench's mechanism: re-tracing a fresh jit closure
+    # under chain_impl() runs the legacy formulation — results must be
+    # bit-identical (the A/B measures speed, never semantics).
+    from ba_tpu.parallel.sweep import agreement_step
+
+    B, n = 6, 8
+    state = make_sweep_state(jr.key(60), B, n)
+    strat = jnp.asarray(
+        np.random.default_rng(6).integers(0, 5, (B, n)), jnp.int8
+    )
+    keys = jr.split(jr.key(61), B)
+    new = jax.jit(
+        lambda k, st, s: agreement_step(k, st, strategies=s)
+    )(keys, state, strat)
+    with strat_mod.chain_impl():
+        old = jax.jit(
+            lambda k, st, s: agreement_step(k, st, strategies=s)
+        )(keys, state, strat)
+    for f in ("majorities", "decision", "histogram"):
+        np.testing.assert_array_equal(np.asarray(new[f]), np.asarray(old[f]))
+
+
+# -- 4. engine selection ------------------------------------------------------
+
+
+def test_resolve_engine_table():
+    assert resolve_engine("xla") == ("xla", None)
+    assert resolve_engine(None) == ("xla", None)  # env default
+    assert resolve_engine("interpret") == ("interpret", None)
+    # pallas off-TPU resolves to the interpreter (house pattern); the
+    # recorded engine always names what ran.
+    resolved, fb = resolve_engine("pallas")
+    assert resolved == ("pallas" if jax.devices()[0].platform == "tpu"
+                        else "interpret")
+    assert fb is None
+    with pytest.raises(ValueError, match="bogus"):
+        resolve_engine("bogus")
+    with pytest.raises(ValueError, match="m=2"):
+        resolve_engine("pallas", m=2)
+    with pytest.raises(ValueError, match="data=4"):
+        resolve_engine("interpret", n_shards=4)
+    with pytest.raises(ValueError, match="signed"):
+        resolve_engine("pallas", signed=True)
+    assert resolve_engine("auto", m=3)[0] == "xla"
+    assert "m=3" in resolve_engine("auto", m=3)[1]
+    assert engine_support() is None
+    assert "signed" in engine_support(signed=True)
+    assert "mesh" in engine_support(meshed=True)
+    # An ENV-sourced kernel preference on an unsupported combination is
+    # a counted fallback, never a hard failure (only a CALL-SITE
+    # engine= demand raises) — exporting BA_TPU_ENGINE must not break
+    # the paths the kernel never covered.
+    import os
+
+    os.environ["BA_TPU_ENGINE"] = "interpret"
+    try:
+        resolved, why = resolve_engine(None, m=2)
+        assert resolved == "xla" and "m=2" in why
+        assert resolve_engine(None) == ("interpret", None)
+    finally:
+        del os.environ["BA_TPU_ENGINE"]
+
+
+def test_engine_eager_errors_and_counted_fallback():
+    state = make_sweep_state(jr.key(70), 8, 8)
+    with pytest.raises(ValueError, match="m=2"):
+        pipeline_sweep(jr.key(0), _fresh(state), 4, m=2, engine="pallas")
+    # ANY mesh excludes the kernel — even data=1 routes every dispatch
+    # through the shard_map-wrapped XLA core, and a kernel request that
+    # silently ran XLA would record an engine that never executed.
+    mesh = make_mesh((1, 1), ("data", "node"))
+    with pytest.raises(ValueError, match="mesh"):
+        scenario_sweep(
+            jr.key(0), _fresh(state),
+            block_from_kills(np.zeros((2, 8, 8), bool)),
+            mesh=mesh, engine="interpret",
+        )
+    with pytest.raises(ValueError, match="m=2"):
+        scenario_sweep(
+            jr.key(0), _fresh(state),
+            block_from_kills(np.zeros((2, 8, 8), bool)),
+            m=2, engine="interpret",
+        )
+    # auto + mesh: counted fallback, XLA actually runs and is recorded.
+    mout = scenario_sweep(
+        jr.key(1), _fresh(state),
+        block_from_kills(np.zeros((2, 8, 8), bool)),
+        mesh=mesh, engine="auto",
+    )
+    assert mout["stats"]["engine"] == "xla"
+    assert "mesh" in mout["stats"]["engine_fallback"]
+    del mesh
+    reg = obs.default_registry()
+    out = pipeline_sweep(jr.key(1), _fresh(state), 2, m=2, engine="auto")
+    assert out["stats"]["engine"] == "xla"
+    assert "m=2" in out["stats"]["engine_fallback"]
+    assert reg.get("pipeline_engine").value == ENGINE_IDS["xla"]
+    assert reg.get("pipeline_engine_fallback_total").value >= 1
+    out2 = pipeline_sweep(jr.key(1), _fresh(state), 2, engine="interpret")
+    assert out2["stats"]["engine_fallback"] is None
+    assert reg.get("pipeline_engine").value == ENGINE_IDS["interpret"]
+
+
+def test_backend_run_rounds_signed_engine_errors_eagerly():
+    from ba_tpu.runtime.backends import JaxBackend
+
+    be = JaxBackend(protocol="sm", m=1, signed=True)
+    # The silent sequential fallback (None) is fine by default...
+    class _G:
+        def __init__(self, i):
+            self.id = i
+            self.faulty = False
+
+    gens = [_G(i + 1) for i in range(4)]
+    assert be.run_rounds(gens, 0, 1, 0, 2) is None
+    # ...but an explicit kernel-engine request must error, not silently
+    # betray the engine expectation.
+    with pytest.raises(ValueError, match="signed"):
+        be.run_rounds(gens, 0, 1, 0, 2, engine="pallas")
+
+
+def test_engine_axis_is_an_explained_recompile():
+    obs.reset_first_calls()
+    axes = {"batch": 4, "capacity": 8, "rounds": 2, "engine": "xla"}
+    first, changed, cross = obs.classify_compile("megastep_test_fn", axes)
+    assert first and changed is None
+    first, changed, cross = obs.classify_compile(
+        "megastep_test_fn", {**axes, "engine": "interpret"}
+    )
+    assert first
+    assert changed == {"engine": ["xla", "interpret"]}
+
+
+def test_aot_specs_build_kernel_engines():
+    from ba_tpu.ops.scenario_step import (
+        pallas_coalesced_megastep, pallas_scenario_megastep,
+    )
+
+    axes = {"batch": 2, "capacity": 4, "rounds": 3, "m": 1,
+            "max_liars": None, "unroll": 1, "scenario": True,
+            "engine": "interpret"}
+    fn, args, kwargs = coalesced_aot_spec(axes)
+    assert fn is pallas_coalesced_megastep
+    assert kwargs["interpret"] is True
+    sx = {**axes, "engine": "xla", "collect_decisions": True, "data": 1}
+    fn2, _, kwargs2 = scenario_aot_spec(sx)
+    assert fn2 is not pallas_scenario_megastep  # xla rows keep the scan core
+    assert "interpret" not in kwargs2
+    si = {**sx, "engine": "interpret"}
+    fn3, _, kwargs3 = scenario_aot_spec(si)
+    assert fn3 is pallas_scenario_megastep and kwargs3["interpret"] is True
+    with pytest.raises(ValueError, match="unknown engine"):
+        coalesced_aot_spec({**axes, "engine": "mosaic2"})
+
+
+def test_serve_engine_tokens_and_cohort_separation():
+    from ba_tpu.runtime.serve import (
+        ENGINE_TOKENS, AgreementRequest, ServeConfig, cohort_key,
+        validate_request,
+    )
+
+    # serve.py's jax-free spelling must track the engine seam's.
+    assert ENGINE_TOKENS == _ENGINE_REQUESTS
+    assert set(ENGINES) <= set(ENGINE_TOKENS)
+    r1 = AgreementRequest(kind="run-rounds", n=4, rounds=4, seed=1)
+    r2 = AgreementRequest(
+        kind="run-rounds", n=4, rounds=4, seed=1, engine="interpret"
+    )
+    assert cohort_key(r1) != cohort_key(r2)
+    assert cohort_key(r1, "interpret") == cohort_key(r2)
+    with pytest.raises(ValueError, match="engine"):
+        validate_request(AgreementRequest(engine="mosaic2"))
+    with pytest.raises(ValueError, match="engine"):
+        ServeConfig(engine="mosaic2")
+    assert ServeConfig(engine="interpret").engine == "interpret"
+
+
+def test_warmup_plan_covers_both_engines():
+    from ba_tpu.runtime.serve import ServeConfig
+    from ba_tpu.runtime.warmup import bucket_lattice, plan_engines
+
+    assert plan_engines(ServeConfig()) == ("xla",)
+    got = plan_engines(ServeConfig(engine="interpret"))
+    assert got == ("xla", "interpret")
+    # pallas resolves per-platform; both engines always present.
+    got = plan_engines(ServeConfig(engine="pallas"))
+    assert got[0] == "xla" and len(got) == 2 and got[1] in ENGINES
+    plan = bucket_lattice(2, 4, engines=got)
+    assert {a["engine"] for _, a in plan} == set(got)
+
+
+# -- 5. engine invariants -----------------------------------------------------
+
+
+def test_interpret_engine_no_blocking_dispatch_count_supervised(
+    monkeypatch, tmp_path
+):
+    # ISSUE 13 acceptance: the depth-k dispatch schedule is untouched by
+    # the kernel engine — re-run the no-blocking proof with
+    # engine="interpret" under FULL supervision.
+    from ba_tpu.runtime.supervisor import SupervisorConfig, supervised_sweep
+
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    R, depth = 7, 3
+    state = make_sweep_state(jr.key(90), 8, 8)
+    events = []
+    out = supervised_sweep(
+        jr.key(91), state, R,
+        config=SupervisorConfig(timeout_s=60.0),
+        depth=depth, rounds_per_dispatch=1, with_counters=True,
+        checkpoint_every=3,
+        checkpoint_path=str(tmp_path / "nb_{round}.npz"),
+        on_event=lambda kind, i: events.append((kind, i)),
+        engine="interpret",
+    )
+    assert out["stats"]["engine"] == "interpret"
+    dispatches = [i for kind, i in events if kind == "dispatch"]
+    retires = [i for kind, i in events if kind == "retire"]
+    assert dispatches == list(range(R))
+    assert retires == list(range(R))
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [
+        ("dispatch", i) for i in range(depth + 1)
+    ]
+    assert out["stats"]["max_in_flight"] == depth + 1
+    assert out["supervisor"]["attempts"] == 1
+
+
+def test_checkpoint_crosses_engines_bit_exact(tmp_path):
+    # A campaign checkpointed under the XLA core resumes under the
+    # kernel engine (and vice versa) bit-exactly: the carry format and
+    # the key schedule are engine-free, and the coins are bit-equal.
+    rng = np.random.default_rng(21)
+    B, n, R = 6, 7, 8
+    state = make_sweep_state(jr.key(95), B, n)
+    strat0, block = _random_campaign(rng, B, n, R)
+    key = jr.key(96)
+    kw = dict(initial_strategy=strat0, rounds_per_dispatch=2,
+              collect_decisions=True)
+    want = scenario_sweep(key, _fresh(state), block, engine="xla", **kw)
+    ck = str(tmp_path / "cross_{round}.npz")
+    scenario_sweep(
+        key, _fresh(state), block, engine="xla",
+        checkpoint_every=4, checkpoint_path=ck, **kw,
+    )
+    resumed = scenario_sweep(
+        None, None, block, resume=ck.replace("{round}", "4"),
+        engine="interpret", rounds_per_dispatch=2,
+        collect_decisions=True,
+    )
+    np.testing.assert_array_equal(
+        want["decisions"][4:], resumed["decisions"]
+    )
+    np.testing.assert_array_equal(want["leaders"][4:], resumed["leaders"])
+    assert want["counters"] == resumed["counters"]
+    np.testing.assert_array_equal(
+        np.asarray(want["final_strategy"]),
+        np.asarray(resumed["final_strategy"]),
+    )

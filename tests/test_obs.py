@@ -227,7 +227,14 @@ def test_sink_and_tracer_thread_safety(tmp_path):
     tracer = Tracer(capacity=1 << 16, enabled=True)
     threads, per = 8, 50
 
+    # All workers stay alive together (barrier) so their thread idents
+    # are necessarily distinct: on a loaded host, threads that finish
+    # before later ones start get their idents RECYCLED, and the
+    # tid-identity assertion below would flake on scheduler luck.
+    gate = threading.Barrier(threads)
+
     def work(t):
+        gate.wait()
         for i in range(per):
             with tracer.span("w", t=t, i=i):
                 sink.emit({"event": "thread_test", "t": t, "i": i})

@@ -107,9 +107,13 @@ def test_donation_consumes_inputs_and_returns_live_state():
     # ba-lint's BA201 proves statically — hence the suppressions.)
     assert state.faulty.is_deleted()  # ba-lint: disable=BA201
     assert sched.key_data.is_deleted()  # ba-lint: disable=BA201
-    with pytest.raises(RuntimeError):
+    # The exception TYPE depends on jit-cache temperature (a cold
+    # jnp.add raises RuntimeError at trace time; a warmed one surfaces
+    # the runtime's deleted-buffer ValueError) — the contract under
+    # test is only that use-after-donate RAISES.
+    with pytest.raises((RuntimeError, ValueError)):
         _ = state.faulty + 0  # ba-lint: disable=BA201
-    with pytest.raises(RuntimeError):
+    with pytest.raises((RuntimeError, ValueError)):
         _ = sched.counter + 0  # ba-lint: disable=BA201
     # The returned pair is live and carries the thread forward.
     assert int(out_sched.counter) == R

@@ -1551,7 +1551,7 @@ def test_repl_scenario_command_guards(tmp_path):
     )
     assert out == ["scenario error: too many arguments "
                    "(usage: scenario <file> [<ckpt-path> <every>] "
-                   "[supervise] [mesh=N])"]
+                   "[supervise] [mesh=N] [engine=...])"]
     # mesh=1 (ISSUE 8) routes the B=1 campaign through the sharded scan
     # core and still prints the normal result lines.
     out = []
