@@ -94,6 +94,17 @@ KNOWN_DONATING = {
     "ba_tpu.ops.scenario_step.pallas_coalesced_megastep": DonationSpec(
         frozenset([0, 1, 2]), ("state", "sched", "strategy")
     ),
+    # The signed lane (ISSUE 14): signed megasteps donate (state, sched)
+    # like their plain twins — counter block and sign-ahead verdict
+    # planes deliberately excluded (no output aliases their shapes).
+    # Real donate_argnums decorators and def-line annotations exist
+    # there too; same belt-and-braces as the rows above.
+    "ba_tpu.parallel.pipeline.signed_megastep": DonationSpec(
+        frozenset([0, 1]), ("state", "sched")
+    ),
+    "ba_tpu.parallel.pipeline.coalesced_signed_megastep": DonationSpec(
+        frozenset([0, 1]), ("state", "sched")
+    ),
 }
 
 _DONATES_RE = re.compile(r"#\s*ba-lint:\s*donates\(([^)]*)\)")

@@ -53,6 +53,10 @@ except ImportError:  # pragma: no cover - cryptography is baked into the image
 
 MSG_LEN = 16
 _MAGIC = b"BAv1"
+# Round-bound claims (the sign-ahead lane, ISSUE 14) carry their own
+# domain separator: a "says v" table signature can never satisfy a
+# "says v in round r" verifier or vice versa, whatever the pad bytes.
+_MAGIC_ROUND = b"BAr1"
 
 _verify_jit = None  # lazily-created jitted ed25519.verify (shared cache)
 _verify_rlc_jit = None  # lazily-created jitted ed25519.verify_rlc
@@ -130,10 +134,21 @@ def sign_value_tables(
     """
     B = len(sks)
     msgs = _value_table_msgs(B, n_values, base)
-    # Host signing is exactly the lane the pipelined engine's host_work
-    # hook overlaps with device compute (ROADMAP sign-ahead item), so it
-    # is span-traced + histogrammed: the trace shows whether signing fits
-    # inside the device window or spills past it.
+    return msgs, _sign_table_msgs(sks, pks, msgs)
+
+
+def _sign_table_msgs(sks: list[bytes], pks: np.ndarray, msgs: np.ndarray) -> np.ndarray:
+    """Host-sign a [B, V, MSG_LEN] message table -> sigs uint8 [B, V, 64].
+
+    The one signing body behind :func:`sign_value_tables` and the
+    round-bound :func:`sign_round_tables` (sign-ahead lane, ISSUE 14):
+    native C++ batch path when available, per-call signer otherwise.
+    Host signing is exactly the lane the pipelined engine's host_work
+    hook overlaps with device compute, so it is span-traced +
+    histogrammed: the trace shows whether signing fits inside the
+    device window or spills past it.
+    """
+    B, n_values = msgs.shape[:2]
     with obs.timed_span("host_sign", "host_sign_s", batch=B, values=n_values):
         nat = _native_or_none()
         if nat is not None:
@@ -155,7 +170,69 @@ def sign_value_tables(
                         host_sign(sk, pk, msgs[b, v].tobytes()), np.uint8
                     )
     obs.default_registry().counter("host_signs_total").inc(B * n_values)
-    return msgs, sigs
+    return sigs
+
+
+def round_message(instance: int, round_index: int, value: int) -> bytes:
+    """The round-bound claim: "commander of ``instance`` says ``value``
+    in round ``round_index``" (sign-ahead lane, ISSUE 14).
+
+    Binding the round next to the instance id closes the cross-ROUND
+    replay a multi-round signed protocol would otherwise admit (a round
+    r signature re-presented at round r' != r verifies under the
+    round-free encoding); the distinct magic keeps the two table
+    grammars mutually unverifiable.
+    """
+    body = (
+        _MAGIC_ROUND
+        + int(instance).to_bytes(4, "little")
+        + int(round_index).to_bytes(4, "little")
+        + bytes([value & 0xFF])
+    )
+    return body.ljust(MSG_LEN, b"\0")
+
+
+def _round_table_msgs(
+    B: int, round_index: int, n_values: int, base: int
+) -> np.ndarray:
+    """Vectorized :func:`round_message` over the [B, V] table grid —
+    byte-identical to the per-call encoder (pinned by
+    tests/test_signed_pipeline.py) at O(1) numpy ops, the
+    :func:`_value_table_msgs` discipline."""
+    msgs = np.zeros((B, n_values, MSG_LEN), np.uint8)
+    msgs[:, :, 0:4] = np.frombuffer(_MAGIC_ROUND, np.uint8)
+    msgs[:, :, 4:8] = (
+        np.arange(base, base + B, dtype="<u4").view(np.uint8).reshape(B, 1, 4)
+    )
+    msgs[:, :, 8:12] = np.frombuffer(
+        np.uint32(round_index).tobytes(), np.uint8
+    )
+    msgs[:, :, 12] = np.arange(n_values, dtype=np.uint8)[None, :]
+    return msgs
+
+
+def sign_round_tables(
+    sks: list[bytes],
+    pks: np.ndarray,
+    round_index: int,
+    n_values: int = 2,
+    base: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-(instance, value) signature tables for ONE round: the unit of
+    work the sign-ahead host lane (``ba_tpu.parallel.signing``) prepares
+    for rounds d+1..d+depth while dispatches d-depth..d are in flight.
+
+    Same shapes and signing substrate as :func:`sign_value_tables`
+    (msgs uint8 [B, V, MSG_LEN], sigs uint8 [B, V, 64]); the messages
+    bind (instance, ROUND, value) via :func:`round_message`, so each
+    round's tables are distinct bytes under the same commander keys —
+    Ed25519 determinism makes a round-free per-round table a no-op
+    recomputation, and the round binding is what makes per-round
+    signing a real protocol obligation rather than busywork.
+    """
+    B = len(sks)
+    msgs = _round_table_msgs(B, round_index, n_values, base)
+    return msgs, _sign_table_msgs(sks, pks, msgs)
 
 
 def _value_table_msgs(B: int, n_values: int, base: int) -> np.ndarray:

@@ -576,6 +576,19 @@ def _megastep_call(
             else S((_CPAD, R_pad), jnp.int32)
         )
 
+    # Donation THROUGH the pallas_call (ISSUE 14 satellite, the PR 12
+    # follow-on): the padded state planes alias their same-shape
+    # outputs (alive -> out 0, faulty -> out 1, leader -> out 2,
+    # strategy -> out 3), so XLA recycles those buffers in place
+    # instead of allocating fresh outputs every dispatch.  Safe by the
+    # kernel's access pattern: every aliased input ref is read exactly
+    # once into the fori_loop carry BEFORE the loop, and the aliased
+    # output refs are written exactly once AFTER it.  The operand
+    # indices are fixed by the operands list above (leader=4, faulty=8,
+    # alive=9; strategy follows ids at 11 when scenario).
+    aliases = {9: 0, 8: 1, 4: 2}
+    if scenario:
+        aliases[11] = 3
     outs = pl.pallas_call(
         functools.partial(
             _megastep_kernel,
@@ -590,6 +603,7 @@ def _megastep_call(
         in_specs=in_specs,
         out_specs=[vmem] * len(out_shape),
         out_shape=out_shape,
+        input_output_aliases=aliases,
         interpret=interpret,
     )(*operands)
 

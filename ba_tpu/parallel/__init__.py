@@ -28,6 +28,7 @@ from ba_tpu.parallel.pipeline import (
     COUNTER_NAMES,
     ENGINES,
     SCENARIO_COUNTER_NAMES,
+    SIGNED_COUNTER_NAMES,
     CarryCheckpoint,
     KeySchedule,
     agreement_counters_init,
@@ -43,6 +44,8 @@ from ba_tpu.parallel.pipeline import (
     scenario_counters_init,
     scenario_megastep,
     scenario_sweep,
+    signed_counters_init,
+    signed_megastep,
 )
 from ba_tpu.parallel.sweep import (
     bucketed_sweep_states,
@@ -62,6 +65,7 @@ __all__ = [
     "COUNTER_NAMES",
     "ENGINES",
     "SCENARIO_COUNTER_NAMES",
+    "SIGNED_COUNTER_NAMES",
     "CarryCheckpoint",
     "KeySchedule",
     "agreement_counters_init",
@@ -77,6 +81,8 @@ __all__ = [
     "scenario_counters_init",
     "scenario_megastep",
     "scenario_sweep",
+    "signed_counters_init",
+    "signed_megastep",
     "failover_sweep",
     "sharded_sweep",
     "make_sweep_state",

@@ -69,9 +69,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ba_tpu import obs
 from ba_tpu.core.election import elect_lowest_id
 from ba_tpu.core.state import SimState
-from ba_tpu.core.types import COMMAND_DTYPE, UNDEFINED
+from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT, UNDEFINED
 from ba_tpu.parallel.multihost import put_global
-from ba_tpu.parallel.sweep import agreement_step
+from ba_tpu.parallel.sweep import agreement_step, signed_agreement_step
 from ba_tpu.utils import metrics as _metrics
 from ba_tpu.utils import snapshot as _snapshot
 
@@ -116,8 +116,8 @@ def engine_support(m: int = 1, n_shards: int = 1,
     shard_map-wrapped XLA scan core, so a kernel request there would
     otherwise record an engine that never ran."""
     if signed:
-        return ("signed=True (the signed path host-signs between "
-                "rounds and never enters the scenario scan)")
+        return ("signed=True (the signed lane runs the XLA signed "
+                "megastep; the fused kernel covers oral OM(1) only)")
     if m != 1:
         return f"m={m} (the dense EIG tree stays on the XLA scan core)"
     if n_shards != 1 or meshed:
@@ -214,6 +214,20 @@ def _record_engine(reg, engine: str, fallback: str | None) -> None:
 # PR 4 block (the counters stay protocol-agnostic: everything reads
 # ``agreement_step`` outputs + the state, never the protocol's RNG).
 SCENARIO_COUNTER_NAMES = COUNTER_NAMES + ("ic1_violations", "ic2_violations")
+
+# Signed campaigns (ISSUE 14) extend the block with the SIGNED verdicts
+# instead: ``sig_rejections`` counts instances whose round table carried
+# at least one INVALID commander signature (the device verifier's
+# reject, surfaced as a counter — honest tables keep it 0, which the
+# property tests assert), and ``commander_equivocations`` counts
+# instances whose faulty alive commander PROVABLY equivocated this
+# round — both contradictory claims reached alive lieutenants, i.e.
+# both honestly-signed messages exist, exactly the paper's
+# faulty-commander power in SM(m).  The first len(COUNTER_NAMES)
+# entries stay bit-identical to the PR 4 block.
+SIGNED_COUNTER_NAMES = COUNTER_NAMES + (
+    "sig_rejections", "commander_equivocations"
+)
 
 
 @jax.tree_util.register_dataclass
@@ -655,6 +669,236 @@ def scenario_megastep(
     return (carry[0], carry[1], carry[2], *ys)
 
 
+# -- the signed megastep (ISSUE 14) ------------------------------------------
+#
+# The signed SM(m) protocol was the last reference behavior excluded
+# from every fast path: host Ed25519 signing sat BETWEEN the round-1
+# broadcast and the relay rounds, so ``runtime/backends._run_signed``
+# ran one blocking host-sign + device-verify + dispatch + fetch cycle
+# per round.  The sign-ahead lane (``parallel/signing.py``) dissolves
+# that order: each round's signatures cover the commander's (at most V)
+# DISTINCT round-bound claims — not the realized broadcast — so the
+# tables for rounds d+1..d+depth can be signed on host and their
+# verification dispatched while dispatches d-depth..d are still in
+# flight, and the per-round [B, V] verdicts enter the scan as consumed
+# ``xs`` exactly like scenario event planes.  In-scan, the broadcast's
+# values gather their verdicts by a select (``signed_agreement_step``),
+# which is the dedup-verify identity ``sig_valid_from_tables`` pins.
+
+
+def signed_counters_init() -> jax.Array:
+    """A zeroed signed counter block (one int32 per
+    SIGNED_COUNTER_NAMES: the PR 4 agreement counters + the signature /
+    equivocation verdicts)."""
+    return jnp.zeros((len(SIGNED_COUNTER_NAMES),), jnp.int32)
+
+
+def signed_counter_delta(
+    out: dict, state: SimState, ok: jax.Array
+) -> jax.Array:
+    """One signed round's counter increments (trace-time, in-scan).
+
+    The PR 4 agreement deltas (first three entries, bit-identical)
+    followed by the signed verdicts:
+
+    - ``sig_rejections``: instances whose round table held at least one
+      invalid commander signature (``ok`` [B, V] is the device
+      verifier's per-claim verdict row);
+    - ``commander_equivocations``: instances whose commander is faulty
+      and alive AND whose alive lieutenants received BOTH orders this
+      round — two honestly-signed contradictory claims in flight, the
+      provable equivocation SM(m)'s V-set rule exists to catch.
+
+    Host-reproducible from the fetched ``received`` stream, which the
+    sequential-driver bit-match test derives independently in numpy.
+    """
+    base = agreement_counter_delta(out, state)
+    received = out["received"]
+    sig_rej = jnp.sum(jnp.any(~ok, axis=-1), dtype=jnp.int32)
+    idx = jnp.arange(state.faulty.shape[1])[None, :]
+    lieutenants = state.alive & (idx != state.leader[:, None])
+    got_a = ((received == ATTACK) & lieutenants).any(axis=1)
+    got_r = ((received == RETREAT) & lieutenants).any(axis=1)
+    leader_faulty = jnp.take_along_axis(
+        state.faulty, state.leader[:, None], axis=1
+    )[:, 0]
+    leader_alive = jnp.take_along_axis(
+        state.alive, state.leader[:, None], axis=1
+    )[:, 0]
+    equiv = jnp.sum(
+        got_a & got_r & leader_faulty & leader_alive, dtype=jnp.int32
+    )
+    return jnp.concatenate([base, jnp.stack([sig_rej, equiv])])
+
+
+def _signed_scan(
+    state: SimState,
+    sched: KeySchedule,
+    counters: jax.Array,
+    ok_planes: jax.Array,
+    *,
+    rounds: int,
+    m: int = 1,
+    collapsed: bool = False,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+):
+    """The signed scan core (trace-time; shared by the donated
+    :func:`signed_megastep` and the sequential reference driver's
+    single-round calls through ``signed_agreement_step``).
+
+    ``ok_planes`` [rounds, B, V] bool — the sign-ahead lane's per-round
+    table verdicts — are the scan's consumed ``xs``.  Returns
+    ``(carry, ys)`` with carry ``(state, sched, counters)`` and ys
+    ``(histograms[, decisions], counter_rows)`` — the exact layout of
+    the plain counter-threaded scan, so the engine's retire/assembly
+    path serves both protocols verbatim.
+    """
+    def body(carry, ok):
+        st, sc, ctr = carry
+        keys = round_keys(sc, st.batch)
+        out = signed_agreement_step(keys, st, ok, m=m, collapsed=collapsed)
+        ctr = ctr + signed_counter_delta(out, st, ok)
+        nxt = KeySchedule(sc.key_data, sc.counter + 1)
+        ys = (out["histogram"],)
+        if collect_decisions:
+            ys += (out["decision"],)
+        return (st, nxt, ctr), ys + (ctr,)
+
+    return jax.lax.scan(
+        body, (state, sched, counters), ok_planes,
+        length=rounds, unroll=unroll,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "rounds", "m", "collapsed", "unroll", "collect_decisions"
+    ),
+    donate_argnums=(0, 1),
+)
+def signed_megastep(  # ba-lint: donates(state, sched)
+    state: SimState,
+    sched: KeySchedule,
+    counters: jax.Array,
+    ok_planes: jax.Array,
+    *,
+    rounds: int,
+    m: int = 1,
+    collapsed: bool = False,
+    unroll: int = 1,
+    collect_decisions: bool = False,
+):
+    """``rounds`` SIGNED SM(m) rounds in one donated dispatch (ISSUE 14
+    tentpole): round-1 equivocation broadcast, table-signature gating,
+    m relay rounds and the quorum layer, per round, all inside one
+    ``lax.scan``.
+
+    Mirrors the existing megasteps' signature/donation/return contract
+    exactly: ``state`` and ``sched`` are CONSUMED (thread the returned
+    ones), the counter block (SIGNED_COUNTER_NAMES) rides the carry
+    with its cumulative rows stacked into the outputs (the PR 4
+    pattern — the last row continues the thread and reaches the host
+    inside the existing depth-delayed retire fetch), and the sign-ahead
+    verdict planes enter as consumed ``xs`` (NOT donated — no output
+    aliases their shape, like scenario event planes).
+
+    Bit-compat contract: round ``sched.counter + r`` computes exactly
+    ``signed_agreement_step(round_keys(<schedule at counter + r>, B),
+    state, ok_planes[r])`` — the blocking sequential signed driver
+    (``parallel.signing.sequential_signed_sweep``) under the same key
+    schedule and the same round tables produces identical decisions,
+    histograms and counters (tests/test_signed_pipeline.py).
+    """
+    carry, ys = _signed_scan(
+        state,
+        sched,
+        counters,
+        ok_planes,
+        rounds=rounds,
+        m=m,
+        collapsed=collapsed,
+        unroll=unroll,
+        collect_decisions=collect_decisions,
+    )
+    return (carry[0], carry[1], *ys)
+
+
+def slot_signed_counter_delta(
+    out: dict, state: SimState, ok: jax.Array
+) -> jax.Array:
+    """One signed round's PER-SLOT counter increments ([B, C] — the
+    coalesced serving twin of :func:`signed_counter_delta`, exactly as
+    :func:`slot_counter_delta` relates to the batch deltas): row ``b``
+    is bit-identical to the delta slot ``b``'s own B=1 signed run would
+    fold, with the batch reductions dropped and unanimity fixed at its
+    B=1 value."""
+    base = slot_counter_delta(out, state, scenario=False)
+    received = out["received"]
+    sig_rej = jnp.any(~ok, axis=-1).astype(jnp.int32)
+    idx = jnp.arange(state.faulty.shape[1])[None, :]
+    lieutenants = state.alive & (idx != state.leader[:, None])
+    got_a = ((received == ATTACK) & lieutenants).any(axis=1)
+    got_r = ((received == RETREAT) & lieutenants).any(axis=1)
+    leader_faulty = jnp.take_along_axis(
+        state.faulty, state.leader[:, None], axis=1
+    )[:, 0]
+    leader_alive = jnp.take_along_axis(
+        state.alive, state.leader[:, None], axis=1
+    )[:, 0]
+    equiv = (got_a & got_r & leader_faulty & leader_alive).astype(jnp.int32)
+    return jnp.concatenate(
+        [base, jnp.stack([sig_rej, equiv], axis=-1)], axis=-1
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rounds", "m", "collapsed", "unroll"),
+    donate_argnums=(0, 1),
+)
+def coalesced_signed_megastep(  # ba-lint: donates(state, sched)
+    state: SimState,
+    sched: KeySchedule,
+    slot_counters: jax.Array,
+    ok_planes: jax.Array,
+    *,
+    rounds: int,
+    m: int = 1,
+    collapsed: bool = False,
+    unroll: int = 1,
+):
+    """``rounds`` SIGNED rounds of a COALESCED serving batch in one
+    donated dispatch: every slot an independent signed request.
+
+    ``sched`` is a slot schedule (one base key per slot, folding
+    instance 0 — :func:`slot_round_keys`), so slot ``b`` is bit-exact
+    with its own B=1 ``pipeline_sweep(signed=True)`` run at equal
+    padded capacity — the serving parity pin extended verbatim to
+    signed cohorts.  Returns ``(state, sched, None, last_majorities,
+    decisions, counter_rows)`` — the unsigned coalesced tuple with the
+    (absent) strategy slot pinned to None, so the dispatch loop's
+    unpacking serves both protocols verbatim.
+    """
+
+    def body(carry, ok):
+        st, sc, ctr, _maj = carry
+        keys = slot_round_keys(sc)
+        out = signed_agreement_step(keys, st, ok, m=m, collapsed=collapsed)
+        ctr = ctr + slot_signed_counter_delta(out, st, ok)
+        nxt = KeySchedule(sc.key_data, sc.counter + 1)
+        return (st, nxt, ctr, out["majorities"]), (out["decision"], ctr)
+
+    B, n = state.faulty.shape
+    maj0 = jnp.full((B, n), UNDEFINED, COMMAND_DTYPE)
+    carry, ys = jax.lax.scan(
+        body, (state, sched, slot_counters, maj0), ok_planes,
+        length=rounds, unroll=unroll,
+    )
+    return (carry[0], carry[1], None, carry[3], *ys)
+
+
 @dataclasses.dataclass(frozen=True)
 class CarryCheckpoint:
     """A resumable snapshot of the engine's donated carry (ISSUE 6).
@@ -691,6 +935,11 @@ class CarryCheckpoint:
     strategy: jax.Array | None
     round: int
     shard_layout: dict | None = None
+    # Signed campaigns (ISSUE 14): the counter block is the SIGNED
+    # table (SIGNED_COUNTER_NAMES) and a resume must re-enter the
+    # signed lane — the flag is what lets load/resume refuse a
+    # cross-protocol splice positionally.
+    signed: bool = False
     # Flight-recorder correlation (ISSUE 9): the run_id of the campaign
     # that wrote this checkpoint, so a resume CONTINUES the same run's
     # ledger (a killed process's successor joins its predecessor's
@@ -725,14 +974,14 @@ def _carry_arrays(host_state, host_sched, host_counters, host_strategy):
 # colliding with an engine field is the misclassified-checkpoint
 # hazard both checks exist to prevent).
 RESERVED_CARRY_META_KEYS = frozenset(
-    {"format", "v", "round", "scenario", "counter_names", "sha256",
-     "rounds_total", "shard_layout", "run_id"}
+    {"format", "v", "round", "scenario", "signed", "counter_names",
+     "sha256", "rounds_total", "shard_layout", "run_id"}
 )
 
 
 def _carry_meta(
     round_cursor: int, counters, strategy, shard_layout=None, run_id=None,
-    **extra
+    signed=False, **extra
 ) -> dict:
     clash = (RESERVED_CARRY_META_KEYS - {"rounds_total"}) & set(extra)
     if clash:
@@ -746,14 +995,17 @@ def _carry_meta(
     names = None
     if counters is not None:
         # The strategy plane is what makes a carry a scenario carry —
-        # select the name table on it, never on block length (the two
-        # tables' lengths are not a contract).
+        # select the name table on it (then the signed flag), never on
+        # block length (the tables' lengths are not a contract).
         names = list(
-            SCENARIO_COUNTER_NAMES if strategy is not None else COUNTER_NAMES
+            SCENARIO_COUNTER_NAMES
+            if strategy is not None
+            else SIGNED_COUNTER_NAMES if signed else COUNTER_NAMES
         )
     return {
         "round": int(round_cursor),
         "scenario": strategy is not None,
+        "signed": bool(signed),
         "counter_names": names,
         # Provenance, not a resume constraint: the stored arrays are
         # canonical (gather-on-write), so any device count reads them.
@@ -798,7 +1050,8 @@ def save_carry_checkpoint(path: str, ckpt: CarryCheckpoint, **extra) -> int:
         arrays,
         _carry_meta(
             ckpt.round, host[2], host[3], shard_layout=layout,
-            run_id=ckpt.run_id or _metrics.active_run_id(), **extra
+            run_id=ckpt.run_id or _metrics.active_run_id(),
+            signed=ckpt.signed, **extra
         ),
     )
     return sum(v.nbytes for v in arrays.values())
@@ -815,7 +1068,11 @@ def load_carry_checkpoint(path: str) -> CarryCheckpoint:
     meta, arrays = _snapshot.read_carry_checkpoint(path)
     if "counters" in arrays:
         live = (
-            SCENARIO_COUNTER_NAMES if meta.get("scenario") else COUNTER_NAMES
+            SCENARIO_COUNTER_NAMES
+            if meta.get("scenario")
+            else SIGNED_COUNTER_NAMES
+            if meta.get("signed")
+            else COUNTER_NAMES
         )
         stored = meta.get("counter_names")
         if stored is not None and tuple(stored) != tuple(live):
@@ -852,6 +1109,7 @@ def load_carry_checkpoint(path: str) -> CarryCheckpoint:
         strategy=strategy,
         round=meta["round"],
         shard_layout=meta.get("shard_layout"),
+        signed=bool(meta.get("signed", False)),
         run_id=meta.get("run_id"),
     )
 
@@ -1119,6 +1377,22 @@ def coalesced_aot_spec(axes: dict):
     sched = KeySchedule(
         key_data=S((B,) + kshape, kdtype), counter=S((), jnp.int32)
     )
+    if axes.get("signed"):
+        # The signed coalesced twin (ISSUE 14): per-slot SIGNED counter
+        # blocks, per-round table-verdict planes as xs, always the XLA
+        # core (the kernel never covers signed — resolve_engine pins it).
+        counters = S((B, len(SIGNED_COUNTER_NAMES)), jnp.int32)
+        ok = S((nr, B, 2), jnp.bool_)
+        return (
+            coalesced_signed_megastep,
+            (_abstract_state(B, n), sched, counters, ok),
+            dict(
+                rounds=nr,
+                m=axes["m"],
+                collapsed=bool(axes.get("collapsed", False)),
+                unroll=axes["unroll"],
+            ),
+        )
     strategy = S((B, n), jnp.int8) if scenario else None
     names = SCENARIO_COUNTER_NAMES if scenario else COUNTER_NAMES
     counters = S((B, len(names)), jnp.int32)
@@ -1204,12 +1478,39 @@ def scenario_aot_spec(axes: dict):
     )
 
 
+def signed_aot_spec(axes: dict):
+    """``(jitted, args, kwargs)`` for one :func:`signed_megastep`
+    specialization (ISSUE 14; single-device by construction — the
+    signed lane never meshes)."""
+    S = jax.ShapeDtypeStruct
+    B, n, nr = axes["batch"], axes["capacity"], axes["rounds"]
+    kshape, kdtype = _key_data_spec()
+    sched = KeySchedule(key_data=S(kshape, kdtype), counter=S((), jnp.int32))
+    return (
+        signed_megastep,
+        (
+            _abstract_state(B, n),
+            sched,
+            S((len(SIGNED_COUNTER_NAMES),), jnp.int32),
+            S((nr, B, 2), jnp.bool_),
+        ),
+        dict(
+            rounds=nr,
+            m=axes["m"],
+            collapsed=bool(axes.get("collapsed", False)),
+            unroll=axes["unroll"],
+            collect_decisions=axes["collect_decisions"],
+        ),
+    )
+
+
 # fn name -> builder; the names ARE the compile-signature/ledger fn
 # names, so the warmup pass can map ledger rows straight onto builders.
 AOT_SPECS = {
     "coalesced_megastep": coalesced_aot_spec,
     "pipeline_megastep": pipeline_aot_spec,
     "scenario_megastep": scenario_aot_spec,
+    "signed_megastep": signed_aot_spec,
 }
 
 
@@ -1314,6 +1615,9 @@ def coalesced_sweep(  # ba-lint: donates(state)
     unroll: int = 1,
     scenario=None,
     initial_strategy: jax.Array | None = None,
+    signed: bool = False,
+    collapsed: bool = False,
+    sign_seed: int = 0,
     exec_seam=None,
     on_retire=None,
     executables=None,
@@ -1338,6 +1642,17 @@ def coalesced_sweep(  # ba-lint: donates(state)
     fetch's host block — the slot→request mapping hook: the service
     streams per-request rows out as windows retire instead of waiting
     for the drain.
+
+    ``signed=True`` (ISSUE 14) runs the batch through the SIGNED
+    coalesced megastep: per-slot keys as above, per-slot SIGNED counter
+    blocks, and the sign-ahead lane's per-round table verdicts staged
+    up front (every slot's alone-run binds instance 0 under
+    ``sign_seed``, so the per-slot tables coincide and the lane signs
+    each distinct round-bound claim once).  Slot ``b`` stays bit-exact
+    with its own B=1 ``pipeline_sweep(signed=True)`` run at equal
+    padded capacity — the parity pin, extended verbatim.  ``collapsed``
+    selects the O(n) fair-coin relay; incompatible with ``scenario``
+    (the signed megastep has no mutating-round form).
 
     ``executables`` (ISSUE 11) is an ``obs.aotcache.ExecutableCache``
     (anything with ``.get(fn, axes)``): the loop consults it BEFORE each
@@ -1380,11 +1695,25 @@ def coalesced_sweep(  # ba-lint: donates(state)
         raise ValueError(
             f"rounds_per_dispatch={rounds_per_dispatch} must be >= 1"
         )
-    # Engine resolution (ISSUE 13): eager like the campaign path — an
+    # Engine resolution (ISSUE 13/14): eager like the campaign path — an
     # explicit kernel request that cannot serve this cohort raises
     # before anything stages or donates; serving cohorts are always
-    # single-device, so only the m dial can exclude the kernel.
-    engine_resolved, engine_fallback = resolve_engine(engine, m=m)
+    # single-device, so only the m and signed dials can exclude the
+    # kernel.
+    engine_resolved, engine_fallback = resolve_engine(
+        engine, m=m, signed=signed
+    )
+    if signed and scenario is not None:
+        raise ValueError(
+            "signed cohorts cannot carry scenario planes (the signed "
+            "megastep has no mutating-round form)"
+        )
+    if collapsed and not signed:
+        # Same eager rejection as the campaign path: silently ignoring
+        # the dial would hand back exact-relay results to a caller who
+        # asked for the O(n) collapsed relay.
+        raise ValueError("collapsed= is the signed relay dial; it needs "
+                         "signed=True")
     B, n = state.faulty.shape
     if len(slot_keys) != B:
         raise ValueError(
@@ -1418,21 +1747,40 @@ def coalesced_sweep(  # ba-lint: donates(state)
             strategy = jnp.asarray(initial_strategy, jnp.int8).copy()
     elif initial_strategy is not None:
         raise ValueError("initial_strategy needs a scenario block")
-    counters = jnp.zeros(
-        (B, len(SCENARIO_COUNTER_NAMES if is_scenario else COUNTER_NAMES)),
-        jnp.int32,
+    names = (
+        SCENARIO_COUNTER_NAMES
+        if is_scenario
+        else SIGNED_COUNTER_NAMES if signed else COUNTER_NAMES
     )
-    names = SCENARIO_COUNTER_NAMES if is_scenario else COUNTER_NAMES
+    counters = jnp.zeros((B, len(names)), jnp.int32)
 
     chunks = [rounds_per_dispatch] * (rounds // rounds_per_dispatch)
     if rounds % rounds_per_dispatch:
         chunks.append(rounds % rounds_per_dispatch)
+
+    # Signed cohorts (ISSUE 14): every slot's alone run binds instance 0
+    # under the shared sign seed, so the per-slot round tables COINCIDE
+    # — the lane signs each distinct round-bound claim once (the dedup
+    # the tables exist for) and the [R, 1, V] verdict planes broadcast
+    # over the batch at staging.  One lane, one verify dispatch, staged
+    # up front (serving batches are short; the campaign engine owns the
+    # true windowed sign-ahead).
+    ok_planes = None
+    if signed:
+        from ba_tpu.parallel import signing as _signing
+
+        lane = _signing.SignAheadLane(1, seed=sign_seed)
+        ok_planes = lane.stage(0, rounds)
 
     def _identity_material():
         material = [
             "coalesced", rounds, B,
             jax.device_get(sched.key_data).tobytes(),
         ]
+        if signed:
+            # Protocol joins the identity: a signed cohort under the
+            # same keys/rounds is a different flight than its oral twin.
+            material.append(f"signed:m={m}:collapsed={collapsed}")
         if ev_planes is not None:
             # Event-plane CONTENT joins the identity (the PR 9
             # hardening, upheld here): two scenario cohorts with equal
@@ -1462,6 +1810,7 @@ def coalesced_sweep(  # ba-lint: donates(state)
         is_scenario=is_scenario, exec_seam=exec_seam,
         on_retire=on_retire, run_id=rid, executables=executables,
         engine_resolved=engine_resolved, engine_fallback=engine_fallback,
+        signed=signed, collapsed=collapsed, ok_planes=ok_planes,
     )
     out["counter_names"] = list(names)
     out["stats"]["run_id"] = rid
@@ -1474,7 +1823,7 @@ def _coalesced_loop(
     state, sched, strategy, counters, ev_planes, chunks, *,
     m, max_liars, depth, unroll, is_scenario, exec_seam, on_retire,
     run_id=None, executables=None, engine_resolved="xla",
-    engine_fallback=None,
+    engine_fallback=None, signed=False, collapsed=False, ok_planes=None,
 ):
     """The coalesced driver's dispatch loop: the main engine's depth-k
     retire discipline, without scenario staging/checkpoint machinery
@@ -1519,16 +1868,24 @@ def _coalesced_loop(
 
     round_base = 0
     majorities = None
+    B_slots = state.faulty.shape[0]
     for d, nr in enumerate(chunks):
         lo, hi = round_base, round_base + nr
         axes = {
-            "batch": state.faulty.shape[0],
+            "batch": B_slots,
             "capacity": state.faulty.shape[1],
             "rounds": nr,
             "m": m,
             "max_liars": max_liars,
             "unroll": min(unroll, nr),
             "scenario": is_scenario,
+            # ISSUE 14: ONE fn name for both protocols of the serving
+            # megastep with the protocol as a named axis — a signed
+            # cohort after an oral one at equal shapes reads
+            # `"signed": [false, true]` in the recompile record, an
+            # EXPLAINED recompile rather than a mystery second compile.
+            "signed": signed,
+            "collapsed": collapsed if signed else False,
             "engine": engine_resolved,
         }
         ev = None
@@ -1537,6 +1894,15 @@ def _coalesced_loop(
                 # Async upload of this dispatch's plane slice; it
                 # queues behind the in-flight dispatches.
                 ev = {k: jnp.asarray(v[lo:hi]) for k, v in ev_planes.items()}
+        elif signed:
+            with tracer.span("stage_planes", lo=lo, hi=hi, signed=True):
+                # The lane's [nr, 1, V] verdict slice broadcasts over
+                # the slots (every slot's alone-run table coincides —
+                # coalesced_sweep documents the dedup); a lazy device
+                # view, no fetch.
+                ev = jnp.broadcast_to(
+                    ok_planes[lo:hi], (nr, B_slots, ok_planes.shape[-1])
+                )
         # Executable-cache consult (ISSUE 11): a hit dispatches the
         # precompiled executable under a plain warm `dispatch` span
         # (_dispatch_span documents why it skips the classifier); a
@@ -1552,21 +1918,29 @@ def _coalesced_loop(
             dispatch=d, rounds=nr,
         ) as phase:
             with obs.xla.annotate("coalesced_dispatch", dispatch=d):
-                jit_call = functools.partial(
-                    coalesced_fn,
-                    state, sched, strategy, counters, ev,
-                    rounds=nr, m=m, max_liars=max_liars,
-                    unroll=min(unroll, nr), scenario=is_scenario,
-                    **engine_extra,
-                )
+                if signed:
+                    jit_call = functools.partial(
+                        coalesced_signed_megastep,
+                        state, sched, counters, ev,
+                        rounds=nr, m=m, collapsed=collapsed,
+                        unroll=min(unroll, nr),
+                    )
+                    exe_args = (state, sched, counters, ev)
+                else:
+                    jit_call = functools.partial(
+                        coalesced_fn,
+                        state, sched, strategy, counters, ev,
+                        rounds=nr, m=m, max_liars=max_liars,
+                        unroll=min(unroll, nr), scenario=is_scenario,
+                        **engine_extra,
+                    )
+                    exe_args = (state, sched, strategy, counters, ev)
                 if exe is not None:
                     # The executable's call takes only the traced
                     # arguments (statics baked at lowering); a call-time
                     # failure evicts + falls back to jit_call.
                     call = _warm_call(
-                        functools.partial(
-                            exe, state, sched, strategy, counters, ev
-                        ),
+                        functools.partial(exe, *exe_args),
                         jit_call, executables,
                         "coalesced_megastep", axes, fell_back,
                     )
@@ -1667,6 +2041,15 @@ def pipeline_sweep(  # ba-lint: donates(state)
         # supervised retry attempt, whose derivation always loses to
         # the supervisor's active scope.
         material = [rounds]
+        if engine_kwargs.get("signed"):
+            # Protocol joins the identity (ISSUE 14): a signed campaign
+            # under the same key/rounds is a different flight than its
+            # oral twin — merged records would collide on the round
+            # grid.
+            material.append(
+                f"signed:m={engine_kwargs.get('m', 1)}:"
+                f"collapsed={engine_kwargs.get('collapsed', False)}"
+            )
         if key is not None:
             material.append(jax.device_get(jr.key_data(key)).tobytes())
         elif resume is not None:
@@ -1727,6 +2110,9 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
     on_event=None,
     scenario=None,
     initial_strategy: jax.Array | None = None,
+    signed: bool = False,
+    collapsed: bool = False,
+    sign_seed: int = 0,
     checkpoint_every: int | None = None,
     checkpoint_path: str | None = None,
     checkpoint_keep_last: int | None = None,
@@ -1988,10 +2374,42 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
         raise ValueError("on_stall needs retire_timeout_s")
     if health_every is not None and health_every < 1:
         raise ValueError(f"health_every={health_every} must be >= 1")
+    if signed:
+        # SIGNED MODE (ISSUE 14): the sign-ahead lane prepares per-round
+        # signature-table verdicts in the host_work overlap slot and the
+        # scan consumes them as xs (``signed_megastep``).  Single-device
+        # XLA only — the fused kernel and the mesh scan cores never
+        # covered the SM relay, and both exclusions are EAGER (nothing
+        # donated yet).  Counters are always on: the signed verdicts
+        # are the campaign's product and they ride the existing retire
+        # fetch for free, exactly like scenario mode.
+        if scenario is not None:
+            raise ValueError(
+                "signed=True cannot take a scenario block (the signed "
+                "megastep has no mutating-round form)"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "signed=True is single-device (mesh signed combos are "
+                "unsupported; shard by running independent sweeps)"
+            )
+        with_counters = True
+    elif collapsed:
+        raise ValueError("collapsed= is the signed relay dial; it needs "
+                         "signed=True")
 
     if resume is not None:
         if isinstance(resume, str):
             resume = load_carry_checkpoint(resume)
+        if bool(getattr(resume, "signed", False)) != signed:
+            # A cross-protocol splice would resume the wrong counter
+            # table positionally AND re-enter the wrong megastep under
+            # the checkpoint's key schedule — refuse loudly.
+            raise ValueError(
+                f"resume checkpoint signed={resume.signed} but this "
+                f"sweep has signed={signed} — a carry never crosses "
+                f"protocols"
+            )
         if key is not None or state is not None:
             raise ValueError(
                 "resume= supplies the carry: pass key=None, state=None"
@@ -2095,6 +2513,8 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
         sched = make_key_schedule(key)
         if scenario is not None:
             counters = scenario_counters_init()
+        elif signed:
+            counters = signed_counters_init()
         else:
             counters = agreement_counters_init() if with_counters else None
     n_shards = 1
@@ -2133,7 +2553,8 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
     # donated yet; an auto fallback resolves to the scan core and is
     # counted below once stats exists.
     engine_resolved, engine_fallback = resolve_engine(
-        engine, m=m, n_shards=n_shards, meshed=mesh is not None
+        engine, m=m, n_shards=n_shards, signed=signed,
+        meshed=mesh is not None,
     )
     scen_fn, plain_fn, _, engine_extra = _engine_megasteps(engine_resolved)
 
@@ -2153,6 +2574,7 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
     plane_peak_bytes = 0
     plane_shard_peak = 0
     stage_s = 0.0
+    sign_ahead_s = 0.0
 
     # Observability (ISSUE 2): spans + registry feed off the engine's
     # existing dispatch/retire/host_work structure and add NO
@@ -2284,6 +2706,34 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
         stage_s += time.perf_counter() - t0
         return staged
 
+    # The sign-ahead host lane (ISSUE 14): per-round signature tables
+    # for the NEXT dispatch window are signed on host and their device
+    # verification dispatched in the same overlap slot plane staging
+    # uses — while dispatches d-depth..d are in flight — and the
+    # per-round [B, V] verdicts enter the scan as consumed xs.  Signing
+    # is host numpy work, verification an async dispatch (or, on the
+    # CPU backend, the native batch verifier — still the host lane):
+    # neither ever fetches, so the no-blocking dispatch-count proof
+    # runs with the lane live.
+    sign_lane = None
+    if signed:
+        from ba_tpu.parallel import signing as _signing
+
+        sign_lane = _signing.SignAheadLane(
+            state.faulty.shape[0], seed=sign_seed
+        )
+
+    def stage_signed(lo, hi):
+        nonlocal sign_ahead_s
+        with tracer.span("sign_ahead", lo=lo, hi=hi):
+            staged = sign_lane.stage(lo, hi)
+        sign_ahead_s = sign_lane.sign_ahead_s
+        # Live overlap gauge (the go/no-go reading): cumulative wall
+        # the host lane spent signing + dispatching verifies inside
+        # the overlap slot.  In-memory scalar ops, no fetch, no sync.
+        reg.gauge("host_sign_ahead_s").set(round(sign_ahead_s, 6))
+        return staged
+
     # Carry checkpointing (ISSUE 6): `pending` is (round cursor, a
     # fresh_copy of the live carry — an async device-side copy, not a
     # sync) attached to the dispatch that produced it; the write happens
@@ -2311,6 +2761,7 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
                 strategy=carry_strategy,
                 round=round_cursor,
                 shard_layout=layout,
+                signed=signed,
             ),
             rounds_total=rounds,
             **(checkpoint_meta or {}),
@@ -2434,6 +2885,10 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
         # Chunk 0 stages before the loop (nothing is in flight yet to
         # overlap with); every later chunk stages in the overlap slot.
         staged_ev = stage_chunk(start, start + chunks[0])
+    elif signed and chunks:
+        # Same discipline for the sign-ahead lane: window 0's tables
+        # sign before the loop, every later window signs in the slot.
+        staged_ev = stage_signed(start, start + chunks[0])
     for d, nr in enumerate(chunks):
         # The round window this dispatch covers — threaded through the
         # execution seam and the in-flight tuple so fault injection,
@@ -2452,21 +2907,40 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
         # and a device-count change now reads as `"data": [1, 8]` in
         # the recompile record — and in the cross-run compile ledger's
         # signature — instead of an unexplained recompile.
-        axes = {
-            "batch": state.faulty.shape[0],
-            "capacity": state.faulty.shape[1],
-            "rounds": nr,
-            "m": m,
-            "max_liars": max_liars,
-            "unroll": min(unroll, nr),
-            "collect_decisions": collect_decisions,
-            "counters": with_counters,
-            "data": n_shards,
-            "scenario": scenario is not None,
-            # ISSUE 13: an engine flip at equal shapes is an EXPLAINED
-            # recompile — `"engine": ["xla", "pallas"]` in the record.
-            "engine": engine_resolved,
-        }
+        if signed:
+            # The signed megastep's own named-axes signature (ISSUE 14):
+            # `signed` rides every megastep's axes so a protocol flip is
+            # an explained recompile and the cross-run ledger / warmup
+            # lattice can address signed specializations.
+            axes = {
+                "batch": state.faulty.shape[0],
+                "capacity": state.faulty.shape[1],
+                "rounds": nr,
+                "m": m,
+                "collapsed": collapsed,
+                "unroll": min(unroll, nr),
+                "collect_decisions": collect_decisions,
+                "signed": True,
+                "engine": engine_resolved,
+            }
+        else:
+            axes = {
+                "batch": state.faulty.shape[0],
+                "capacity": state.faulty.shape[1],
+                "rounds": nr,
+                "m": m,
+                "max_liars": max_liars,
+                "unroll": min(unroll, nr),
+                "collect_decisions": collect_decisions,
+                "counters": with_counters,
+                "data": n_shards,
+                "scenario": scenario is not None,
+                "signed": False,
+                # ISSUE 13: an engine flip at equal shapes is an
+                # EXPLAINED recompile — `"engine": ["xla", "pallas"]`
+                # in the record.
+                "engine": engine_resolved,
+            }
         # Executable-cache consult (ISSUE 11, single-device only): a hit
         # dispatches the precompiled executable under a plain warm
         # `dispatch` span (_dispatch_span documents why it skips the
@@ -2475,7 +2949,8 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
         fell_back: list = []
         if executables is not None and mesh is None:
             exe = executables.get(
-                "scenario_megastep" if scenario is not None
+                "signed_megastep" if signed
+                else "scenario_megastep" if scenario is not None
                 else "pipeline_megastep",
                 axes,
             )
@@ -2548,6 +3023,57 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
                     obs.xla.abstractify(
                         (out[0], out[1], out[2], counters, ev)
                     ),
+                    obs.xla.abstractify(kwargs),
+                    axes=axes,
+                )
+        elif signed:
+            # This window's verdict planes were staged one loop
+            # iteration ago (window 0 before the loop): the signing
+            # already happened in the overlap slot, the verify dispatch
+            # is queued — or done — behind the in-flight megasteps.
+            ev = staged_ev
+            kwargs = dict(
+                rounds=nr,
+                m=m,
+                collapsed=collapsed,
+                unroll=min(unroll, nr),
+                collect_decisions=collect_decisions,
+            )
+            with _dispatch_span(
+                "signed_megastep", axes, exe is not None,
+                dispatch=d, rounds=nr,
+            ) as phase:
+                with obs.xla.annotate("megastep_dispatch", dispatch=d):
+                    if exe is not None:
+                        # Statics baked at AOT lowering; a call-time
+                        # failure evicts + falls back.
+                        call = _warm_call(
+                            functools.partial(
+                                exe, state, sched, counters, ev
+                            ),
+                            functools.partial(
+                                signed_megastep,
+                                state, sched, counters, ev, **kwargs,
+                            ),
+                            executables, "signed_megastep", axes,
+                            fell_back,
+                        )
+                    else:
+                        call = functools.partial(
+                            signed_megastep,
+                            state, sched, counters, ev, **kwargs,
+                        )
+                    if exec_seam is None:
+                        out = call()
+                    else:
+                        out = exec_seam(call, "dispatch", d, lo, hi)
+            if phase == "compile" and obs.xla.enabled():
+                # Device-tier artifact (the scenario-path pattern): the
+                # returned carry's signature equals the donated inputs'.
+                obs.xla.introspect(
+                    signed_megastep,
+                    "signed_megastep",
+                    obs.xla.abstractify((out[0], out[1], counters, ev)),
                     obs.xla.abstractify(kwargs),
                     axes=axes,
                 )
@@ -2661,6 +3187,14 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
             # device — the host_work overlap slot, extended to plane
             # staging.
             staged_ev = stage_chunk(round_base, round_base + chunks[d + 1])
+        elif signed and d + 1 < len(chunks):
+            # The sign-ahead refill (ISSUE 14): window d+1's tables sign
+            # on host and their verification dispatches NOW, while
+            # dispatches d-depth..d occupy the device — host signing
+            # leaves the critical path exactly as the chunked
+            # setup-overlap machinery in crypto/signed.py proved it
+            # could.
+            staged_ev = stage_signed(round_base, round_base + chunks[d + 1])
         if host_work is not None:
             with tracer.span("host_work", dispatch=d):
                 host_work(d)  # overlaps the rounds still executing on device
@@ -2731,6 +3265,8 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
             "health_samples": sampler.samples if sampler is not None else 0,
             "engine": engine_resolved,
             "engine_fallback": engine_fallback,
+            "signed": signed,
+            "sign_ahead_s": round(sign_ahead_s, 6),
         },
     }
     if scenario is not None:
@@ -2768,10 +3304,13 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
     if with_counters:
         # Counter rows were already fetched inside the retire fetches
         # (they ride ys), so everything below is host arithmetic — the
-        # "drain" adds no synchronization.
+        # "drain" adds no synchronization.  Signed sweeps carry the
+        # SIGNED verdict table (the name table is positional — the
+        # checkpoint reader pins the same selection).
         counter_rows = _host_np.concatenate([ys[-1] for ys in retired])
+        names_table = SIGNED_COUNTER_NAMES if signed else COUNTER_NAMES
         final = {
-            name: int(v) for name, v in zip(COUNTER_NAMES, counter_rows[-1])
+            name: int(v) for name, v in zip(names_table, counter_rows[-1])
         }
         result["counters"] = final
         result["counters_per_round"] = counter_rows

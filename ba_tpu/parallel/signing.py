@@ -1,0 +1,284 @@
+"""Sign-ahead host lane: per-round signature tables prepared while the
+pipelined signed megastep is in flight (ISSUE 14 tentpole).
+
+The signed SM(m) protocol's host obligation is signing, and signing is
+what kept it off every fast path: ``runtime/backends._run_signed`` had
+to host-sign BETWEEN the round-1 broadcast and the relay rounds, so
+every round paid sign + verify + dispatch + fetch strictly in series.
+The dissolving observation: a round's signatures cover the commander's
+(at most V) DISTINCT round-bound claims — "commander of instance b says
+v in round r" (``crypto.signed.round_message``) — not the realized
+broadcast, so round r's whole table is known before round r runs.  The
+lane exploits exactly the machinery ``crypto/signed.py`` proved in its
+chunked setup overlap (``setup_signed_tables_overlapped``): sign a
+window of rounds on host, dispatch the chunked device verification
+without fetching, and hand the per-round ``[B, V]`` verdict planes to
+the scan as consumed ``xs``.  ``pipeline_sweep(signed=True)`` stages
+window d+1 through :meth:`SignAheadLane.stage` in the SAME host_work
+overlap slot that stages scenario planes, while dispatches d-depth..d
+occupy the device — host signing leaves the critical path entirely.
+
+Nothing here ever fetches: signing is host numpy work, verification an
+async device dispatch (or, on the CPU backend, the native C++ batch
+verifier — host work in the host lane, overlapping the XLA compute
+threads).  The no-blocking dispatch-count proof runs with the lane
+live (tests/test_signed_pipeline.py).
+
+:func:`sequential_signed_sweep` is the blocking per-round reference
+driver — the ``_run_signed`` shape generalized to a sweep — whose
+outputs the pipelined lane must reproduce BIT-EXACTLY under the same
+key schedule and round tables (decisions, histograms, counters, final
+majorities).  Its counter derivation is independent host numpy, so the
+parity test cross-checks the in-scan verdict formulas too.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from ba_tpu import obs
+from ba_tpu.crypto.signed import (
+    _verify_received_exact,
+    commander_keys,
+    sign_round_tables,
+)
+from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
+from ba_tpu.utils import metrics as _metrics
+
+
+class SignAheadLane:
+    """The host lane: one commander key-set, per-round table staging.
+
+    Keygen happens ONCE at construction (the per-key-set cost the
+    signed setup always paid); :meth:`stage` then prepares any window
+    of rounds — host-sign each round's per-(instance, value) table
+    (``sign_round_tables``: messages bind instance, ROUND and value),
+    dispatch one chunked verification over the whole window, and
+    return the ``[hi-lo, B, V]`` verdict planes as a device array the
+    signed megastep consumes as scan ``xs``.
+
+    ``stage`` is re-entrant per window and never fetches; cumulative
+    wall time lands in :attr:`sign_ahead_s` (the engine mirrors it
+    into the ``host_sign_ahead_s`` gauge and ``stats["sign_ahead_s"]``
+    — the committed overlap-efficiency reading), and each window emits
+    one ``{"event": "sign_ahead", "v": 1}`` record when the sink is
+    live.
+    """
+
+    def __init__(self, batch: int, seed: int = 0, n_values: int = 2):
+        if batch < 1:
+            raise ValueError(f"batch={batch} must be >= 1")
+        if n_values < 1:
+            raise ValueError(f"n_values={n_values} must be >= 1")
+        self.batch = batch
+        self.seed = seed
+        self.n_values = n_values
+        with obs.span("sign_ahead_keys", batch=batch):
+            self.sks, self.pks = commander_keys(batch, seed)
+        self.sign_ahead_s = 0.0
+        self.windows = 0
+        self.rounds_signed = 0
+
+    def round_tables(self, round_index: int):
+        """One round's (msgs, sigs) tables — host numpy, the unit the
+        window staging loops over; also the piece a last-round
+        majority recompute (``runtime/backends``) needs alone."""
+        return sign_round_tables(
+            self.sks, self.pks, round_index, self.n_values
+        )
+
+    def stage(self, lo: int, hi: int):
+        """Sign + dispatch-verify rounds ``[lo, hi)`` -> device bool
+        ``[hi-lo, B, V]`` verdict planes.  Never fetches."""
+        if not 0 <= lo < hi:
+            raise ValueError(f"bad sign-ahead window [{lo}, {hi})")
+        t0 = time.perf_counter()
+        nr = hi - lo
+        parts = [self.round_tables(r) for r in range(lo, hi)]
+        msgs = np.concatenate([m for m, _ in parts])  # [nr*B, V, LEN]
+        sigs = np.concatenate([s for _, s in parts])
+        pks_w = np.tile(self.pks, (nr, 1))
+        # The EXACT per-signature verifier, deliberately sidestepping
+        # the BA_TPU_VERIFY_RLC knob: the RLC wrapper's accept/fallback
+        # decision is a BLOCKING fetch (it would serialize this lane
+        # against the in-flight dispatches it exists to overlap), and
+        # its cofactored verdict is batch-dependent — per-round table
+        # verdicts feed the sig_rejections counter, so they must be
+        # per-signature semantics whatever window they were batched in.
+        # The exact path dispatches the chunked device program (or the
+        # native batch verifier on CPU backends) and returns WITHOUT
+        # fetching; the reshape is a lazy device view.
+        ok = _verify_received_exact(pks_w, msgs, sigs).reshape(
+            nr, self.batch, self.n_values
+        )
+        wall = time.perf_counter() - t0
+        self.sign_ahead_s += wall
+        self.windows += 1
+        self.rounds_signed += nr
+        reg = obs.default_registry()
+        reg.counter("pipeline_sign_ahead_windows_total").inc()
+        reg.counter("pipeline_sign_ahead_rounds_total").inc(nr)
+        if _metrics.default_sink().enabled:
+            _metrics.emit(
+                {
+                    "event": "sign_ahead",
+                    "v": _metrics.SCHEMA_VERSION,
+                    "lo": lo,
+                    "hi": hi,
+                    "batch": self.batch,
+                    "values": self.n_values,
+                    "wall_s": round(wall, 6),
+                    "table_bytes": int(msgs.nbytes + sigs.nbytes),
+                }
+            )
+        return ok
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _keys_at(key, round_index, batch: int):
+    """Round ``round_index``'s per-instance keys under the engine's
+    schedule: ``fold_in(fold_in(base, r), i)`` — the exact
+    ``pipeline.round_keys`` derivation, jitted once for the sequential
+    driver's per-round loop."""
+    kr = jr.fold_in(key, round_index)
+    idx = jnp.arange(batch, dtype=jnp.uint32)
+    return jax.vmap(jr.fold_in, in_axes=(None, 0))(kr, idx)
+
+
+def _host_signed_counter_delta(
+    decision, majorities, received, ok, alive, faulty, leader
+):
+    """One round's SIGNED_COUNTER_NAMES increments derived ON HOST in
+    numpy from the fetched streams — deliberately independent of the
+    in-scan ``signed_counter_delta`` formulas, so the bit-match test
+    cross-checks them (the PR 4 host-derivation discipline)."""
+    B, n = majorities.shape
+    idx = np.arange(n)[None, :]
+    lieutenants = alive & (idx != leader[:, None])
+    quorum_failures = int((decision == UNDEFINED).sum())
+    counts = [
+        int((decision == RETREAT).sum()),
+        int((decision == ATTACK).sum()),
+        int((decision == UNDEFINED).sum()),
+    ]
+    unanimous = int(max(counts) == B)
+    big = np.int64(127)
+    maj = majorities.astype(np.int64)
+    mmax = np.where(lieutenants, maj, -big).max(axis=1)
+    mmin = np.where(lieutenants, maj, big).min(axis=1)
+    disagree = (mmax != mmin) & lieutenants.any(axis=1)
+    traitor_present = (faulty & alive).any(axis=1)
+    equivocation = int((disagree & traitor_present).sum())
+    sig_rej = int((~ok).any(axis=1).sum())
+    got_a = ((received == ATTACK) & lieutenants).any(axis=1)
+    got_r = ((received == RETREAT) & lieutenants).any(axis=1)
+    rows = np.arange(B)
+    leader_faulty = faulty[rows, leader]
+    leader_alive = alive[rows, leader]
+    cmd_equiv = int((got_a & got_r & leader_faulty & leader_alive).sum())
+    return np.array(
+        [quorum_failures, unanimous, equivocation, sig_rej, cmd_equiv],
+        np.int64,
+    )
+
+
+def sequential_signed_sweep(
+    key,
+    state,
+    rounds: int,
+    *,
+    m: int = 1,
+    collapsed: bool = False,
+    sign_seed: int = 0,
+    collect_decisions: bool = True,
+    lane: SignAheadLane | None = None,
+):
+    """The BLOCKING per-round signed driver: the reference behavior the
+    sign-ahead lane must reproduce bit-exactly, and the bench A/B's
+    baseline leg.
+
+    Per round, strictly in series (the ``backends._run_signed`` shape
+    generalized to a sweep): host-sign the round's tables, verify and
+    FETCH the verdicts, dispatch one jitted signed round, FETCH its
+    outputs.  Keys derive from the same schedule the engine threads
+    (``fold_in(fold_in(base, r), i)``), tables from the same lane
+    grammar — so ``pipeline_sweep(signed=True)`` under the same
+    ``key``/``sign_seed`` is bit-identical in decisions, histograms,
+    counters and final-round majorities (the parity tests pin it).
+
+    Returns a dict: ``histograms`` [R, 3], ``decisions`` [R, B] (when
+    ``collect_decisions``), ``counters`` ({name: int} over
+    SIGNED_COUNTER_NAMES, derived on HOST — see
+    ``_host_signed_counter_delta``), ``majorities`` [B, n] (last
+    round), and ``timings`` (cumulative ``sign_s`` / ``verify_s`` /
+    ``step_s`` — the serial cost structure the bench reports).
+    """
+    from ba_tpu.parallel.pipeline import SIGNED_COUNTER_NAMES
+    from ba_tpu.parallel.sweep import signed_agreement_step
+
+    B, n = state.faulty.shape
+    if lane is None:
+        lane = SignAheadLane(B, seed=sign_seed)
+    step = jax.jit(
+        signed_agreement_step, static_argnames=("m", "collapsed")
+    )
+    alive = np.asarray(state.alive)
+    faulty = np.asarray(state.faulty)
+    leader = np.asarray(state.leader)
+    hists = np.zeros((rounds, 3), np.int64)
+    decisions = np.zeros((rounds, B), np.int64)
+    counters = np.zeros(len(SIGNED_COUNTER_NAMES), np.int64)
+    majorities = None
+    sign_s = verify_s = step_s = 0.0
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        msgs, sigs = lane.round_tables(r)
+        t1 = time.perf_counter()
+        # The exact per-signature path, like the lane (same verdict
+        # semantics on both legs is part of the parity contract); the
+        # np.asarray is the BLOCKING per-round fetch this driver is the
+        # baseline for.
+        ok = np.asarray(_verify_received_exact(lane.pks, msgs, sigs))
+        t2 = time.perf_counter()
+        keys = _keys_at(key, jnp.asarray(r, jnp.int32), B)
+        out = step(
+            keys, state, jnp.asarray(ok), m=m, collapsed=collapsed
+        )
+        # The blocking fetch the pipelined engine exists to remove:
+        # every stream comes back to host before the next round may
+        # even be signed.
+        decision = np.asarray(out["decision"])
+        maj = np.asarray(out["majorities"])
+        received = np.asarray(out["received"])
+        hists[r] = np.asarray(out["histogram"])
+        t3 = time.perf_counter()
+        decisions[r] = decision
+        majorities = maj
+        counters += _host_signed_counter_delta(
+            decision, maj, received, ok, alive, faulty, leader
+        )
+        sign_s += t1 - t0
+        verify_s += t2 - t1
+        step_s += t3 - t2
+    result = {
+        "histograms": hists,
+        "majorities": majorities,
+        "counters": {
+            name: int(v) for name, v in zip(SIGNED_COUNTER_NAMES, counters)
+        },
+        "timings": {
+            "sign_s": round(sign_s, 6),
+            "verify_s": round(verify_s, 6),
+            "step_s": round(step_s, 6),
+        },
+    }
+    if collect_decisions:
+        result["decisions"] = decisions
+    return result

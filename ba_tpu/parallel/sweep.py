@@ -18,7 +18,7 @@ import jax.random as jr
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ba_tpu.core.eig import eig_round
-from ba_tpu.core.om import om1_round, om1_round_from_coins
+from ba_tpu.core.om import om1_round, om1_round_from_coins, round1_broadcast
 from ba_tpu.core.rng import coin_bits, coin_words, unpack_coin_words
 import ba_tpu.scenario.strategies as _strategies
 from ba_tpu.core.quorum import majority_counts, quorum_decision
@@ -315,6 +315,66 @@ def failover_sweep(
         "decisions": ys[3],
         "histograms": ys[0],
         "final_state": carry[0],
+    }
+
+
+def signed_agreement_step(
+    keys: jax.Array,
+    state: SimState,
+    ok: jax.Array,
+    m: int = 1,
+    collapsed: bool = False,
+):
+    """One SIGNED SM(m) round per instance with per-instance PRNG keys
+    (the sign-ahead lane's in-scan round, ISSUE 14).
+
+    The signed twin of :func:`agreement_step`: per instance, split the
+    round key, run the commander's round-1 equivocation broadcast, gate
+    each received value on its TABLE signature verdict (``ok`` [B, V]
+    bool — the per-(instance, value) verdicts the sign-ahead host lane
+    verified for this round, gathered to the [B, n] validity mask by
+    ``sig_valid_from_tables``'s select), then the m SM relay rounds and
+    the quorum layer.  ``collapsed`` selects the O(B*n) fair-coin relay
+    (the sweep10k production path); False keeps the exact
+    per-(receiver, sender) cube — bit-identical per instance to
+    ``sm_round(sig_valid=..., received=...)`` under the same key, which
+    is the sequential-driver parity contract.
+
+    Returns the :func:`agreement_step` dict plus ``received`` [B, n]
+    (the round-1 broadcast — the signed counter verdicts read it).
+    """
+    from ba_tpu.core.sm import sm_round
+
+    def one(k, order, leader, faulty, alive, ids, ok_row):
+        st = SimState(
+            order[None], leader[None], faulty[None], alive[None], ids[None]
+        )
+        k1, k2 = jr.split(k)
+        received = round1_broadcast(k1, st)
+        # V=2 tables: the broadcast select of sig_valid_from_tables,
+        # inlined (the gather form serializes on TPU — its docstring).
+        sig_valid = jnp.where(
+            received == 1, ok_row[None, 1:2], ok_row[None, 0:1]
+        )
+        maj = sm_round(
+            k2, st, m, sig_valid=sig_valid, received=received,
+            collapsed=collapsed,
+        )
+        return maj[0], received[0]
+
+    majorities, received = jax.vmap(one)(
+        keys, state.order, state.leader, state.faulty, state.alive,
+        state.ids, ok,
+    )
+    n_attack, n_retreat, n_undefined = majority_counts(majorities, state.alive)
+    decision, needed, total = quorum_decision(n_attack, n_retreat, n_undefined)
+    return {
+        "majorities": majorities,
+        "decision": decision,
+        "needed": needed,
+        "total": total,
+        "histogram": decision_histogram(decision),
+        "received": received,
     }
 
 

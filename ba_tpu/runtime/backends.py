@@ -124,6 +124,8 @@ class JaxBackend:
         self._signed_compiled = None  # (jitted r1, jitted post-sign) pair
         self._keys = None  # cached (sks, pks) for the B=1 commander
         self._majorities_fn = None  # jitted last-round majority recompute
+        self._signed_maj_fn = None  # signed twin of _majorities_fn
+        self._sign_lane = None  # cached sign-ahead lane (B=1 commander)
         self._round_keys_fn = None  # jitted on-device key derivation
 
     @staticmethod
@@ -285,12 +287,13 @@ class JaxBackend:
     ):
         """``rounds`` agreement rounds through the pipelined sweep engine.
 
-        Oral-message protocols only (the signed path host-signs between
-        device programs, which is exactly the host round-trip the pipeline
-        exists to avoid — callers fall back to per-round ``run_round``
-        there): one donated key-schedule thread drives all R rounds with
-        depth-``BA_TPU_PIPELINE_DEPTH`` dispatches in flight and
-        ``host_work`` (metrics emission) overlapping device compute.
+        Oral-message protocols ride the plain megasteps; ``signed=True``
+        SM(m) rides the SIGNED megastep behind the sign-ahead host lane
+        (ISSUE 14): per-round signature tables prepared in the engine's
+        host_work overlap slot while depth-k dispatches are in flight —
+        the host round-trip that used to force the per-round
+        ``_run_signed`` fallback is gone.  Unsigned SM still falls back
+        (returns None): its relay has no pipelined path yet.
 
         Returns ``(majorities_last, decision_codes, stats)`` — the last
         round's per-roster-general majorities (for the REPL's per-general
@@ -307,22 +310,16 @@ class JaxBackend:
         import jax.random as jr
         import numpy as np
 
-        if self.protocol != "om" or self.signed:
+        if self.protocol != "om" and not self.signed:
             # Explicitly asking the kernel engine (ISSUE 13) to run a
             # path that cannot be pipelined at all deserves a loud
             # error, not the silent sequential fallback: the caller
             # expressed an engine expectation the fallback would betray.
             if engine in ("pallas", "interpret"):
-                from ba_tpu.parallel.pipeline import engine_support
-
                 raise ValueError(
                     f"engine={engine!r} unsupported: "
-                    + (
-                        engine_support(signed=True)
-                        if self.signed
-                        else f"protocol={self.protocol!r} has no "
-                        f"pipelined path"
-                    )
+                    f"protocol={self.protocol!r} unsigned has no "
+                    f"pipelined path"
                 )
             return None
 
@@ -353,6 +350,7 @@ class JaxBackend:
             rounds_per_dispatch=per_dispatch,
             collect_decisions=True,
             with_counters=True,
+            signed=self.signed,
             host_work=host_work,
             executables=executables,
             engine=engine,
@@ -362,19 +360,43 @@ class JaxBackend:
         # pipeline executed — the schedule's determinism contract — at the
         # cost of one extra B=1 dispatch, which keeps majority collection
         # out of the engine's steady-state outputs.
-        if self._majorities_fn is None:
-            self._majorities_fn = jax.jit(
-                lambda keys, st: agreement_step(keys, st, m=self.m)[
-                    "majorities"
-                ]
-            )
         if self._round_keys_fn is None:
             # Cached like _majorities_fn: a fresh jax.jit wrapper per call
             # would retrace (and recompile, seconds on the tunnel) every
             # run-rounds invocation.
             self._round_keys_fn = jax.jit(round_keys, static_argnums=1)
         keys_last = self._round_keys_fn(make_key_schedule(key, rounds - 1), 1)
-        maj = self._majorities_fn(keys_last, state_copy)
+        if self.signed:
+            # The signed block recomputes through the SAME lane grammar
+            # the engine staged: the last round's table verdicts gate
+            # the recomputed broadcast exactly as they did in-scan.
+            from ba_tpu.crypto.signed import _verify_received_exact
+            from ba_tpu.parallel.signing import SignAheadLane
+            from ba_tpu.parallel.sweep import signed_agreement_step
+
+            if self._sign_lane is None:
+                self._sign_lane = SignAheadLane(1, seed=0)
+            if self._signed_maj_fn is None:
+                m = self.m
+                self._signed_maj_fn = jax.jit(
+                    lambda keys, st, ok: signed_agreement_step(
+                        keys, st, ok, m=m
+                    )["majorities"]
+                )
+            msgs, sigs = self._sign_lane.round_tables(rounds - 1)
+            # Exact per-signature semantics, like the lane's staging
+            # (the RLC knob's batch-dependent verdicts never reach the
+            # signed round tables).
+            ok = _verify_received_exact(self._sign_lane.pks, msgs, sigs)
+            maj = self._signed_maj_fn(keys_last, state_copy, ok)
+        else:
+            if self._majorities_fn is None:
+                self._majorities_fn = jax.jit(
+                    lambda keys, st: agreement_step(keys, st, m=self.m)[
+                        "majorities"
+                    ]
+                )
+            maj = self._majorities_fn(keys_last, state_copy)
         majorities = [int(v) for v in np.asarray(maj[0, :n])]
         decisions = [int(v) for v in out["decisions"][:, 0]]
         # The on-device agreement counters ride the stats block (they

@@ -149,6 +149,16 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
             f"Rounds: {rounds} - attack={counts['attack']}, "
             f"retreat={counts['retreat']}, undefined={counts['undefined']}"
         )
+        if _stats and _stats.get("signed"):
+            # Signed lane evidence (ISSUE 14): the sign-ahead host lane
+            # ran — one additive line so an interactive signed session
+            # can see its overlap wall without opening the metrics
+            # stream.
+            out(
+                f"Signed lane: sign_ahead_s="
+                f"{_stats.get('sign_ahead_s')}, dispatches="
+                f"{_stats.get('dispatches')}"
+            )
 
     elif command == "scenario":
         # Framework extension (additive, like run-rounds): a whole

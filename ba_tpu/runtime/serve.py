@@ -186,6 +186,21 @@ class ServeConfig:
     #                                 is first-class traffic; False
     #                                 halves warmup wall when the fleet
     #                                 is known interactive-only)
+    warm_signed: bool = True       # ISSUE 14: also warm SIGNED-cohort
+    #                                 specializations (the lattice's
+    #                                 signed axis) — a fleet including
+    #                                 signed cohorts keeps
+    #                                 serve_compile_on_request_path_total
+    #                                 at 0 after the warm barrier; False
+    #                                 trims warmup wall for fleets that
+    #                                 never sign
+    warm_ms: tuple | None = None   # ISSUE 14: m values the lattice
+    #                                 warms (None = just the config's
+    #                                 `m` dial).  Per-request m joined
+    #                                 the cohort key, so a fleet that
+    #                                 serves m=2 EIG cohorts lists it
+    #                                 here or pays one counted
+    #                                 compile-on-miss per unwarmed m
     aot_cache: str | None = None   # executable-cache dir; None = the
     #                                 BA_TPU_AOT_CACHE / default dir
     engine: str = "xla"            # ISSUE 13: the service's default
@@ -238,6 +253,14 @@ class ServeConfig:
                 raise ValueError(
                     f"warm_capacities entry {cap!r} must be an int >= 1"
                 )
+        if self.warm_ms is not None:
+            for mv in self.warm_ms:
+                if not isinstance(mv, int) or isinstance(mv, bool) or (
+                    mv < 1
+                ):
+                    raise ValueError(
+                        f"warm_ms entry {mv!r} must be an int >= 1"
+                    )
         if self.engine not in ENGINE_TOKENS:
             raise ValueError(
                 f"engine={self.engine!r} not in {ENGINE_TOKENS}"
@@ -323,6 +346,15 @@ class AgreementRequest:
     # service's configured default).  Joins the cohort key — an engine
     # request never coalesces into another engine's batch.
     engine: str | None = None
+    # ISSUE 14: per-request protocol dials, both cohort-key members so
+    # one front-end serves oral, signed and mixed-depth traffic
+    # CONCURRENTLY without ever coalescing across protocols.  ``m`` is
+    # the recursion/relay depth (None = the service's single ``m``
+    # dial, the PR 10 behavior); ``signed=True`` runs the request
+    # through the signed SM(m) lane (sign-ahead tables + the signed
+    # coalesced megastep).
+    m: int | None = None
+    signed: bool = False
 
 
 def validate_request(req: AgreementRequest) -> AgreementRequest:
@@ -347,9 +379,18 @@ def validate_request(req: AgreementRequest) -> AgreementRequest:
         raise ValueError(
             f"engine={req.engine!r} not in {ENGINE_TOKENS}"
         )
+    if req.m is not None and (
+        not isinstance(req.m, int) or isinstance(req.m, bool) or req.m < 1
+    ):
+        raise ValueError(f"m={req.m!r} must be an int >= 1 (or None)")
     if req.kind == "scenario":
         if req.spec is None:
             raise ValueError("kind='scenario' needs a spec")
+        if req.signed:
+            raise ValueError(
+                "signed requests cannot carry a scenario (the signed "
+                "megastep has no mutating-round form)"
+            )
     elif req.spec is not None:
         raise ValueError(f"kind={req.kind!r} does not take a spec")
     if req.kind == "actual-order" and req.rounds != 1:
@@ -366,15 +407,23 @@ def request_rounds(req: AgreementRequest) -> int:
     return req.spec.rounds if req.kind == "scenario" else req.rounds
 
 
-def cohort_key(req: AgreementRequest, default_engine: str = "xla") -> tuple:
+def cohort_key(
+    req: AgreementRequest,
+    default_engine: str = "xla",
+    default_m: int = 1,
+) -> tuple:
     """Requests sharing this key coalesce into one batch: same compiled
-    specialization (round count, padded capacity, scenario-ness, and —
-    ISSUE 13 — the effective engine request, so pallas and xla cohorts
-    never share a batch; the dispatcher passes its config's default) —
+    specialization (round count, padded capacity, scenario-ness, the
+    effective engine request — ISSUE 13 — and, ISSUE 14, the PROTOCOL:
+    the effective recursion/relay depth ``m`` and the ``signed`` flag,
+    so signed and m>=2 EIG cohorts coalesce separately but serve
+    concurrently; the dispatcher passes its config's defaults) —
     orders, seeds, fault patterns and event planes are per-slot DATA."""
     return (
         req.kind == "scenario", request_rounds(req), _capacity(req.n),
         req.engine or default_engine,
+        default_m if req.m is None else req.m,
+        bool(req.signed),
     )
 
 
@@ -760,7 +809,9 @@ class AgreementService:
                 head = t
                 break
             if head is not None:
-                ckey = cohort_key(head.request, self._cfg.engine)
+                ckey = cohort_key(
+                    head.request, self._cfg.engine, self._cfg.m
+                )
                 cohort = [head]
                 window_end = time.perf_counter() + self._window_s
                 while len(cohort) < self._cfg.max_batch:
@@ -775,7 +826,9 @@ class AgreementService:
                             expired.append(t)
                         elif (
                             len(cohort) < self._cfg.max_batch
-                            and cohort_key(t.request, self._cfg.engine)
+                            and cohort_key(
+                                t.request, self._cfg.engine, self._cfg.m
+                            )
                             == ckey
                         ):
                             cohort.append(t)
@@ -998,8 +1051,8 @@ class AgreementService:
 
         import jax.numpy as jnp
 
-        is_scenario, rounds, cap, engine = cohort_key(
-            live[0].request, self._cfg.engine
+        is_scenario, rounds, cap, engine, m, signed = cohort_key(
+            live[0].request, self._cfg.engine, self._cfg.m
         )
         n_live = len(live)
         B = min(_batch_bucket(n_live), _batch_bucket(self._cfg.max_batch))
@@ -1046,10 +1099,11 @@ class AgreementService:
             keys,
             state,
             rounds,
-            m=self._cfg.m,
+            m=m,
             depth=self._cfg.depth,
             rounds_per_dispatch=self._cfg.rounds_per_dispatch,
             scenario=planes,
+            signed=signed,
             exec_seam=self._seam,
             executables=self._exec_cache,
             engine=engine,

@@ -50,6 +50,7 @@ the persistent entry directory (``obs/aotcache.py``).
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -64,7 +65,12 @@ WARM_ENV = "BA_TPU_WARM"
 # construction stays jax-free (a drifted name simply never matches a
 # ledger row; the builder lookup below would raise loudly on a plan
 # that names an unknown fn).
-WARM_FNS = ("coalesced_megastep", "pipeline_megastep", "scenario_megastep")
+WARM_FNS = (
+    "coalesced_megastep",
+    "pipeline_megastep",
+    "scenario_megastep",
+    "signed_megastep",
+)
 
 
 def builder_for(fn: str):
@@ -95,6 +101,8 @@ def bucket_lattice(
     m: int = 1,
     scenarios=(False,),
     engines=("xla",),
+    signeds=(False,),
+    ms=None,
 ) -> list:
     """The serving dispatcher's reachable coalesced specializations:
     ``(fn, axes)`` pairs over every power-of-two batch bucket up to the
@@ -124,39 +132,63 @@ def bucket_lattice(
         windows.add(min(rounds, rounds_per_dispatch))
         if rounds % rounds_per_dispatch:
             windows.add(rounds % rounds_per_dispatch)
+    # The m axis (ISSUE 14): requests may carry their own recursion /
+    # relay depth into the cohort key, so the lattice enumerates every
+    # m the operator expects to serve (default: the config's single
+    # dial).  A request with an UNWARMED m still serves — it pays one
+    # counted compile-on-miss, exactly like an unwarmed window.
+    m_values = []
+    for mv in (ms if ms is not None else (m,)):
+        if not isinstance(mv, int) or isinstance(mv, bool) or mv < 1:
+            raise ValueError(f"m value {mv!r} must be an int >= 1")
+        if mv not in m_values:
+            m_values.append(mv)
+    for cap in capacities:
+        if cap < 1:
+            raise ValueError(f"capacity {cap} must be >= 1")
     plan = []
-    for engine in engines:
-        for scenario in scenarios:
-            for cap in capacities:
-                if cap < 1:
-                    raise ValueError(f"capacity {cap} must be >= 1")
-                for batch in buckets:
-                    for window in sorted(windows):
-                        plan.append(
-                            (
-                                "coalesced_megastep",
-                                {
-                                    "batch": batch,
-                                    "capacity": cap,
-                                    "rounds": window,
-                                    "m": m,
-                                    "max_liars": None,
-                                    # Literal 1 = coalesced_sweep's
-                                    # unroll default (serve never
-                                    # overrides it); if serving ever
-                                    # grows an unroll dial this must
-                                    # track min(unroll, window) or warm
-                                    # lookups silently stop matching.
-                                    "unroll": 1,
-                                    "scenario": bool(scenario),
-                                    # ISSUE 13: the engine is a compile
-                                    # axis — a warm lookup without it
-                                    # would never match the dispatch
-                                    # loop's signature.
-                                    "engine": engine,
-                                },
-                            )
-                        )
+    for signed in signeds:
+        # Signed cohorts (ISSUE 14) exist only on the XLA core and
+        # never carry scenario planes — the lattice mirrors the
+        # dispatch loop's reachable combinations exactly, not the
+        # cross product.
+        combos = itertools.product(
+            engines if not signed else ("xla",),
+            scenarios if not signed else (False,),
+            capacities,
+            m_values,
+            buckets,
+            sorted(windows),
+        )
+        for engine, scenario, cap, mv, batch, window in combos:
+            plan.append(
+                (
+                    "coalesced_megastep",
+                    {
+                        "batch": batch,
+                        "capacity": cap,
+                        "rounds": window,
+                        "m": mv,
+                        "max_liars": None,
+                        # Literal 1 = coalesced_sweep's unroll default
+                        # (serve never overrides it); if serving ever
+                        # grows an unroll dial this must track
+                        # min(unroll, window) or warm lookups silently
+                        # stop matching.
+                        "unroll": 1,
+                        "scenario": bool(scenario),
+                        # ISSUE 14: protocol axes — a warm lookup
+                        # without them would never match the dispatch
+                        # loop's uniform coalesced signature.
+                        "signed": bool(signed),
+                        "collapsed": False,
+                        # ISSUE 13: the engine is a compile axis — a
+                        # warm lookup without it would never match the
+                        # dispatch loop's signature.
+                        "engine": engine,
+                    },
+                )
+            )
     return plan
 
 
@@ -190,6 +222,12 @@ def ledger_replay_set(fns=WARM_FNS) -> list:
             axes.setdefault("engine", "xla")
             if axes["engine"] not in ("xla", "pallas", "interpret"):
                 continue
+            # Pre-ISSUE-14 rows carry no protocol axes: they were oral
+            # compiles — same in-place upgrade (`collapsed` exists only
+            # on the coalesced/signed signatures).
+            axes.setdefault("signed", False)
+            if fn in ("coalesced_megastep", "signed_megastep"):
+                axes.setdefault("collapsed", False)
             out.append((fn, axes))
     return out
 
@@ -235,6 +273,15 @@ def service_plan(config) -> list:
         m=config.m,
         scenarios=(False, True) if config.warm_scenarios else (False,),
         engines=plan_engines(config),
+        signeds=(
+            (False, True)
+            if getattr(config, "warm_signed", False)
+            else (False,)
+        ),
+        # The config's own m dial is ALWAYS warm (it is every
+        # m=None request's effective depth); warm_ms adds the other
+        # depths the fleet's per-request overrides will ask for.
+        ms=(config.m,) + tuple(getattr(config, "warm_ms", None) or ()),
     )
     seen: set = set()
     deduped = []

@@ -2938,6 +2938,136 @@ def bench_megastep_ab(jax, jnp, jr):
     }
 
 
+def bench_signed_ab(jax, jnp, jr):
+    """ISSUE 14: the sign-ahead lane A/B — the pipelined SIGNED sweep
+    (``pipeline_sweep(signed=True)``: per-round signature tables signed
+    on host in the overlap slot, verification dispatched ahead, depth-k
+    megasteps in flight) vs the blocking sequential signed driver
+    (``parallel.signing.sequential_signed_sweep``: sign -> verify-fetch
+    -> dispatch -> fetch, per round — the ``backends._run_signed``
+    shape).  Two legs, every pair bit-exact asserted (decisions,
+    histograms, counters) before any timing is believed:
+
+    1. ``interactive`` — B=1 at the interactive roster shape (capacity
+       4, SM(1), exact relay): the ``run-rounds`` signed path this PR
+       moves off the per-round fallback.  Engine overheads (per-round
+       dispatch + fetches + host bookkeeping) dominate here, which is
+       exactly what the pipeline removes — the CPU-measurable win, and
+       the gated acceptance number (``interactive_speedup_within_target``
+       >= 1.5x).
+    2. ``sweep`` — the ``sweep10k_signed`` discipline (power-of-two
+       capacity, m=3, collapsed relay) at an env-scaled batch
+       (``BA_TPU_BENCH_SIGNED_BATCH``, default 2048; 10240 restores the
+       full production shape).  On a CPU host this leg is HOST-VERIFY
+       BOUND: the native Ed25519 batch verifier runs ~11k sigs/s on one
+       core and both legs pay it identically, so the speedup reads ~1x
+       BY CONSTRUCTION — there is no second core for the lane to
+       overlap into and no async device verify queue.  The number is
+       reported honestly (not gated); the overlap reading at this shape
+       is a TPU number (device-side chunked verify + host signing off
+       the critical path) and rides the consolidated tunnel measurement
+       pass (ROADMAP).  ``host_sign_fraction``/``host_verify_fraction``
+       decompose the sequential wall so the artifact shows WHERE the
+       single-core wall sits.
+    """
+    import numpy as np
+
+    from ba_tpu.parallel import fresh_copy, make_sweep_state
+    from ba_tpu.parallel.pipeline import pipeline_sweep
+    from ba_tpu.parallel.signing import SignAheadLane, sequential_signed_sweep
+
+    depth = int(os.environ.get("BA_TPU_PIPELINE_DEPTH", 2))
+    reps = 3
+
+    def ab(B, cap, m, collapsed, rounds, rpd, seed):
+        state = make_sweep_state(make_key(seed), B, cap)
+        key = make_key(seed + 1)
+        lane = SignAheadLane(B, seed=0)
+
+        def run_seq():
+            return sequential_signed_sweep(
+                key, state, rounds, m=m, collapsed=collapsed, lane=lane
+            )
+
+        def run_pipe():
+            return pipeline_sweep(
+                key, fresh_copy(state), rounds, signed=True, m=m,
+                collapsed=collapsed, depth=depth,
+                rounds_per_dispatch=rpd, collect_decisions=True,
+            )
+
+        # Warm + verify off the clock: compiles, the chunk-shaped verify
+        # program, and the bit-exactness gate.
+        ref = run_seq()
+        out = run_pipe()
+        bit = (
+            np.array_equal(out["histograms"], ref["histograms"])
+            and np.array_equal(out["decisions"], ref["decisions"])
+            and out["counters"] == ref["counters"]
+        )
+        t_seq = t_pipe = float("inf")
+        last_pipe = None
+        for _ in range(reps):  # interleaved pairs: window drift cancels
+            t0 = time.perf_counter()
+            ref = run_seq()
+            t_seq = min(t_seq, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            last_pipe = run_pipe()
+            t_pipe = min(t_pipe, time.perf_counter() - t0)
+        return {
+            "batch": B, "n_max": cap, "m": m, "collapsed": collapsed,
+            "rounds": rounds, "rounds_per_dispatch": rpd,
+            "seq_s": round(t_seq, 4), "pipe_s": round(t_pipe, 4),
+            "speedup": round(t_seq / t_pipe, 3),
+            "rounds_per_sec": round(B * rounds / t_pipe, 1),
+            "seq_rounds_per_sec": round(B * rounds / t_seq, 1),
+            "bit_exact": bool(bit),
+            "seq_timings": ref["timings"],
+            "sign_ahead_s": last_pipe["stats"]["sign_ahead_s"],
+            "host_sign_fraction": round(
+                ref["timings"]["sign_s"] / t_seq, 4
+            ),
+            "host_verify_fraction": round(
+                ref["timings"]["verify_s"] / t_seq, 4
+            ),
+        }
+
+    interactive = ab(1, 4, 1, False, 64, 8, 50)
+    sweep_batch = int(os.environ.get("BA_TPU_BENCH_SIGNED_BATCH", 2048))
+    sweep_cap = int(os.environ.get("BA_TPU_BENCH_SIGNED_CAP", 256))
+    sweep_rounds = int(os.environ.get("BA_TPU_BENCH_SIGNED_ROUNDS", 16))
+    sweep = ab(sweep_batch, sweep_cap, 3, True, sweep_rounds, 8, 52)
+    target = 1.5
+    return {
+        "rounds_per_sec": interactive["rounds_per_sec"],
+        "interactive": interactive,
+        "sweep": sweep,
+        "interactive_speedup": interactive["speedup"],
+        "sweep_speedup": sweep["speedup"],
+        "speedup_target": target,
+        "bit_exact_interactive": interactive["bit_exact"],
+        "bit_exact_sweep": sweep["bit_exact"],
+        "interactive_speedup_within_target": bool(
+            interactive["speedup"] >= target
+        ),
+        "elapsed_s": interactive["pipe_s"],
+        "bound": "protocol lane only: identical key schedule, round "
+                 "tables and outputs on both legs — the delta is the "
+                 "sequential driver's per-round sign -> verify-fetch -> "
+                 "dispatch -> fetch serialization vs the lane's "
+                 "windowed sign-ahead + depth-k megasteps",
+        "note": "the sweep leg on a CPU host is single-core "
+                "host-verify-bound (~11k sigs/s native): both legs pay "
+                "the identical Ed25519 wall and the speedup reads ~1x "
+                "by construction — the overlap win at the production "
+                "shape is a TPU number (device verify queue + host "
+                "signing off the critical path) and rides the "
+                "consolidated tunnel measurement pass; the gated "
+                "acceptance number is the interactive leg, where the "
+                "engine overheads the pipeline removes dominate",
+    }
+
+
 CONFIGS = {
     # Latency-sensitive configs first: dispatch through the TPU tunnel gets
     # noticeably slower once the big Ed25519-verify programs have run
@@ -2951,6 +3081,7 @@ CONFIGS = {
     "pipeline_sweep": bench_pipeline_sweep,
     "scenario_sweep": bench_scenario_sweep,
     "megastep_ab": bench_megastep_ab,
+    "signed_ab": bench_signed_ab,
     "scenario_long": bench_scenario_long,
     "resilience": bench_resilience,
     "serving": bench_serving,
@@ -2975,7 +3106,7 @@ DEFAULT_CONFIGS = [
     n for n in CONFIGS
     if n not in (
         "scenario_long", "resilience", "multichip", "serving",
-        "serving_warm", "megastep_ab",
+        "serving_warm", "megastep_ab", "signed_ab",
     )
 ]
 

@@ -81,6 +81,27 @@ def main() -> int:
             jr.key(2), make_sweep_state(jr.key(3), 4, 8), 2,
             with_counters=True, engine="interpret",
         )
+        # Sign-ahead lane records (ISSUE 14): a tiny SIGNED campaign
+        # drives the real sign_ahead emitter (one record per staged
+        # window) and stamps the signed compile-signature axis; an
+        # oral -> signed coalesced pair at EQUAL shapes then forces the
+        # protocol-flip recompile the explainer must attribute to
+        # exactly the signed axis.
+        from ba_tpu.parallel.pipeline import coalesced_sweep, fresh_copy
+
+        pipeline_sweep(
+            jr.key(10), make_sweep_state(jr.key(11), 4, 4), 4,
+            signed=True, rounds_per_dispatch=2, engine="xla",
+        )
+        _st_pair = make_sweep_state(jr.key(12), 2, 4)
+        coalesced_sweep(
+            [jr.key(13), jr.key(14)], fresh_copy(_st_pair), 2,
+            rounds_per_dispatch=2,
+        )
+        coalesced_sweep(
+            [jr.key(13), jr.key(14)], fresh_copy(_st_pair), 2,
+            rounds_per_dispatch=2, signed=True,
+        )
         # Streaming-engine records (ISSUE 6): a tiny sparse campaign
         # with checkpoint_every drives the real scenario_checkpoint
         # emitter (carry serialization inside the retire fetch).
@@ -215,12 +236,22 @@ def main() -> int:
             warm_svc.submit(
                 AgreementRequest(kind="run-rounds", n=4, seed=5, rounds=2)
             ).result(timeout=300)
+            # ISSUE 14 acceptance: the fleet INCLUDES a signed cohort
+            # and the barrier-warmed service still never compiles on
+            # the request path — the warmup lattice covers the signed
+            # axis.
+            warm_svc.submit(
+                AgreementRequest(
+                    kind="run-rounds", n=4, seed=6, rounds=2, signed=True
+                )
+            ).result(timeout=300)
             warm_stats = warm_svc.stats()
             warm_svc.stop()
         if warm_stats["compiles_on_request_path"] != 0:
             print(
-                f"schema check: warm service compiled on the request "
-                f"path ({warm_stats['compiles_on_request_path']}x)",
+                f"schema check: warm service (incl. a signed cohort) "
+                f"compiled on the request path "
+                f"({warm_stats['compiles_on_request_path']}x)",
                 file=sys.stderr,
             )
             return 1
@@ -235,6 +266,7 @@ def main() -> int:
         bad = 0
         events = set()
         engine_flips = []  # ISSUE 13: recompile records' engine-axis pairs
+        signed_flips = []  # ISSUE 14: recompile records' signed-axis pairs
         from ba_tpu.obs import flight as _flight
 
         def _num_or_null(v):
@@ -331,6 +363,22 @@ def main() -> int:
                         bad += 1
                     else:
                         engine_flips.append(pair)
+                if isinstance(changed, dict) and "signed" in changed:
+                    # ISSUE 14: the signed axis is a bool pair (old may
+                    # be null on a cross-process diff against a
+                    # pre-signed-axis row).
+                    pair = changed["signed"]
+                    if not all(
+                        v is None or isinstance(v, bool) for v in pair
+                    ):
+                        print(
+                            f"schema check: line {i} malformed signed "
+                            f"axis: {line[:160]}",
+                            file=sys.stderr,
+                        )
+                        bad += 1
+                    else:
+                        signed_flips.append(pair)
             elif rec.get("event") == "recovery":
                 if not (
                     rec.get("fault") in ("transient", "fatal", "oom")
@@ -381,6 +429,26 @@ def main() -> int:
                     print(
                         f"schema check: line {i} malformed "
                         f"scenario_checkpoint: {line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "sign_ahead":
+                # Sign-ahead lane records (ISSUE 14): one per staged
+                # window of per-round signature tables.
+                if not (
+                    isinstance(rec.get("lo"), int)
+                    and isinstance(rec.get("hi"), int)
+                    and rec.get("lo") < rec.get("hi")
+                    and isinstance(rec.get("batch"), int)
+                    and rec.get("batch") >= 1
+                    and isinstance(rec.get("values"), int)
+                    and rec.get("values") >= 1
+                    and isinstance(rec.get("wall_s"), (int, float))
+                    and isinstance(rec.get("table_bytes"), int)
+                ):
+                    print(
+                        f"schema check: line {i} malformed sign_ahead: "
+                        f"{line[:160]}",
                         file=sys.stderr,
                     )
                     bad += 1
@@ -584,6 +652,11 @@ def main() -> int:
                     "pipeline_shards",
                     "pipeline_carry_bytes_per_shard",
                     "scenario_plane_bytes_per_shard",
+                    # Sign-ahead lane family (ISSUE 14): the signed
+                    # campaign above must have left its overlap gauge
+                    # and window counter behind.
+                    "host_sign_ahead_s",
+                    "pipeline_sign_ahead_windows_total",
                 ):
                     snap = metrics_blk.get(g)
                     if not (
@@ -611,6 +684,7 @@ def main() -> int:
             "admission",
             "shed",
             "warmup",
+            "sign_ahead",
         }
         if not want <= events:
             print(
@@ -626,6 +700,16 @@ def main() -> int:
             print(
                 f"schema check: no recompile record explained the "
                 f"engine flip (saw {engine_flips})",
+                file=sys.stderr,
+            )
+            bad += 1
+        if [False, True] not in signed_flips:
+            # The oral -> signed coalesced pair above re-specialized at
+            # equal shapes: the explainer must read the PROTOCOL flip
+            # off the signed axis (ISSUE 14).
+            print(
+                f"schema check: no recompile record explained the "
+                f"signed protocol flip (saw {signed_flips})",
                 file=sys.stderr,
             )
             bad += 1

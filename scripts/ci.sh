@@ -144,6 +144,14 @@ mutate_and_expect BA101 parallel/shard.py \
 mutate_and_expect BA101 ops/scenario_step.py \
     'def _mut101_megastep(x):
     return x.block_until_ready()' || exit 1
+# ISSUE 14: the sign-ahead host lane (parallel/signing.py) is a NEW
+# module inside the BA101 hot-path scope (ba_tpu.parallel.*) — its job
+# is host work in the overlap slot, but a block_until_ready there would
+# serialize the lane against the in-flight dispatches it exists to
+# overlap.  Prove the scope covers it.
+mutate_and_expect BA101 parallel/signing.py \
+    'def _mut101_signing(x):
+    return x.block_until_ready()' || exit 1
 # ISSUE 9: BA301 grew the symmetric host-tier scope — obs modules
 # (the flight recorder and health sampler in particular) must never
 # import through ba_tpu.core/ba_tpu.ops.  Prove the direction is live.
@@ -249,11 +257,13 @@ if ! python scripts/bench_sentinel.py --index-only; then
 fi
 # The full perf-regression sentinel runs a REAL bench.py rep and
 # compares against the newest committed baseline per config — minutes
-# of wall clock, so it is opt-in like the resilience/multichip bench
-# configs: BA_TPU_CI_SENTINEL=1 (optionally BA_TPU_CI_SENTINEL_CONFIGS
-# to narrow the config list).
-if [ "${BA_TPU_CI_SENTINEL:-0}" = "1" ]; then
-    echo "== perf-regression sentinel (opt-in) =="
+# of wall clock.  DEFAULT-ON since ISSUE 14: BENCH_trajectory.json now
+# carries 40+ indexed rows of CPU baselines, so the trajectory gate
+# has teeth on the CI platform — export BA_TPU_CI_SENTINEL=0 to opt a
+# constrained host out (BA_TPU_CI_SENTINEL_CONFIGS narrows the config
+# list).
+if [ "${BA_TPU_CI_SENTINEL:-1}" = "1" ]; then
+    echo "== perf-regression sentinel (default-on; BA_TPU_CI_SENTINEL=0 opts out) =="
     if ! python scripts/bench_sentinel.py --run \
             --configs "${BA_TPU_CI_SENTINEL_CONFIGS:-pipeline_sweep,scenario_sweep}"; then
         echo "perf-regression sentinel failed" >&2

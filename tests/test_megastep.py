@@ -337,19 +337,29 @@ def test_engine_eager_errors_and_counted_fallback():
 def test_backend_run_rounds_signed_engine_errors_eagerly():
     from ba_tpu.runtime.backends import JaxBackend
 
-    be = JaxBackend(protocol="sm", m=1, signed=True)
-    # The silent sequential fallback (None) is fine by default...
     class _G:
         def __init__(self, i):
             self.id = i
             self.faulty = False
+            self.alive = True
 
     gens = [_G(i + 1) for i in range(4)]
-    assert be.run_rounds(gens, 0, 1, 0, 2) is None
-    # ...but an explicit kernel-engine request must error, not silently
-    # betray the engine expectation.
+    # UNSIGNED sm still has no pipelined path: silent None by default,
+    # loud error on an explicit kernel-engine request.
+    be_plain = JaxBackend(protocol="sm", m=1, signed=False)
+    assert be_plain.run_rounds(gens, 0, 1, 0, 2) is None
+    with pytest.raises(ValueError, match="pipelined"):
+        be_plain.run_rounds(gens, 0, 1, 0, 2, engine="pallas")
+    # SIGNED sm rides the sign-ahead lane (ISSUE 14) — but an explicit
+    # kernel-engine request must still error eagerly: the kernel never
+    # covered the SM relay.
+    be = JaxBackend(protocol="sm", m=1, signed=True)
     with pytest.raises(ValueError, match="signed"):
         be.run_rounds(gens, 0, 1, 0, 2, engine="pallas")
+    out = be.run_rounds(gens, 0, 1, 0, 2)
+    assert out is not None
+    majorities, decisions, stats = out
+    assert stats["signed"] is True and len(decisions) == 2
 
 
 def test_engine_axis_is_an_explained_recompile():

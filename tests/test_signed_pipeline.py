@@ -1,0 +1,538 @@
+"""Sign-ahead lane tests (ISSUE 14): the pipelined signed SM(m)
+protocol.
+
+The contract under test, layer by layer:
+
+- the pipelined signed sweep is BIT-EXACT with the blocking sequential
+  signed driver under the same key schedule and round tables
+  (decisions / histograms / counters — the counters cross-checked
+  against an independent host numpy derivation);
+- the no-blocking dispatch-count proof holds with the sign-ahead lane
+  live (host signing + verify dispatch in the overlap slot add no
+  synchronization);
+- signed carries checkpoint and resume bit-exactly, and a carry never
+  crosses protocols;
+- signed cohorts serve coalesced with per-slot parity (batched ≡
+  alone, bit-identical), and the serving cohort key separates signed
+  and m>=2 traffic while one service front-end serves them
+  concurrently;
+- engine selection: explicit kernel requests on signed raise eagerly,
+  env/auto preferences fall back counted;
+- the warmup lattice covers the signed axis.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import jax.random as jr  # noqa: E402
+
+from ba_tpu.core.state import SimState  # noqa: E402
+from ba_tpu.core.types import COMMAND_DTYPE  # noqa: E402
+from ba_tpu.parallel.pipeline import (  # noqa: E402
+    SIGNED_COUNTER_NAMES,
+    coalesced_sweep,
+    fresh_copy,
+    load_carry_checkpoint,
+    pipeline_sweep,
+)
+from ba_tpu.parallel.signing import sequential_signed_sweep  # noqa: E402
+from ba_tpu.parallel.sweep import make_sweep_state  # noqa: E402
+
+
+def churn_state(batch, cap, *, faulty_leaders=True, seed=3):
+    """A sweep state with (optionally) half the leaders faulty, so the
+    commander-equivocation verdicts actually fire."""
+    state = make_sweep_state(jr.key(seed), batch, cap)
+    if faulty_leaders:
+        faulty = np.asarray(state.faulty).copy()
+        leader = np.asarray(state.leader)
+        for b in range(0, batch, 2):
+            faulty[b, leader[b]] = True
+        state = SimState(
+            state.order, state.leader, jnp.asarray(faulty),
+            state.alive, state.ids,
+        )
+    return state
+
+
+def alone_state(n, faulty, order, cap):
+    f = np.zeros((1, cap), bool)
+    a = np.zeros((1, cap), bool)
+    a[0, :n] = True
+    for i in faulty:
+        f[0, i] = True
+    return fresh_copy(
+        SimState(
+            order=jnp.asarray(np.full(1, order, np.int8).astype(COMMAND_DTYPE)),
+            leader=jnp.zeros(1, jnp.int32),
+            faulty=jnp.asarray(f),
+            alive=jnp.asarray(a),
+            ids=jnp.asarray(
+                np.tile(np.arange(1, cap + 1, dtype=np.int32), (1, 1))
+            ),
+        )
+    )
+
+
+# -- encoders -----------------------------------------------------------------
+
+
+def test_round_table_msgs_match_per_call_encoder():
+    from ba_tpu.crypto import signed as cs
+
+    msgs = cs._round_table_msgs(5, 7, 2, base=3)
+    for b in range(5):
+        for v in range(2):
+            assert msgs[b, v].tobytes() == cs.round_message(3 + b, 7, v)
+    # Distinct domain separator: a round-bound message can never equal
+    # a round-free table message, whatever the ids.
+    assert cs.round_message(0, 0, 0)[:4] != cs.order_message(0, 0)[:4]
+
+
+def test_sign_round_tables_round_binding():
+    from ba_tpu.crypto.signed import commander_keys, sign_round_tables
+
+    sks, pks = commander_keys(2, seed=1)
+    m0, s0 = sign_round_tables(sks, pks, 0)
+    m1, s1 = sign_round_tables(sks, pks, 1)
+    # The round is bound INTO the message, so both bytes differ — a
+    # round-free table would make per-round signing a no-op recompute.
+    assert not np.array_equal(m0, m1)
+    assert not np.array_equal(s0, s1)
+
+
+# -- bit-exactness vs the sequential driver -----------------------------------
+
+
+@pytest.mark.parametrize("collapsed", [False, True])
+def test_signed_pipeline_bit_exact_vs_sequential(collapsed):
+    state = churn_state(8, 8)
+    key = jr.key(11)
+    ref = sequential_signed_sweep(key, state, 9, m=2, collapsed=collapsed)
+    out = pipeline_sweep(
+        key, fresh_copy(state), 9, signed=True, m=2, collapsed=collapsed,
+        depth=2, rounds_per_dispatch=4, collect_decisions=True,
+    )
+    np.testing.assert_array_equal(out["histograms"], ref["histograms"])
+    np.testing.assert_array_equal(out["decisions"], ref["decisions"])
+    # The sequential driver derives its counters INDEPENDENTLY on host
+    # (numpy over the fetched streams) — this cross-checks the in-scan
+    # verdict formulas, not just the schedule.
+    assert out["counters"] == ref["counters"]
+    # The campaign actually exercised the signed verdicts.
+    assert out["counters"]["commander_equivocations"] > 0
+    assert out["stats"]["signed"] is True
+    assert out["stats"]["sign_ahead_s"] > 0
+    assert list(out["counters"]) == list(SIGNED_COUNTER_NAMES)
+
+
+def test_signed_counters_continue_across_dispatches():
+    state = churn_state(6, 8)
+    key = jr.key(21)
+    one = pipeline_sweep(
+        key, fresh_copy(state), 8, signed=True, rounds_per_dispatch=8,
+    )
+    many = pipeline_sweep(
+        key, fresh_copy(state), 8, signed=True, rounds_per_dispatch=3,
+    )
+    # Chunking is invisible: cumulative counter rows and totals match.
+    assert one["counters"] == many["counters"]
+    np.testing.assert_array_equal(
+        one["counters_per_round"], many["counters_per_round"]
+    )
+
+
+# -- no-blocking proof with the lane live -------------------------------------
+
+
+def test_signed_no_blocking_dispatch_count(monkeypatch):
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    B, cap, R, depth = 4, 8, 7, 3
+    state = churn_state(B, cap)
+    events = []
+    out = pipeline_sweep(
+        jr.key(23), state, R, signed=True,
+        depth=depth, rounds_per_dispatch=1,
+        on_event=lambda kind, i: events.append((kind, i)),
+    )
+    dispatches = [i for kind, i in events if kind == "dispatch"]
+    retires = [i for kind, i in events if kind == "retire"]
+    assert dispatches == list(range(R))
+    assert retires == list(range(R))
+    # The in-flight window fills before the engine ever blocks — with
+    # the sign-ahead lane staging every window in between.
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [("dispatch", i) for i in range(depth + 1)]
+    for r in range(R - depth):
+        assert events.index(("retire", r)) > events.index(
+            ("dispatch", r + depth)
+        )
+    assert out["stats"]["max_in_flight"] == depth + 1
+    assert out["stats"]["sign_ahead_s"] > 0
+
+
+# -- checkpoint / resume ------------------------------------------------------
+
+
+def test_signed_checkpoint_resume_bit_exact(tmp_path):
+    p = str(tmp_path / "ck_{round}.npz")
+    state = churn_state(6, 8)
+    key = jr.key(9)
+    full = pipeline_sweep(
+        key, fresh_copy(state), 12, signed=True, m=2,
+        rounds_per_dispatch=3, collect_decisions=True,
+        checkpoint_every=6, checkpoint_path=p,
+    )
+    ck = load_carry_checkpoint(p.replace("{round}", "6"))
+    assert ck.signed is True and ck.round == 6
+    res = pipeline_sweep(
+        None, None, 12, signed=True, m=2,
+        rounds_per_dispatch=3, collect_decisions=True, resume=ck,
+    )
+    np.testing.assert_array_equal(res["histograms"], full["histograms"][6:])
+    np.testing.assert_array_equal(res["decisions"], full["decisions"][6:])
+    assert res["counters"] == full["counters"]
+    # Resume from the PATH form too (the load-in-wrapper route).
+    res2 = pipeline_sweep(
+        None, None, 12, signed=True, m=2,
+        rounds_per_dispatch=3, collect_decisions=True,
+        resume=p.replace("{round}", "6"),
+    )
+    np.testing.assert_array_equal(res2["histograms"], full["histograms"][6:])
+
+
+def test_signed_checkpoint_never_crosses_protocols(tmp_path):
+    p = str(tmp_path / "ck_{round}.npz")
+    pipeline_sweep(
+        jr.key(5), churn_state(4, 8), 6, signed=True,
+        rounds_per_dispatch=3, checkpoint_every=3, checkpoint_path=p,
+    )
+    ck = load_carry_checkpoint(p.replace("{round}", "3"))
+    with pytest.raises(ValueError, match="protocol"):
+        pipeline_sweep(
+            None, None, 6, rounds_per_dispatch=3, with_counters=True,
+            resume=ck,
+        )
+    # ...and the other direction: an oral carry never enters the lane.
+    p2 = str(tmp_path / "oral_{round}.npz")
+    pipeline_sweep(
+        jr.key(6), make_sweep_state(jr.key(7), 4, 8), 6,
+        with_counters=True, rounds_per_dispatch=3,
+        checkpoint_every=3, checkpoint_path=p2,
+    )
+    ck2 = load_carry_checkpoint(p2.replace("{round}", "3"))
+    with pytest.raises(ValueError, match="protocol"):
+        pipeline_sweep(
+            None, None, 6, signed=True, rounds_per_dispatch=3, resume=ck2,
+        )
+
+
+# -- serving: coalesced parity + cohort separation ----------------------------
+
+
+def test_signed_coalesced_parity():
+    cap = 4
+    reqs = [(4, (2,), 1, 11), (3, (), 0, 12), (4, (0, 3), 1, 13)]
+    rows = [alone_state(n, f, o, cap) for n, f, o, s in reqs]
+    batched = fresh_copy(
+        SimState(*[
+            jnp.concatenate([getattr(s, fld) for s in rows])
+            for fld in ("order", "leader", "faulty", "alive", "ids")
+        ])
+    )
+    co = coalesced_sweep(
+        [jr.key(s) for n, f, o, s in reqs], batched, 5,
+        rounds_per_dispatch=2, signed=True, m=2,
+    )
+    assert co["counter_names"] == list(SIGNED_COUNTER_NAMES)
+    for i, (n, f, o, s) in enumerate(reqs):
+        alone = pipeline_sweep(
+            jr.key(s), alone_state(n, f, o, cap), 5,
+            signed=True, m=2, rounds_per_dispatch=2,
+            collect_decisions=True,
+        )
+        np.testing.assert_array_equal(
+            co["decisions"][:, i], alone["decisions"][:, 0]
+        )
+        got = dict(
+            zip(co["counter_names"], (int(v) for v in co["counters"][i]))
+        )
+        assert got == alone["counters"]
+        solo = coalesced_sweep(
+            [jr.key(s)], alone_state(n, f, o, cap), 5,
+            rounds_per_dispatch=2, signed=True, m=2,
+        )
+        np.testing.assert_array_equal(
+            co["majorities"][i], solo["majorities"][0]
+        )
+
+
+def test_signed_cohort_key_separation():
+    from ba_tpu.runtime.serve import AgreementRequest, cohort_key
+
+    a = AgreementRequest(kind="run-rounds", n=4, rounds=4, seed=1)
+    b = AgreementRequest(kind="run-rounds", n=4, rounds=4, seed=2, m=2)
+    c = AgreementRequest(
+        kind="run-rounds", n=4, rounds=4, seed=3, signed=True
+    )
+    d = AgreementRequest(
+        kind="run-rounds", n=4, rounds=4, seed=4, signed=True, m=2
+    )
+    keys = [cohort_key(r) for r in (a, b, c, d)]
+    assert len(set(keys)) == 4  # m and signed separate INDEPENDENTLY
+    # The m dial defaults through the service's config, so an explicit
+    # m equal to the default coalesces with the default.
+    assert cohort_key(a, "xla", 2) == cohort_key(b)
+    # Signed scenario requests are invalid eagerly.
+    from ba_tpu.runtime.serve import validate_request
+    from ba_tpu.scenario import from_dict
+
+    spec = from_dict({"name": "s", "rounds": 2, "events": []})
+    with pytest.raises(ValueError, match="signed"):
+        validate_request(
+            AgreementRequest(kind="scenario", n=4, spec=spec, signed=True)
+        )
+    with pytest.raises(ValueError, match="m="):
+        validate_request(
+            AgreementRequest(kind="run-rounds", n=4, rounds=2, m=0)
+        )
+
+
+def test_service_serves_mixed_protocol_cohorts():
+    from ba_tpu.obs.registry import MetricsRegistry
+    from ba_tpu.runtime.serve import (
+        AgreementRequest,
+        AgreementService,
+        ServeConfig,
+    )
+
+    svc = AgreementService(
+        ServeConfig(
+            max_batch=4, max_queue=16, coalesce_window_s=0.2,
+            rounds_per_dispatch=2,
+        ),
+        registry=MetricsRegistry(),
+    )
+    svc.start()
+    reqs = [
+        AgreementRequest(kind="run-rounds", n=4, faulty=(2,), seed=31,
+                         rounds=4),
+        AgreementRequest(kind="run-rounds", n=4, faulty=(2,), seed=31,
+                         rounds=4, signed=True),
+        AgreementRequest(kind="run-rounds", n=4, faulty=(1,), seed=32,
+                         rounds=4, signed=True),
+        AgreementRequest(kind="run-rounds", n=4, faulty=(), seed=33,
+                         rounds=4, m=2),
+    ]
+    tickets = [svc.submit(r) for r in reqs]
+    outs = [t.result(timeout=600) for t in tickets]
+    try:
+        # The two signed requests coalesced into ONE batch; the oral and
+        # the m=2 request each dispatched alone — protocols never share
+        # a batch, yet one front-end served all three cohorts.
+        assert outs[1]["batch"] == 2 and outs[2]["batch"] == 2
+        assert outs[0]["batch"] == 1 and outs[3]["batch"] == 1
+        assert "sig_rejections" in outs[1]["counters"]
+        # Per-request parity through the service: each signed result is
+        # bit-identical to its own alone run at equal padded capacity.
+        for req, out in zip(reqs[1:3], outs[1:3]):
+            alone = pipeline_sweep(
+                jr.key(req.seed),
+                alone_state(req.n, req.faulty, 1, 4), 4,
+                signed=True, rounds_per_dispatch=2,
+                collect_decisions=True,
+            )
+            assert out["decisions"] == [
+                int(v) for v in alone["decisions"][:, 0]
+            ]
+            assert out["counters"] == alone["counters"]
+    finally:
+        svc.stop()
+
+
+# -- engine selection ---------------------------------------------------------
+
+
+def test_signed_engine_rules():
+    state = churn_state(4, 8)
+    with pytest.raises(ValueError, match="signed"):
+        pipeline_sweep(
+            jr.key(1), fresh_copy(state), 2, signed=True, engine="pallas"
+        )
+    with pytest.raises(ValueError, match="signed"):
+        coalesced_sweep(
+            [jr.key(1)], alone_state(4, (), 1, 4), 2, signed=True,
+            engine="interpret",
+        )
+    # auto prefers the kernel but falls back COUNTED for signed.
+    out = pipeline_sweep(
+        jr.key(2), fresh_copy(state), 2, signed=True, engine="auto",
+    )
+    assert out["stats"]["engine"] == "xla"
+    assert "signed" in out["stats"]["engine_fallback"]
+    # The signed/scenario/mesh combos error eagerly.
+    with pytest.raises(ValueError, match="scenario"):
+        from ba_tpu.scenario import compile_scenario, from_dict
+
+        spec = from_dict({"name": "x", "rounds": 2, "events": []})
+        pipeline_sweep(
+            jr.key(3), fresh_copy(state), 2, signed=True,
+            scenario=compile_scenario(spec, 4, 8),
+        )
+    with pytest.raises(ValueError, match="collapsed"):
+        pipeline_sweep(jr.key(4), fresh_copy(state), 2, collapsed=True)
+    with pytest.raises(ValueError, match="collapsed"):
+        coalesced_sweep(
+            [jr.key(5)], alone_state(4, (), 1, 4), 2, collapsed=True
+        )
+
+
+# -- the interactive backend --------------------------------------------------
+
+
+def test_backend_signed_run_rounds_matches_sequential_driver():
+    from ba_tpu.runtime.backends import JaxBackend
+
+    class G:
+        def __init__(self, i, faulty=False):
+            self.id = i
+            self.faulty = faulty
+            self.alive = True
+
+    gens = [G(1), G(2, True), G(3), G(4)]
+    be = JaxBackend(protocol="sm", m=1, signed=True)
+    majorities, decisions, stats = be.run_rounds(gens, 0, 1, 42, 6)
+    assert stats["signed"] is True
+    assert list(stats["counters"]) == list(SIGNED_COUNTER_NAMES)
+    # The backend's padded B=1 state under the same key/sign-seed: the
+    # sequential driver's last-round majorities must match the
+    # backend's recompute (schedule + lane determinism, end to end).
+    state = be._make_state(gens, 0, 1)
+    ref = sequential_signed_sweep(jr.key(42), state, 6, m=1)
+    assert majorities == [int(v) for v in ref["majorities"][0, :4]]
+    assert decisions == [int(v) for v in ref["decisions"][:, 0]]
+    assert stats["counters"] == ref["counters"]
+
+
+def test_repl_signed_run_rounds_prints_lane_line():
+    from ba_tpu.runtime.backends import JaxBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    cluster = Cluster(4, JaxBackend(protocol="sm", m=1, signed=True), seed=0)
+    lines = []
+    handle_command(cluster, "run-rounds attack 4", lines.append)
+    assert any(l.startswith("Rounds: 4") for l in lines)
+    # The signed lane evidence line (additive; oral sessions never
+    # print it).
+    assert any(l.startswith("Signed lane:") for l in lines)
+    oral = Cluster(4, JaxBackend(), seed=0)
+    lines2 = []
+    handle_command(oral, "run-rounds attack 2", lines2.append)
+    assert not any(l.startswith("Signed lane:") for l in lines2)
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_sign_ahead_records_and_gauges(tmp_path):
+    from ba_tpu import obs
+    from ba_tpu.utils import metrics as _metrics
+
+    path = str(tmp_path / "m.jsonl")
+    sink = _metrics.configure(path)
+    try:
+        pipeline_sweep(
+            jr.key(30), churn_state(4, 8), 6, signed=True,
+            rounds_per_dispatch=2,
+        )
+        sink.close()
+        import json
+
+        recs = [
+            json.loads(line)
+            for line in open(path).read().splitlines()
+            if line.strip()
+        ]
+        sa = [r for r in recs if r.get("event") == "sign_ahead"]
+        assert len(sa) == 3  # one per staged window
+        assert [(r["lo"], r["hi"]) for r in sa] == [(0, 2), (2, 4), (4, 6)]
+        for r in sa:
+            assert r["batch"] == 4 and r["values"] == 2
+            assert r["table_bytes"] > 0 and r["wall_s"] >= 0
+        reg = obs.default_registry()
+        assert reg.get("host_sign_ahead_s").value > 0
+        assert reg.get("pipeline_sign_ahead_windows_total").value >= 3
+    finally:
+        _metrics.configure(None)
+
+
+# -- warmup covers the signed axis --------------------------------------------
+
+
+def test_warmup_lattice_covers_signed_axis():
+    from ba_tpu.runtime import warmup
+    from ba_tpu.runtime.serve import ServeConfig
+
+    plan = warmup.bucket_lattice(2, 4, signeds=(False, True))
+    signed_rows = [a for fn, a in plan if a["signed"]]
+    oral_rows = [a for fn, a in plan if not a["signed"]]
+    assert signed_rows and oral_rows
+    # Signed entries mirror the dispatch loop's reachable combinations:
+    # XLA core only, never scenario.
+    assert all(a["engine"] == "xla" for a in signed_rows)
+    assert all(a["scenario"] is False for a in signed_rows)
+    assert all("collapsed" in a for a in signed_rows + oral_rows)
+    # The service plan covers the axis by default and trims on request.
+    cfg = ServeConfig(max_batch=1, rounds_per_dispatch=2, warm=True)
+    assert any(a["signed"] for _, a in warmup.service_plan(cfg))
+    # The per-request m dial (cohort-key member) warms through warm_ms
+    # — the config's own m always included, the overrides added.
+    cfg_m = ServeConfig(
+        max_batch=1, rounds_per_dispatch=2, warm=True, warm_ms=(2,)
+    )
+    ms = {a["m"] for _, a in warmup.service_plan(cfg_m)}
+    assert ms == {1, 2}
+    with pytest.raises(ValueError, match="warm_ms"):
+        ServeConfig(max_batch=1, warm_ms=(0,))
+    cfg_off = ServeConfig(
+        max_batch=1, rounds_per_dispatch=2, warm=True, warm_signed=False
+    )
+    assert not any(a["signed"] for _, a in warmup.service_plan(cfg_off))
+    # The signed megastep has a registered AOT builder.
+    assert "signed_megastep" in warmup.WARM_FNS
+    from ba_tpu.parallel.pipeline import AOT_SPECS
+
+    assert "signed_megastep" in AOT_SPECS
+
+
+def test_signed_aot_warm_dispatch_bit_exact(tmp_path):
+    from ba_tpu.obs import aotcache
+    from ba_tpu.parallel.pipeline import AOT_SPECS
+
+    axes = {
+        "batch": 4, "capacity": 8, "rounds": 3, "m": 2,
+        "collapsed": False, "unroll": 1, "collect_decisions": True,
+        "signed": True, "engine": "xla",
+    }
+    cache = aotcache.ExecutableCache(str(tmp_path))
+    cache.ensure("signed_megastep", axes, AOT_SPECS["signed_megastep"])
+    state = churn_state(4, 8)
+    ref = pipeline_sweep(
+        jr.key(6), fresh_copy(state), 6, signed=True, m=2,
+        rounds_per_dispatch=3, collect_decisions=True,
+    )
+    warm = pipeline_sweep(
+        jr.key(6), fresh_copy(state), 6, signed=True, m=2,
+        rounds_per_dispatch=3, collect_decisions=True, executables=cache,
+    )
+    np.testing.assert_array_equal(warm["decisions"], ref["decisions"])
+    np.testing.assert_array_equal(warm["histograms"], ref["histograms"])
+    assert warm["counters"] == ref["counters"]
+    assert warm["stats"]["warm_dispatches"] == warm["stats"]["dispatches"]
+    assert warm["stats"]["request_path_compiles"] == 0

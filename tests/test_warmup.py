@@ -54,6 +54,10 @@ B, CAP, ROUNDS, RPD = 2, 4, 8, 4
 COALESCED_AXES = {
     "batch": B, "capacity": CAP, "rounds": RPD, "m": 1,
     "max_liars": None, "unroll": 1, "scenario": False,
+    # ISSUE 14: the protocol axes joined the coalesced signature —
+    # signed/oral cohorts never share an executable, and a protocol
+    # flip is an explained recompile.
+    "signed": False, "collapsed": False,
     # ISSUE 13: the engine joined the compile signature — warm lookups
     # without it can never match the dispatch loop's axes.
     "engine": "xla",
@@ -277,7 +281,7 @@ def test_pipeline_sweep_warm_opt_in(tmp_path):
         "batch": B, "capacity": CAP, "rounds": RPD, "m": 1,
         "max_liars": None, "unroll": 1, "collect_decisions": True,
         "counters": True, "data": 1, "scenario": False,
-        "engine": "xla",
+        "signed": False, "engine": "xla",
     }
     cache = aotcache.ExecutableCache(str(tmp_path))
     cache.ensure("pipeline_megastep", axes, AOT_SPECS["pipeline_megastep"])
