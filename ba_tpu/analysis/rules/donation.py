@@ -105,6 +105,12 @@ KNOWN_DONATING = {
     "ba_tpu.parallel.pipeline.coalesced_signed_megastep": DonationSpec(
         frozenset([0, 1]), ("state", "sched")
     ),
+    # The adversary search engine's evaluation seam (ISSUE 15): it
+    # hands `state` straight to coalesced_sweep, which consumes it.
+    # Carries the def-line annotation too — same belt-and-braces.
+    "ba_tpu.search.loop.evaluate_population": DonationSpec(
+        frozenset([1]), ("slot_keys", "state", "block")
+    ),
 }
 
 _DONATES_RE = re.compile(r"#\s*ba-lint:\s*donates\(([^)]*)\)")

@@ -34,8 +34,13 @@ from ba_tpu.analysis.base import Rule, register
 # megastep (ops/scenario_step.py) IS the dispatch path when the kernel
 # engine is selected — its wrappers sit exactly where the XLA megasteps
 # do, so the same no-host-sync discipline applies (the other ops/
-# kernels are crypto-side and stay out).
-HOT_TREES = ("ba_tpu.parallel.", "ba_tpu.ops.scenario_step")
+# kernels are crypto-side and stay out).  ISSUE 15 added the adversary
+# search loop (search/loop.py): its generation loop drives the
+# coalesced engine's dispatch stream, and a host sync there would
+# serialize population evaluation exactly like one in the engine.
+HOT_TREES = (
+    "ba_tpu.parallel.", "ba_tpu.ops.scenario_step", "ba_tpu.search.loop",
+)
 # The round-loop modules: the ones whose steady-state statements run
 # once per round / per dispatch.  ISSUE 8 added the mesh scan core
 # (parallel/shard.py — the shard_map megasteps and the retire-time
@@ -48,6 +53,10 @@ HOT_CONVERSION_MODULES = {
     "ba_tpu.parallel.sweep",
     "ba_tpu.parallel.shard",
     "ba_tpu.ops.scenario_step",
+    # ISSUE 15: the search loop scores host rows the engine's retire
+    # fetches already brought back — a conversion/drain call there
+    # means a device value leaked into the scoring path.
+    "ba_tpu.search.loop",
 }
 PIPELINE_MODULE = "ba_tpu.parallel.pipeline"
 

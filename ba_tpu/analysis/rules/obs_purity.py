@@ -52,7 +52,15 @@ module-level imports whose own closure reaches the jitted trees.
 The WARMUP pass (ISSUE 11): ``ba_tpu.runtime.warmup`` joins the same
 module-level host-tier scope (plan construction is jax-free; the AOT
 builders, which need the jitted trees, load lazily from the runner
-thread).  The executable cache ``ba_tpu.obs.aotcache`` needs no listing
+thread).
+
+The ADVERSARY SEARCH package (ISSUE 15): every ``ba_tpu.search``
+module joins the module-level host-tier scope — the generator,
+objective table, minimizer and corpus layers are numpy/stdlib by
+contract (the jax-free ``python -m ba_tpu.search`` CLI and the CI
+corpus stage depend on it), and the hunt loop reaches the coalesced
+engine only through function-body imports, exactly the serve
+dispatcher's sanctioned lazy seam.  The executable cache ``ba_tpu.obs.aotcache`` needs no listing
 — it sits inside the obs scope, whose STRICTER rule (even function-local
 core/ops imports are findings) already covers it; its specialization
 builders therefore live in ``parallel/pipeline.py`` and are passed in.
@@ -67,11 +75,22 @@ from ba_tpu.analysis.base import Rule, register
 SCOPES = ("ba_tpu.core", "ba_tpu.ops")
 OBS = "ba_tpu.obs"
 SINK = "ba_tpu.utils.metrics"
-# Host-tier-at-module-level modules: the serving front-end (ISSUE 10)
-# and the warmup pass (ISSUE 11) — both must import jax-free (plan
-# construction and admission run on hosts without jax) and reach the
-# engine only through function-local imports.
-HOST_TIER_MODULES = ("ba_tpu.runtime.serve", "ba_tpu.runtime.warmup")
+# Host-tier-at-module-level modules: the serving front-end (ISSUE 10),
+# the warmup pass (ISSUE 11), and the adversary search package
+# (ISSUE 15) — all must import jax-free (plan construction, admission,
+# and the search CLI's sample/corpus ops run on hosts without jax) and
+# reach the engine only through function-local imports.
+HOST_TIER_MODULES = (
+    "ba_tpu.runtime.serve",
+    "ba_tpu.runtime.warmup",
+    "ba_tpu.search",
+    "ba_tpu.search.__main__",
+    "ba_tpu.search.generate",
+    "ba_tpu.search.objective",
+    "ba_tpu.search.loop",
+    "ba_tpu.search.minimize",
+    "ba_tpu.search.corpus",
+)
 
 
 def _in_scope(modname: str) -> bool:

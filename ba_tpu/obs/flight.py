@@ -73,6 +73,12 @@ RUN_SCOPED_EVENTS = frozenset(
         "fault_injected",
         "health_snapshot",
         "flight_summary",
+        # The adversary search family (ISSUE 15): every hunt runs
+        # inside its own run scope, so these always carry the id.
+        "search_generation",
+        "search_found",
+        "search_minimized",
+        "search_checkpoint",
     }
 )
 

@@ -554,3 +554,34 @@ class JaxBackend:
             "alive": [bool(v) for v in np.asarray(final.alive[0, :n])],
             "faulty": [bool(v) for v in np.asarray(final.faulty[0, :n])],
         }
+
+    def run_search(self, generals, seed, space=None, **kwargs):
+        """An adversary hunt sized to THIS cluster's shape (ISSUE 15).
+
+        The search is roster-independent — every candidate campaign
+        starts from the canonical all-honest state — but the default
+        :class:`~ba_tpu.search.generate.SearchSpace` takes its capacity
+        from the padded roster width, so the REPL ``search`` command
+        hunts adversaries for clusters like the one on screen.  An
+        explicit ``space`` (a SearchSpace or its dict form) overrides
+        everything; ``kwargs`` thread straight into
+        :func:`ba_tpu.search.loop.hunt` (generations, objective,
+        export_dir, checkpoint_path, mesh, engine, ...).  Oral-message
+        protocols only, like ``run_scenario`` — returns None for
+        sm/signed.
+        """
+        if self.protocol != "om" or self.signed:
+            return None
+        from ba_tpu.search.generate import SearchSpace
+        from ba_tpu.search.loop import hunt
+
+        if space is None:
+            cap = self._capacity(len(generals))
+            space = SearchSpace(
+                rounds=8,
+                capacity=cap,
+                population=32,
+                events_min=2,
+                events_max=6,
+            )
+        return hunt(space, seed=seed, **kwargs)

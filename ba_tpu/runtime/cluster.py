@@ -445,6 +445,53 @@ class Cluster:
         )
         return counts, res
 
+    def run_search(self, space=None, **kwargs):
+        """Run an adversary hunt (``ba_tpu.search``, ISSUE 15) sized to
+        this cluster's padded capacity: sample populations of candidate
+        campaigns, evaluate them batched through the coalesced engine,
+        collect objective violations, and (by default) shrink them to
+        minimal reproducers.
+
+        The hunt never touches the roster — candidates run from the
+        canonical all-honest state, so this is "what adversary would
+        break a cluster shaped like mine", not a mutation of the live
+        session.  ``space``/``kwargs`` thread into the backend's
+        ``run_search`` (and from there ``ba_tpu.search.loop.hunt``).
+        Returns the hunt's result dict, or None when the cluster is
+        empty or the backend cannot search (PyBackend, signed paths).
+        """
+        if not self.generals:
+            return None  # the reference would crash here (SURVEY.md Q4)
+        run = getattr(self.backend, "run_search", None)
+        if run is None:
+            return None
+        obs.instant("search_repl", n=len(self.generals))
+        with obs.span("search_hunt", n=len(self.generals)):
+            res = run(self.generals, self._round_seed(), space=space, **kwargs)
+        if res is None:
+            return None
+        metrics.emit(
+            {
+                "event": "search_campaign",
+                # Re-attach the hunt's run id (the engine's scope closed
+                # when the backend returned) so this summary record
+                # joins the same flight — the scenario_campaign pattern.
+                **(
+                    {"run_id": res["stats"]["run_id"]}
+                    if res["stats"].get("run_id")
+                    else {}
+                ),
+                "objective": res["stats"]["objective"],
+                "generations": res["stats"]["generations_run"],
+                "campaigns": res["stats"]["campaigns"],
+                "found": res["stats"]["found"],
+                "minimized": res["stats"]["minimized"],
+                "best_score": res["stats"]["best_score"],
+                "n": len(self.generals),
+            }
+        )
+        return res
+
     def _tally(self, command: str, leader_idx: int, majorities) -> RoundResult:
         """REPL-level bookkeeping for one round's majorities (ba.py:383-399
         + 197-255), shared by the per-round and pipelined paths."""

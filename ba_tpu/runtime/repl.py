@@ -36,6 +36,15 @@ stay byte-identical):
   one-line error naming the mismatch, as does asking for more devices
   than exist); batched multi-chip campaigns use
   ``parallel.pipeline.scenario_sweep(mesh=)`` from library code.
+- ``search`` (ISSUE 15) — run an adversary hunt sized to this cluster
+  (``ba_tpu.search``): sample populations of candidate campaigns,
+  evaluate them batched campaign-per-instance through the coalesced
+  engine, collect IC1/IC2/quorum violations and shrink them to minimal
+  reproducers.  ``search gens=N objective=ic|ic1|ic2|quorum|havoc
+  export=DIR stop=N space=FILE`` — ``export=`` writes the minimized
+  findings as ordinary provenance-stamped scenario JSON specs (the
+  ``scenario`` command replays them), ``space=`` loads an explicit
+  search-space JSON.
 - ``serve start|stat|stop`` (ISSUE 10) — control a local
   agreement-as-a-service front-end (``runtime/serve.py``): ``start``
   spawns the continuous-batching dispatcher (``serve start queue=N
@@ -296,6 +305,104 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
                 f"retries={sup['retries']}, "
                 f"recoveries={sup['recoveries']}, stalls={sup['stalls']}"
             )
+
+    elif command == "search":
+        # Framework extension (additive, ISSUE 15): an adversary hunt
+        # sized to this cluster — sample populations of candidate
+        # campaigns, evaluate them batched (campaign-per-instance),
+        # collect IC1/IC2/quorum violations and shrink them to minimal
+        # reproducers.  Tokens: `gens=N` generations, `objective=NAME`
+        # (ic|ic1|ic2|quorum|havoc), `export=DIR` writes the minimized
+        # reproducers as ordinary scenario JSON specs, `stop=N` ends
+        # early after N findings, `space=FILE` loads an explicit
+        # search-space JSON instead of the roster-shaped default.  An
+        # incapable backend (PyBackend, signed) is silently ignored
+        # like other guarded divergences; every config problem prints
+        # one error line, never a traceback.
+        args = [t for t in cmd[1:] if t]
+        kwargs = {}
+        space = None
+        ok = True
+        for tok in args:
+            key, sep, value = tok.partition("=")
+            if not sep or not value:
+                out(f"search error: unknown token {tok!r} (usage: search "
+                    f"[gens=N] [objective=NAME] [export=DIR] [stop=N] "
+                    f"[space=FILE])")
+                ok = False
+                break
+            if key in ("gens", "stop"):
+                try:
+                    n = int(value)
+                except ValueError:
+                    out(f"search error: {key}= wants an integer, "
+                        f"got {value!r}")
+                    ok = False
+                    break
+                if n < 1:
+                    out(f"search error: {key}= must be >= 1, got {n}")
+                    ok = False
+                    break
+                kwargs["generations" if key == "gens" else "stop_after"] = n
+            elif key == "objective":
+                kwargs["objective"] = value
+            elif key == "export":
+                kwargs["export_dir"] = value
+            elif key == "space":
+                try:
+                    import json as _json
+
+                    from ba_tpu.search.generate import space_from_dict
+
+                    with open(value) as fh:
+                        space = space_from_dict(_json.load(fh))
+                except (OSError, ValueError) as e:
+                    out(f"search error: {e}")
+                    ok = False
+                    break
+            else:
+                out(f"search error: unknown token {tok!r} (usage: search "
+                    f"[gens=N] [objective=NAME] [export=DIR] [stop=N] "
+                    f"[space=FILE])")
+                ok = False
+                break
+        if not ok:
+            return True
+        try:
+            res = cluster.run_search(space=space, **kwargs)
+        except (OSError, ValueError, ImportError) as e:
+            # ValueError: ScenarioError-grade config problems (unknown
+            # objective, bad space).  OSError: an unwritable export /
+            # checkpoint target.  ImportError: a jax-less install — one
+            # error line, not a dead REPL.
+            out(f"search error: {e}")
+            return True
+        if res is None:
+            return True
+        stats = res["stats"]
+        out(
+            f"Search: generations={stats['generations_run']}, "
+            f"campaigns={stats['campaigns']}, "
+            f"objective={stats['objective']}"
+        )
+        shrunk = res["minimized"]
+        shrink_note = (
+            " ({} minimized, events {})".format(
+                len(shrunk),
+                ", ".join(
+                    f"{m['events_before']}->{m['events_after']}"
+                    for m in shrunk
+                ),
+            )
+            if shrunk
+            else ""
+        )
+        out(
+            f"Search found: {stats['found']} violating campaign(s), "
+            f"best score {stats['best_score']}{shrink_note}"
+        )
+        if res["exported"]:
+            out("Search exported: " + ", ".join(res["exported"]))
 
     elif command == "serve":
         # Framework extension (additive, ISSUE 10): start/stat/stop a

@@ -41,6 +41,12 @@ JSON grammar (one object per event; exactly one kind key)::
        {"round": 2, "set_faulty": [4], "value": true},
        {"round": 3, "set_strategy": [4], "value": "collude_retreat",
         "instances": [0, 1]}]}
+
+An optional top-level ``"provenance"`` object (any JSON-able dict,
+round-tripped verbatim) records where a spec came from — the adversary
+search engine (``ba_tpu.search``, ISSUE 15) stamps its replay recipe
+there on every exported minimal reproducer.  The compiler never reads
+it.
 """
 
 from __future__ import annotations
@@ -96,12 +102,21 @@ class Event:
 
 @dataclasses.dataclass(frozen=True)
 class Scenario:
-    """A validated campaign: R rounds of ``order`` under ``events``."""
+    """A validated campaign: R rounds of ``order`` under ``events``.
+
+    ``provenance`` (ISSUE 15) is an optional JSON-able dict of
+    where-this-spec-came-from metadata — the adversary search engine
+    stamps ``{"search": {seed, uid, generation, objective, score,
+    counters}}`` on every exported minimal reproducer so a found spec
+    carries its own replay recipe.  Purely descriptive: the compiler
+    never reads it, and a spec without one is unchanged.
+    """
 
     name: str
     rounds: int
     events: tuple
     order: str = "attack"
+    provenance: dict | None = None
 
 
 def validate(spec: Scenario) -> Scenario:
@@ -124,6 +139,19 @@ def validate(spec: Scenario) -> Scenario:
             "(non-canonical orders are a leader raw-string REPL quirk, "
             "not a campaign input)"
         )
+    if spec.provenance is not None:
+        if not isinstance(spec.provenance, dict):
+            raise ScenarioError(
+                f"provenance must be an object, got {spec.provenance!r}"
+            )
+        try:
+            json.dumps(spec.provenance)
+        except (TypeError, ValueError) as e:
+            # A non-JSON-able provenance would only fail at save() time,
+            # deep inside a search export — the eager-validation rule.
+            raise ScenarioError(
+                f"provenance must be JSON-serializable: {e}"
+            ) from None
     killed_revived = {}
     for ev in spec.events:
         if ev.kind not in EVENT_KINDS:
@@ -205,19 +233,22 @@ def to_dict(spec: Scenario) -> dict:
         if ev.instances is not None:
             d["instances"] = list(ev.instances)
         events.append(d)
-    return {
+    doc = {
         "name": spec.name,
         "rounds": spec.rounds,
         "order": spec.order,
         "events": events,
     }
+    if spec.provenance is not None:
+        doc["provenance"] = spec.provenance
+    return doc
 
 
 def from_dict(doc: dict) -> Scenario:
     """Parse + validate the JSON-grammar form; strict about keys."""
     if not isinstance(doc, dict):
         raise ScenarioError(f"scenario document must be an object, got {doc!r}")
-    unknown = set(doc) - {"name", "rounds", "order", "events"}
+    unknown = set(doc) - {"name", "rounds", "order", "events", "provenance"}
     if unknown:
         raise ScenarioError(f"unknown scenario keys: {sorted(unknown)}")
     events = []
@@ -252,6 +283,7 @@ def from_dict(doc: dict) -> Scenario:
             rounds=doc.get("rounds", 0),
             events=tuple(events),
             order=doc.get("order", "attack"),
+            provenance=doc.get("provenance"),
         )
     )
 

@@ -1,0 +1,95 @@
+"""ba_tpu.search — the adversary search engine (ISSUE 15).
+
+The scenario engine made campaigns plain data and IC1/IC2/quorum
+verdicts on-device counters; this package turns "find the campaign
+that breaks agreement" into the throughput problem the repo is built
+to brute-force.  Four layers, mirroring the scenario package's
+jax-free-at-import discipline (docs/DESIGN.md §14):
+
+- **generator** (``search/generate.py``): a deterministic seed-keyed
+  campaign sampler + mutator over the scenario spec grammar, with
+  constraints as plain data (:class:`~ba_tpu.search.generate.SearchSpace`,
+  eagerly validated) and a population lowering that packs B distinct
+  candidate campaigns into ONE batched block — campaign-per-instance
+  via the per-instance event masks.
+- **objective** (``search/objective.py``): scores over the per-slot
+  scenario counter blocks the coalesced engine already drains inside
+  its depth-delayed retire fetches — scoring adds zero new syncs.
+- **search loop** (``search/loop.py``): random sweep → elite selection
+  → mutation, B campaigns per dispatch stream, per-candidate PRNG keys
+  (``fold_in(key(seed), uid)`` — population/shard/standalone all draw
+  the same stream), versioned search-state checkpoints
+  (``utils/snapshot``) for bit-exact resume, ``mesh=`` per-shard
+  populations, and the ``search_*`` obs record/gauge family under a
+  deterministic run_id.
+- **minimizer + corpus** (``search/minimize.py``, ``search/corpus.py``):
+  ddmin shrink to a 1-minimal violating event set, re-validated by the
+  alone-vs-in-population bit-exact replay oracle (the serving parity
+  pin as ground truth), exported as ordinary provenance-stamped
+  scenario JSON specs into ``examples/scenarios/found/``.
+
+Import discipline: this ``__init__`` eagerly imports only the jax-free
+layers (``python -m ba_tpu.search`` validates corpora and samples
+populations without an accelerator stack; ba-lint BA301 pins the
+host-tier contract); :func:`hunt` — the engine — loads on attribute
+access.
+"""
+
+from ba_tpu.search.corpus import (
+    FOUND_DIR,
+    check_reproducer,
+    export_found,
+    load_corpus,
+)
+from ba_tpu.search.generate import (
+    SearchSpace,
+    campaign_fingerprint,
+    candidate_name,
+    lower_population,
+    mutate_campaign,
+    sample_campaign,
+    sample_population,
+    space_from_dict,
+    space_to_dict,
+    validate_space,
+)
+from ba_tpu.search.objective import (
+    OBJECTIVES,
+    Objective,
+    get_objective,
+    score_rows,
+    violation_rows,
+)
+
+__all__ = [
+    "FOUND_DIR",
+    "OBJECTIVES",
+    "Objective",
+    "SearchSpace",
+    "campaign_fingerprint",
+    "candidate_name",
+    "check_reproducer",
+    "export_found",
+    "get_objective",
+    "hunt",
+    "load_corpus",
+    "lower_population",
+    "mutate_campaign",
+    "sample_campaign",
+    "sample_population",
+    "score_rows",
+    "space_from_dict",
+    "space_to_dict",
+    "validate_space",
+    "violation_rows",
+]
+
+
+def __getattr__(name):
+    # Lazy: `hunt` pulls the whole parallel engine (and jax) — it must
+    # not ride the jax-free CLI / CI validation import path.
+    if name == "hunt":
+        from ba_tpu.search.loop import hunt
+
+        return hunt
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

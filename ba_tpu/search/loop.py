@@ -1,0 +1,685 @@
+"""The adversary hunt loop: random sweep -> elite selection -> mutation,
+batched campaign-per-instance through the coalesced engine.
+
+The execution shape (docs/DESIGN.md §14): one generation = one
+population of B candidate campaigns lowered into a single batch
+(``generate.lower_population``) and evaluated by ONE
+``coalesced_sweep(scenario=...)`` stream — per-slot key schedules, so
+candidate ``b``'s decisions/leaders/counters are bit-identical to its
+own B=1 run (the serving parity pin, reused here as the search's
+correctness oracle), and per-slot scenario counter blocks, so scoring
+(``objective.score_rows``) reads ONLY what the engine's depth-delayed
+retire fetches already brought back — the hunt adds zero device
+synchronizations beyond the engine's own (the no-blocking
+dispatch-count proof re-runs with the harness live,
+tests/test_search.py).
+
+Candidate ``uid`` draws its per-slot PRNG key as
+``fold_in(key(seed), uid)`` — slot-position-free, which is what makes
+population membership, mesh shard assignment and standalone replay all
+bit-exact with each other, and an exported reproducer's
+``(seed, uid)`` provenance a complete replay recipe.
+
+Search state is plain JSON data checkpointed through
+``utils/snapshot.write_search_checkpoint`` (versioned header, content
+digest, atomic write): a killed day-long hunt resumes bit-exactly —
+every sample and mutation is keyed by ``(seed, uid)`` and the uid
+cursor rides the checkpoint — and the resumed process re-derives the
+same run_id, joining its predecessor's flight ledger exactly like a
+supervised campaign's successor does.
+
+``mesh=`` shards a generation into per-device sub-populations (one
+evaluation thread per device, the engine's async dispatch overlapping
+across chips); slot keys make shard assignment layout-only, so a
+sharded hunt is bit-exact with the single-device hunt at any device
+count.
+
+This module is HOST-TIER at import (ba-lint BA301: jax loads lazily
+from function bodies) and lives in the BA101 hot-path scope — the
+generation loop must never block on the device outside the engine's
+own retire discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+from ba_tpu import obs
+from ba_tpu.scenario.spec import Scenario, ScenarioError, from_dict, to_dict
+from ba_tpu.search import generate as _generate
+from ba_tpu.search import objective as _objective
+from ba_tpu.utils import metrics as _metrics
+
+# NOT imported at module level: `ba_tpu.search.minimize` (its lazy
+# loop-import closure reaches the engine) and `ba_tpu.utils.snapshot`
+# (whose state loader reaches core) — both load from function bodies,
+# the BA301 host-tier lazy seam.
+
+# Pad slots (minimizer verification co-population) fold uids from here
+# up — far above any hunt's candidate cursor, so a pad key can never
+# collide with a real candidate's stream.
+PAD_UID_BASE = 0x7F000000
+
+
+def candidate_keys(seed: int, uids):
+    """One typed PRNG key per candidate: ``fold_in(key(seed), uid)``.
+
+    Slot-position-free by construction — the per-slot schedule folds
+    instance 0 whatever slot the candidate lands in — so the SAME key
+    drives the candidate in any population, any mesh shard, and its
+    standalone replay (threefry derivation is backend-independent).
+    """
+    import jax.random as jr
+
+    base = jr.key(seed)
+    return [jr.fold_in(base, uid) for uid in uids]
+
+
+def population_state(batch: int, capacity: int, order: str):
+    """The canonical all-honest initial state every candidate starts
+    from: all ``capacity`` slots alive, nobody faulty, leader slot 0,
+    ids 1..capacity — the campaign's events ARE the whole adversary, so
+    a candidate is a pure function of (events, seed, uid)."""
+    import jax.numpy as jnp
+
+    from ba_tpu.core.state import SimState
+    from ba_tpu.core.types import ATTACK, COMMAND_DTYPE, RETREAT
+
+    code = {"attack": ATTACK, "retreat": RETREAT}[order]
+    return SimState(
+        order=jnp.full((batch,), code, COMMAND_DTYPE),
+        leader=jnp.zeros((batch,), jnp.int32),
+        faulty=jnp.zeros((batch, capacity), bool),
+        alive=jnp.ones((batch, capacity), bool),
+        ids=jnp.broadcast_to(
+            jnp.arange(1, capacity + 1, dtype=jnp.int32),
+            (batch, capacity),
+        ),
+    )
+
+
+def evaluate_population(  # ba-lint: donates(state)
+    slot_keys,
+    state,
+    block,
+    *,
+    rounds: int,
+    depth: int = 2,
+    rounds_per_dispatch: int = 8,
+    unroll: int = 1,
+    engine: str | None = None,
+    exec_seam=None,
+):
+    """Evaluate one population block through the coalesced engine.
+
+    A thin named seam over ``coalesced_sweep(scenario=block)`` so the
+    hunt, the minimizer and the tests share one evaluation path.
+    DONATION: ``state`` is consumed by the first dispatch (the engine's
+    contract) — callers stage a fresh :func:`population_state` per
+    call.  Returns the coalesced result dict: ``decisions``
+    [rounds, B], ``leaders`` [rounds, B], ``counters`` [B, C] per-slot
+    final blocks + ``counter_names``, ``stats``.
+    """
+    from ba_tpu.parallel.pipeline import coalesced_sweep
+
+    return coalesced_sweep(
+        slot_keys,
+        state,
+        rounds,
+        scenario=block,
+        depth=depth,
+        rounds_per_dispatch=rounds_per_dispatch,
+        unroll=unroll,
+        engine=engine,
+        exec_seam=exec_seam,
+    )
+
+
+def _mesh_devices(mesh) -> list:
+    """Flatten a Mesh (or any device sequence) into the shard list."""
+    devices = getattr(mesh, "devices", mesh)
+    flat = getattr(devices, "flat", None)
+    return list(flat) if flat is not None else list(devices)
+
+
+def _evaluate_candidates(
+    candidates, uids, space, *, seed, depth, rounds_per_dispatch,
+    unroll, engine, exec_seam, mesh=None,
+):
+    """Lower + evaluate a candidate list; with ``mesh`` the population
+    splits into per-device sub-populations evaluated concurrently (one
+    thread per device — dispatch is async, so device compute overlaps
+    while each thread runs its own depth-k retire loop).  Returns
+    ``(counters [B, C], counter_names, decisions [R, B],
+    leaders [R, B], stats)`` in candidate order — bit-identical at any
+    shard count (per-slot keys make placement layout-only)."""
+    import jax
+    import numpy as np  # host assembly of already-host retire blocks
+
+    def run_shard(cands, cand_uids, device=None):
+        block = _generate.lower_population(
+            cands, space.capacity, space.rounds
+        )
+        keys = candidate_keys(seed, cand_uids)
+
+        def call():
+            state = population_state(
+                len(cands), space.capacity, space.order
+            )
+            return evaluate_population(
+                keys, state, block,
+                rounds=space.rounds, depth=depth,
+                rounds_per_dispatch=rounds_per_dispatch, unroll=unroll,
+                engine=engine, exec_seam=exec_seam,
+            )
+
+        if device is None:
+            return call()
+        with jax.default_device(device):
+            return call()
+
+    if mesh is None:
+        res = run_shard(candidates, uids)
+        return (
+            res["counters"], res["counter_names"], res["decisions"],
+            res["leaders"], [res["stats"]],
+        )
+    devices = _mesh_devices(mesh)
+    d = len(devices)
+    if d < 1 or len(candidates) % d:
+        raise ScenarioError(
+            f"population {len(candidates)} does not divide over "
+            f"{d} mesh device(s) — per-shard populations must be equal"
+        )
+    per = len(candidates) // d
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=d) as pool:
+        futures = [
+            pool.submit(
+                run_shard,
+                candidates[k * per:(k + 1) * per],
+                uids[k * per:(k + 1) * per],
+                devices[k],
+            )
+            for k in range(d)
+        ]
+        shards = [f.result() for f in futures]
+    return (
+        np.concatenate([s["counters"] for s in shards], axis=0),
+        shards[0]["counter_names"],
+        np.concatenate([s["decisions"] for s in shards], axis=1),
+        np.concatenate([s["leaders"] for s in shards], axis=1),
+        [s["stats"] for s in shards],
+    )
+
+
+def evaluate_alone(
+    campaign: Scenario,
+    *,
+    seed: int,
+    uid: int,
+    capacity: int,
+    depth: int = 2,
+    rounds_per_dispatch: int = 8,
+    unroll: int = 1,
+    engine: str | None = None,
+):
+    """One candidate, alone at B=1 — the standalone replay leg of the
+    parity oracle (same key, same padded capacity as its population
+    run).  Returns ``{counters [C], counter_names, decisions [R],
+    leaders [R]}``."""
+    block = _generate.lower_population([campaign], capacity, campaign.rounds)
+    state = population_state(1, capacity, campaign.order)
+    res = evaluate_population(
+        candidate_keys(seed, [uid]), state, block,
+        rounds=campaign.rounds, depth=depth,
+        rounds_per_dispatch=rounds_per_dispatch, unroll=unroll,
+        engine=engine,
+    )
+    return {
+        "counters": res["counters"][0],
+        "counter_names": res["counter_names"],
+        "decisions": res["decisions"][:, 0],
+        "leaders": res["leaders"][:, 0],
+    }
+
+
+@dataclasses.dataclass
+class SearchState:
+    """The hunt's resumable cursor — plain JSON data, nothing else.
+
+    ``generation`` is the NEXT generation to run and ``next_uid`` the
+    next candidate uid to assign; together with the seed-keyed
+    generator they determine every future sample and mutation, which
+    is the whole resume-bit-exactness argument.
+    """
+
+    seed: int
+    objective: str
+    space_doc: dict
+    generation: int = 0
+    next_uid: int = 0
+    elites: list = dataclasses.field(default_factory=list)
+    found: list = dataclasses.field(default_factory=list)
+    campaigns: int = 0
+    best_score: int = 0
+
+    def to_doc(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "SearchState":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - fields
+        if unknown:
+            raise ScenarioError(
+                f"unknown search state keys: {sorted(unknown)}"
+            )
+        return cls(**doc)
+
+
+def _compose_population(state: SearchState, space, elites: int):
+    """The next generation's candidates, deterministically: surviving
+    elites spawn mutants for half the population, fresh samples fill
+    the rest (generation 0, or an elite-less hunt, is the pure random
+    sweep).  Assigns uids from the state's cursor."""
+    parents = [
+        from_dict(e["doc"]) for e in state.elites[:elites]
+    ]
+    candidates, uids = [], []
+
+    def add(campaign):
+        candidates.append(campaign)
+        uids.append(state.next_uid)
+        state.next_uid += 1
+
+    n_mutants = space.population // 2 if parents else 0
+    for j in range(n_mutants):
+        add(
+            _generate.mutate_campaign(
+                parents[j % len(parents)], space, state.seed,
+                state.next_uid,
+            )
+        )
+    while len(candidates) < space.population:
+        add(_generate.sample_campaign(space, state.seed, state.next_uid))
+    return candidates, uids
+
+
+def _write_checkpoint(path, state: SearchState, run_id) -> str:
+    from ba_tpu.utils import snapshot as _snapshot
+
+    written = path.replace("{generation}", str(state.generation))
+    _snapshot.write_search_checkpoint(
+        written, state.to_doc(), run_id=run_id
+    )
+    _metrics.emit(
+        {
+            "event": "search_checkpoint",
+            "v": _metrics.SCHEMA_VERSION,
+            "generation": state.generation,
+            "path": written,
+            "found": len(state.found),
+        }
+    )
+    obs.default_registry().counter("search_checkpoints_total").inc()
+    return written
+
+
+def hunt(
+    space=None,
+    *,
+    seed: int = 0,
+    generations: int = 4,
+    objective="ic",
+    elites: int = 4,
+    depth: int = 2,
+    rounds_per_dispatch: int = 8,
+    unroll: int = 1,
+    mesh=None,
+    engine: str | None = None,
+    exec_seam=None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int | None = None,
+    resume=None,
+    stop_after: int | None = None,
+    minimize: bool = True,
+    minimize_max: int = 4,
+    export_dir: str | None = None,
+    on_generation=None,
+):
+    """Run an adversary hunt: ``generations`` rounds of sample →
+    evaluate → select → mutate over ``space``, collecting every
+    campaign that breaks the ``objective``'s violation counters.
+
+    ``space`` is a :class:`~ba_tpu.search.generate.SearchSpace` (or its
+    dict form); every dial is validated EAGERLY before any array is
+    built.  ``checkpoint_path`` (+ ``checkpoint_every`` generations,
+    default 1) serializes the search state after each due generation —
+    a literal ``{generation}`` in the path keeps a family;
+    ``resume=`` (a path or a state doc) continues a hunt bit-exactly
+    (``space``/``seed``/``objective`` ride the checkpoint; passing a
+    conflicting ``space`` raises).  ``stop_after=N`` ends the
+    generation loop early once N distinct violations are on file.
+
+    ``minimize=True`` delta-debugs up to ``minimize_max`` findings to
+    minimal event sets (``search/minimize.py``), each re-validated by
+    the alone-vs-in-population bit-exact replay oracle;
+    ``export_dir`` then writes the minimized reproducers as ordinary
+    provenance-stamped scenario JSON specs (``search/corpus.py``).
+
+    The whole hunt runs inside a flight-recorder run scope: a
+    deterministic run_id (derived from seed/space/objective, or
+    inherited from the resume checkpoint so a restarted hunt joins its
+    predecessor's ledger) stamps every ``search_*`` record, gauge
+    snapshot and checkpoint header.
+
+    Returns a dict: ``found`` (violation entries: spec doc, uid,
+    generation, score, per-slot counters), ``minimized`` (shrunk
+    entries incl. the ``bit_exact`` oracle verdict), ``elites``,
+    ``exported`` (paths, when ``export_dir``), ``state`` (the final
+    resumable doc) and ``stats``.
+    """
+    obj = _objective.get_objective(objective)
+    if generations < 1:
+        raise ScenarioError(f"generations={generations} must be >= 1")
+    if elites < 0:
+        raise ScenarioError(f"elites={elites} must be >= 0")
+    if depth < 1 or rounds_per_dispatch < 1 or unroll < 1:
+        raise ScenarioError(
+            f"depth={depth} / rounds_per_dispatch={rounds_per_dispatch} "
+            f"/ unroll={unroll} must all be >= 1"
+        )
+    if stop_after is not None and stop_after < 1:
+        raise ScenarioError(f"stop_after={stop_after} must be >= 1")
+    if minimize_max < 0:
+        raise ScenarioError(f"minimize_max={minimize_max} must be >= 0")
+    if checkpoint_every is not None and checkpoint_every < 1:
+        raise ScenarioError(
+            f"checkpoint_every={checkpoint_every} must be >= 1"
+        )
+    if checkpoint_every is not None and checkpoint_path is None:
+        # The pipeline engine's rule: a checkpoint cadence with no sink
+        # would leave an empty disk at resume time.
+        raise ScenarioError("checkpoint_every needs checkpoint_path")
+    if checkpoint_path is not None and checkpoint_every is None:
+        checkpoint_every = 1
+
+    if resume is not None:
+        if isinstance(resume, str):
+            from ba_tpu.utils import snapshot as _snapshot
+
+            meta, state_doc = _snapshot.read_search_checkpoint(resume)
+            inherited_rid = meta.get("run_id")
+        else:
+            state_doc, inherited_rid = dict(resume), None
+        state = SearchState.from_doc(state_doc)
+        resumed_space = _generate.space_from_dict(state.space_doc)
+        if space is not None:
+            given = (
+                _generate.space_to_dict(space)
+                if isinstance(space, _generate.SearchSpace)
+                else _generate.space_to_dict(
+                    _generate.space_from_dict(space)
+                )
+            )
+            if given != state.space_doc:
+                raise ScenarioError(
+                    "resume checkpoint was written for a different "
+                    "search space — pass space=None (the checkpoint "
+                    "carries it) or the identical space"
+                )
+        space = resumed_space
+        seed = state.seed
+        obj = _objective.get_objective(state.objective)
+        if not state.generation < generations:
+            raise ScenarioError(
+                f"resume cursor {state.generation} outside hunt "
+                f"[0, {generations}) — pass a larger generations= to "
+                f"extend the hunt"
+            )
+    else:
+        if space is None:
+            raise ScenarioError("hunt needs a search space (or resume=)")
+        if not isinstance(space, _generate.SearchSpace):
+            space = _generate.space_from_dict(space)
+        _generate.validate_space(space)
+        state = SearchState(
+            seed=seed,
+            objective=obj.name,
+            space_doc=_generate.space_to_dict(space),
+        )
+        inherited_rid = None
+    if mesh is not None:
+        d = len(_mesh_devices(mesh))
+        if d < 1 or space.population % d:
+            raise ScenarioError(
+                f"population {space.population} does not divide over "
+                f"{d} mesh device(s) — per-shard populations must be "
+                f"equal"
+            )
+
+    rid = obs.flight.resolve_run_id(
+        inherited=inherited_rid,
+        material_fn=lambda: [
+            "search",
+            seed,
+            json.dumps(state.space_doc, sort_keys=True),
+            obj.name,
+            generations,
+        ],
+    )
+    reg = obs.default_registry()
+    seen = {
+        _generate.campaign_fingerprint(from_dict(e["doc"]))
+        for e in state.found
+    }
+    n_checkpoints = 0
+    shard_stats = []
+    t_hunt = time.perf_counter()
+    with obs.flight.run_scope(rid) as scope:
+        obs.instant(
+            "search_start",
+            generations=generations,
+            population=space.population,
+            objective=obj.name,
+            resume=state.generation,
+        )
+        while state.generation < generations:
+            if stop_after is not None and len(state.found) >= stop_after:
+                break
+            g = state.generation
+            t0 = time.perf_counter()
+            candidates, uids = _compose_population(state, space, elites)
+            rows, names, decisions, leaders, stats = _evaluate_candidates(
+                candidates, uids, space,
+                seed=seed, depth=depth,
+                rounds_per_dispatch=rounds_per_dispatch, unroll=unroll,
+                engine=engine, exec_seam=exec_seam, mesh=mesh,
+            )
+            shard_stats = stats
+            scores = _objective.score_rows(rows, names, obj)
+            violations = _objective.violation_rows(rows, names, obj)
+            new_found = 0
+            for i, campaign in enumerate(candidates):
+                if not violations[i]:
+                    continue
+                fp = _generate.campaign_fingerprint(campaign)
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                new_found += 1
+                entry = {
+                    "doc": to_dict(campaign),
+                    "uid": uids[i],
+                    "generation": g,
+                    "score": int(scores[i]),
+                    "counters": _objective.counters_dict(rows[i], names),
+                }
+                state.found.append(entry)
+                _metrics.emit(
+                    {
+                        "event": "search_found",
+                        "v": _metrics.SCHEMA_VERSION,
+                        "name": campaign.name,
+                        "uid": uids[i],
+                        "generation": g,
+                        "score": entry["score"],
+                        "events": len(campaign.events),
+                        "counters": entry["counters"],
+                        "objective": obj.name,
+                    }
+                )
+            pool = state.elites[:elites] + [
+                {
+                    "doc": to_dict(c),
+                    "uid": uids[i],
+                    "score": int(scores[i]),
+                }
+                for i, c in enumerate(candidates)
+            ]
+            pool.sort(key=lambda e: (-e["score"], e["uid"]))
+            state.elites = pool[: max(elites, 1)]
+            state.campaigns += len(candidates)
+            state.best_score = max(
+                state.best_score, int(scores.max()) if len(scores) else 0
+            )
+            state.generation = g + 1
+            reg.counter("search_generations_total").inc()
+            reg.counter("search_campaigns_total").inc(len(candidates))
+            if new_found:
+                reg.counter("search_found_total").inc(new_found)
+            reg.gauge("search_best_score").set(state.best_score)
+            gen_wall = time.perf_counter() - t0
+            _metrics.emit(
+                {
+                    "event": "search_generation",
+                    "v": _metrics.SCHEMA_VERSION,
+                    "generation": g,
+                    "campaigns": len(candidates),
+                    "best_score": state.best_score,
+                    "new_found": new_found,
+                    "found_total": len(state.found),
+                    "objective": obj.name,
+                    "wall_s": round(gen_wall, 6),
+                }
+            )
+            if on_generation is not None:
+                on_generation(
+                    g,
+                    {
+                        "scores": scores,
+                        "new_found": new_found,
+                        "found_total": len(state.found),
+                    },
+                )
+            if (
+                checkpoint_path is not None
+                and (state.generation % checkpoint_every == 0
+                     or state.generation == generations)
+            ):
+                _write_checkpoint(checkpoint_path, state, scope.run_id)
+                n_checkpoints += 1
+
+        minimized = []
+        if minimize:
+            from ba_tpu.search import minimize as _minimize
+
+            for entry in state.found[:minimize_max]:
+                campaign = from_dict(entry["doc"])
+                shrunk, info = _minimize.shrink(
+                    campaign,
+                    seed=seed,
+                    uid=entry["uid"],
+                    capacity=space.capacity,
+                    objective=obj,
+                    depth=depth,
+                    rounds_per_dispatch=rounds_per_dispatch,
+                    engine=engine,
+                )
+                verdict = _minimize.verify_minimized(
+                    shrunk,
+                    seed=seed,
+                    uid=entry["uid"],
+                    capacity=space.capacity,
+                    objective=obj,
+                    depth=depth,
+                    rounds_per_dispatch=rounds_per_dispatch,
+                    engine=engine,
+                )
+                minimized.append(
+                    {
+                        "doc": to_dict(shrunk),
+                        "uid": entry["uid"],
+                        "generation": entry["generation"],
+                        "events_before": info["events_before"],
+                        "events_after": info["events_after"],
+                        "evals": info["evals"],
+                        "score": verdict["score"],
+                        "counters": verdict["counters"],
+                        "bit_exact": verdict["bit_exact"],
+                    }
+                )
+                _metrics.emit(
+                    {
+                        "event": "search_minimized",
+                        "v": _metrics.SCHEMA_VERSION,
+                        "name": shrunk.name,
+                        "uid": entry["uid"],
+                        "generation": entry["generation"],
+                        "events_before": info["events_before"],
+                        "events_after": info["events_after"],
+                        "evals": info["evals"],
+                        "score": verdict["score"],
+                        "bit_exact": verdict["bit_exact"],
+                        "objective": obj.name,
+                    }
+                )
+
+        exported = []
+        if export_dir is not None and minimized:
+            from ba_tpu.search import corpus as _corpus
+
+            exported = _corpus.export_found(
+                minimized, export_dir, seed=seed, objective=obj.name,
+                capacity=space.capacity,
+            )
+        reg.gauge("search_corpus_size").set(len(exported))
+        obs.instant(
+            "search_drain",
+            generations=state.generation,
+            found=len(state.found),
+            best_score=state.best_score,
+        )
+        result = {
+            "found": list(state.found),
+            "minimized": minimized,
+            "elites": list(state.elites),
+            "exported": exported,
+            "state": state.to_doc(),
+            "stats": {
+                "run_id": scope.run_id,
+                "seed": seed,
+                "objective": obj.name,
+                "generations_run": state.generation,
+                "population": space.population,
+                "campaigns": state.campaigns,
+                "found": len(state.found),
+                "minimized": len(minimized),
+                "best_score": state.best_score,
+                "checkpoints": n_checkpoints,
+                "shards": (
+                    len(_mesh_devices(mesh)) if mesh is not None else 1
+                ),
+                "engine": (
+                    shard_stats[0].get("engine") if shard_stats else None
+                ),
+                "wall_s": round(time.perf_counter() - t_hunt, 6),
+            },
+        }
+        if scope.owner:
+            obs.flight.emit_flight_summary(run_id=scope.run_id)
+    return result

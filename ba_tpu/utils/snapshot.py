@@ -197,6 +197,86 @@ def restore_cluster(path: str, cluster) -> None:
 # -- carry checkpoints (the pipelined engine's donated carry, durable) --------
 
 
+SEARCH_STATE_FORMAT = "ba_tpu.search_state"
+SEARCH_STATE_VERSION = 1
+
+
+def _search_state_digest(state: dict) -> str:
+    """sha256 over the canonical JSON of a search-state payload — the
+    pure-JSON twin of :func:`content_digest` (search state is plain
+    data, no arrays to hash)."""
+    return hashlib.sha256(
+        json.dumps(state, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def write_search_checkpoint(path: str, state: dict, **meta) -> None:
+    """Search-state payload + meta -> one atomic versioned JSON file.
+
+    The adversary search engine's checkpoint (ISSUE 15): the hunt's
+    resumable state is plain JSON-able data (seed, generation cursor,
+    uid counter, elites, findings), so the repo's checkpoint discipline
+    — versioned format header, computed content digest, atomic write —
+    applies without the ``.npz`` array machinery.  ``meta`` keys ride
+    the header next to the engine's own (``run_id`` in particular).
+    """
+    doc = {
+        "format": SEARCH_STATE_FORMAT,
+        "v": SEARCH_STATE_VERSION,
+        **meta,
+        # Last so caller meta can never mask them: the digest is
+        # computed, not declared (the write_carry_checkpoint rule).
+        "sha256": _search_state_digest(state),
+        "state": state,
+    }
+
+    def write(tmp):
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+
+    _atomic_write(path, write)
+
+
+def read_search_checkpoint(path: str):
+    """JSON file -> ``(meta, state dict)`` after schema checks.
+
+    Raises ``ValueError`` on anything that could silently resume the
+    wrong hunt: unknown format/version, a missing/non-object payload,
+    or a content-digest mismatch.  stdlib-only — the jax-free search
+    CLI validates checkpoints through this reader.
+    """
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{path!r}: not valid JSON ({e})") from None
+    if not isinstance(doc, dict) or doc.get("format") != SEARCH_STATE_FORMAT:
+        raise ValueError(
+            f"{path!r}: format "
+            f"{doc.get('format') if isinstance(doc, dict) else doc!r} "
+            f"is not {SEARCH_STATE_FORMAT!r}"
+        )
+    if doc.get("v") != SEARCH_STATE_VERSION:
+        raise ValueError(
+            f"{path!r}: search state version {doc.get('v')!r} "
+            f"(this build reads v{SEARCH_STATE_VERSION})"
+        )
+    state = doc.get("state")
+    if not isinstance(state, dict):
+        raise ValueError(f"{path!r}: search state payload missing")
+    want = doc.get("sha256")
+    got = _search_state_digest(state)
+    if want != got:
+        raise ValueError(
+            f"{path!r}: content digest mismatch (stored "
+            f"{str(want)[:12]}..., recomputed {got[:12]}...) — the "
+            f"search checkpoint is corrupt; refusing to resume from it"
+        )
+    meta = {k: v for k, v in doc.items() if k not in ("state", "sha256")}
+    return meta, state
+
+
 def content_digest(arrays: dict) -> str:
     """sha256 over every array's name, dtype, shape and raw bytes.
 

@@ -3068,6 +3068,91 @@ def bench_signed_ab(jax, jnp, jr):
     }
 
 
+def bench_adversary_search(jax, jnp, jr):
+    """Adversary-search config (ISSUE 15 acceptance): a seeded
+    CI-sized hunt — random populations of candidate campaigns lowered
+    campaign-per-instance and evaluated batched through the coalesced
+    engine — must (a) sustain a candidate-campaign throughput worth
+    brute-forcing with, and (b) FIND at least one IC1/IC2-violating
+    campaign, shrink it to a minimal event set, and reproduce the
+    violation bit-exactly when the shrunk spec replays standalone
+    (the alone-vs-in-population parity oracle).
+
+    Throughput is read from the steady-state generations (the
+    per-generation walls after generation 0's compile), reported both
+    as campaigns/s and campaign-rounds/s; ``found_violation_rate`` is
+    the random sweep's hit rate over the whole hunt.  The two
+    acceptance booleans are gated by the trajectory sentinel:
+    ``found_violation_ok`` (the hunt found and minimized >= 1
+    violation) and ``shrunk_replay_bit_exact`` (every minimized
+    finding passed the parity oracle).
+    """
+    from ba_tpu.search.generate import SearchSpace
+    from ba_tpu.search.loop import hunt
+
+    population = int(os.environ.get("BA_TPU_BENCH_SEARCH_POP", 256))
+    capacity = int(os.environ.get("BA_TPU_BENCH_SEARCH_CAP", 16))
+    rounds = int(os.environ.get("BA_TPU_BENCH_SEARCH_ROUNDS", 8))
+    generations = int(os.environ.get("BA_TPU_BENCH_SEARCH_GENS", 4))
+    space = SearchSpace(
+        rounds=rounds, capacity=capacity, population=population,
+        events_min=2, events_max=6,
+    )
+    gen_walls = []
+    t0 = time.perf_counter()
+    out = hunt(
+        space, seed=41, generations=generations, objective="ic",
+        minimize=True, minimize_max=2,
+        on_generation=lambda g, info: gen_walls.append(
+            time.perf_counter()
+        ),
+    )
+    elapsed = time.perf_counter() - t0
+    # Steady-state generation wall: the narrowest gap between
+    # consecutive generation completions (generation 0 pays the
+    # megastep compiles; later generations are pure dispatch streams).
+    steady = min(
+        (b - a for a, b in zip(gen_walls, gen_walls[1:])),
+        default=elapsed,
+    )
+    stats = out["stats"]
+    minimized = out["minimized"]
+    return {
+        "rounds_per_sec": round(population * rounds / steady, 1),
+        "campaigns_per_sec": round(population / steady, 1),
+        "population": population,
+        "capacity": capacity,
+        "rounds": rounds,
+        "generations": generations,
+        "campaigns": stats["campaigns"],
+        "found": stats["found"],
+        "found_violation_rate": round(
+            stats["found"] / stats["campaigns"], 4
+        ),
+        "best_score": stats["best_score"],
+        "minimized_events": [
+            [m["events_before"], m["events_after"]] for m in minimized
+        ],
+        "minimize_evals": sum(m["evals"] for m in minimized),
+        "found_violation_ok": stats["found"] >= 1 and len(minimized) >= 1,
+        "shrunk_replay_bit_exact": bool(minimized)
+        and all(m["bit_exact"] for m in minimized),
+        "objective": "ic",
+        "elapsed_s": round(elapsed, 4),
+        "steady_generation_s": round(steady, 4),
+        "bound": "population evaluation is one coalesced scenario "
+                 "dispatch stream (per-slot keys + per-slot counter "
+                 "blocks), so campaigns/s is the engine's batched "
+                 "mutating-round throughput divided by rounds; "
+                 "generation 0 additionally pays the megastep compiles",
+        "note": "seeded hunt (seed 41): sample -> evaluate -> elite "
+                "mutation over the spec grammar; findings ddmin-shrunk "
+                "and re-validated by the alone-vs-in-population "
+                "bit-exact replay oracle (the serving parity pin).  "
+                "CPU artifact BENCH_search_r15.json",
+    }
+
+
 CONFIGS = {
     # Latency-sensitive configs first: dispatch through the TPU tunnel gets
     # noticeably slower once the big Ed25519-verify programs have run
@@ -3089,6 +3174,7 @@ CONFIGS = {
     "multichip": bench_multichip,
     "sweep10k_signed": bench_sweep10k_signed,
     "sm1_n64_signed": bench_sm1_n64_signed,
+    "adversary_search": bench_adversary_search,
 }
 
 # scenario_long runs a quarter-million-round campaign (minutes of wall
@@ -3097,16 +3183,18 @@ CONFIGS = {
 # children (the device count must precede jax init), serving runs
 # a deliberately-overloaded client-fleet drill (thread storms, 50 ms
 # stalls per dispatch), serving_warm pays a full AOT warmup pass
-# plus a deliberately-cold comparison leg, and megastep_ab re-traces
+# plus a deliberately-cold comparison leg, megastep_ab re-traces
 # the legacy strategy formulation per rep + runs the Pallas interpreter
-# leg (minutes of compile/interpretation by design) — all opt in
-# explicitly: `--configs scenario_long` / `resilience` / `multichip` /
-# `serving` / `serving_warm` / `megastep_ab`.
+# leg (minutes of compile/interpretation by design), and
+# adversary_search runs a multi-generation hunt whose minimizer replays
+# dozens of shrink trials — all opt in explicitly: `--configs
+# scenario_long` / `resilience` / `multichip` / `serving` /
+# `serving_warm` / `megastep_ab` / `adversary_search`.
 DEFAULT_CONFIGS = [
     n for n in CONFIGS
     if n not in (
         "scenario_long", "resilience", "multichip", "serving",
-        "serving_warm", "megastep_ab", "signed_ab",
+        "serving_warm", "megastep_ab", "signed_ab", "adversary_search",
     )
 ]
 

@@ -20,6 +20,10 @@ family (prefix-rule-checked) — plus (ISSUE 11) a WARM serve session
 (background AOT warmup → warm barrier → one warm-dispatched request,
 ``compiles_on_request_path`` asserted 0) driving the real ``warmup``
 record emitters (run_id-stamped) and the ``serve_warmup_*`` gauges —
+plus (ISSUE 15) a short deterministic ADVERSARY SEARCH session (two
+hunt generations, one checkpoint, one minimized finding) driving the
+real ``search_generation``/``search_found``/``search_checkpoint``/
+``search_minimized`` emitters and the ``search_*`` gauge family —
 into a temp sink, then validates every line, including the typed shape of the device-tier, resilience, flight
 and serving records, and the presence/shape of ``run_id`` on every
 record family that carries it.  Run by ``scripts/ci.sh`` before
@@ -252,6 +256,40 @@ def main() -> int:
                 f"schema check: warm service (incl. a signed cohort) "
                 f"compiled on the request path "
                 f"({warm_stats['compiles_on_request_path']}x)",
+                file=sys.stderr,
+            )
+            return 1
+
+        # Adversary-search records (ISSUE 15): a short DETERMINISTIC
+        # seeded hunt — two generations over a tiny space whose random
+        # sweep is guaranteed to break IC (capacity 6 with up to 6
+        # events finds t >= 2 campaigns immediately), one checkpointed
+        # generation, one minimized finding — drives the real
+        # search_generation / search_found / search_checkpoint /
+        # search_minimized emitters, every one stamped with the hunt's
+        # run_id (the RUN_SCOPED_EVENTS contract, validated below).
+        from ba_tpu.search.generate import SearchSpace
+        from ba_tpu.search.loop import hunt as search_hunt
+
+        search_out = search_hunt(
+            SearchSpace(
+                rounds=4, capacity=6, population=8,
+                events_min=2, events_max=5,
+            ),
+            seed=3, generations=2, objective="ic",
+            minimize=True, minimize_max=1,
+            checkpoint_path=path + ".search.json",
+        )
+        if not (
+            search_out["stats"]["found"] >= 1
+            and search_out["minimized"]
+            and search_out["minimized"][0]["bit_exact"]
+        ):
+            print(
+                f"schema check: search session found "
+                f"{search_out['stats']['found']} violation(s), minimized "
+                f"{search_out['minimized']} — the deterministic hunt "
+                f"must find and shrink at least one",
                 file=sys.stderr,
             )
             return 1
@@ -605,6 +643,77 @@ def main() -> int:
                         file=sys.stderr,
                     )
                     bad += 1
+            elif rec.get("event") == "search_generation":
+                # Adversary-search records (ISSUE 15): one per hunt
+                # generation.
+                if not (
+                    isinstance(rec.get("generation"), int)
+                    and isinstance(rec.get("campaigns"), int)
+                    and rec.get("campaigns") >= 1
+                    and isinstance(rec.get("best_score"), int)
+                    and isinstance(rec.get("new_found"), int)
+                    and isinstance(rec.get("found_total"), int)
+                    and isinstance(rec.get("objective"), str)
+                    and isinstance(rec.get("wall_s"), (int, float))
+                ):
+                    print(
+                        f"schema check: line {i} malformed "
+                        f"search_generation: {line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "search_found":
+                if not (
+                    isinstance(rec.get("name"), str)
+                    and isinstance(rec.get("uid"), int)
+                    and isinstance(rec.get("generation"), int)
+                    and isinstance(rec.get("score"), int)
+                    and rec.get("score") >= 1
+                    and isinstance(rec.get("events"), int)
+                    and isinstance(rec.get("counters"), dict)
+                    and rec.get("counters")
+                    and all(
+                        isinstance(v, int)
+                        for v in rec["counters"].values()
+                    )
+                    and isinstance(rec.get("objective"), str)
+                ):
+                    print(
+                        f"schema check: line {i} malformed search_found: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "search_minimized":
+                if not (
+                    isinstance(rec.get("name"), str)
+                    and isinstance(rec.get("uid"), int)
+                    and isinstance(rec.get("events_before"), int)
+                    and isinstance(rec.get("events_after"), int)
+                    and rec.get("events_after") <= rec.get("events_before")
+                    and isinstance(rec.get("evals"), int)
+                    and isinstance(rec.get("score"), int)
+                    and isinstance(rec.get("bit_exact"), bool)
+                    and isinstance(rec.get("objective"), str)
+                ):
+                    print(
+                        f"schema check: line {i} malformed "
+                        f"search_minimized: {line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "search_checkpoint":
+                if not (
+                    isinstance(rec.get("generation"), int)
+                    and isinstance(rec.get("path"), str)
+                    and isinstance(rec.get("found"), int)
+                ):
+                    print(
+                        f"schema check: line {i} malformed "
+                        f"search_checkpoint: {line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
             elif rec.get("event") == "metrics_snapshot":
                 # Shard-labeled gauges (ISSUE 8): the engine stamps the
                 # device count and per-device carry/plane byte shares
@@ -636,6 +745,26 @@ def main() -> int:
                     "serve_warmup_pending",
                     "serve_warmup_warmed_total",
                     "serve_compile_on_request_path_total",
+                ):
+                    snap = metrics_blk.get(g)
+                    if not (
+                        isinstance(snap, dict)
+                        and isinstance(snap.get("value"), (int, float))
+                    ):
+                        print(
+                            f"schema check: line {i} metrics_snapshot "
+                            f"missing/malformed gauge {g}: {line[:160]}",
+                            file=sys.stderr,
+                        )
+                        bad += 1
+                for g in (
+                    # Adversary-search family (ISSUE 15): the hunt
+                    # above must have left its gauges/counters behind.
+                    "search_best_score",
+                    "search_generations_total",
+                    "search_campaigns_total",
+                    "search_found_total",
+                    "search_checkpoints_total",
                 ):
                     snap = metrics_blk.get(g)
                     if not (
@@ -685,6 +814,10 @@ def main() -> int:
             "shed",
             "warmup",
             "sign_ahead",
+            "search_generation",
+            "search_found",
+            "search_minimized",
+            "search_checkpoint",
         }
         if not want <= events:
             print(
@@ -719,7 +852,7 @@ def main() -> int:
         return 0
     finally:
         os.unlink(path)
-        for ck in (".carry.npz", ".mesh_carry.npz"):
+        for ck in (".carry.npz", ".mesh_carry.npz", ".search.json"):
             if os.path.exists(path + ck):
                 os.unlink(path + ck)
         import glob

@@ -185,6 +185,17 @@ mutate_and_expect BA301 runtime/warmup.py \
     'from ba_tpu.parallel import pipeline as _mut_engine' || exit 1
 mutate_and_expect BA301 obs/aotcache.py \
     'from ba_tpu.core import om as _mut_core' || exit 1
+# ISSUE 15: the adversary search loop (search/loop.py) joined the BA101
+# hot-path scope — its generation loop drives the coalesced engine's
+# dispatch stream, and a host sync there would serialize population
+# evaluation.  Prove the extension is live.
+mutate_and_expect BA101 search/loop.py \
+    'def _mut101_search(x):
+    return x.block_until_ready()' || exit 1
+# ...and the search package is host-tier at module level (the jax-free
+# CLI / CI corpus stage depend on it) — prove that direction too.
+mutate_and_expect BA301 search/generate.py \
+    'from ba_tpu.core import om as _mut_core' || exit 1
 
 echo "== scenario spec round-trip =="
 # ISSUE 5: the committed campaign specs must load, validate, round-trip
@@ -192,8 +203,20 @@ echo "== scenario spec round-trip =="
 # validator is jax-free by construction (spec + compiler are
 # numpy/stdlib only — tests/test_scenario.py pins the no-jax property),
 # so like ba-lint this stage costs well under a second.
-if ! python -m ba_tpu.scenario examples/scenarios/*.json; then
+# ISSUE 15: the search-found minimal reproducers in
+# examples/scenarios/found/ are ordinary scenario specs and ride the
+# same jax-free round-trip stage.
+if ! python -m ba_tpu.scenario examples/scenarios/*.json \
+        examples/scenarios/found/*.json; then
     echo "scenario spec validation failed" >&2
+    exit 1
+fi
+# Their search-specific contract — a well-formed provenance.search
+# replay recipe on every reproducer — is the search CLI's corpus
+# check, jax-free by construction (subprocess-pinned in
+# tests/test_search.py).
+if ! python -m ba_tpu.search corpus examples/scenarios/found; then
+    echo "search corpus validation failed" >&2
     exit 1
 fi
 

@@ -1,0 +1,544 @@
+"""Adversary search engine tests (ISSUE 15, ``ba_tpu/search/``).
+
+The contracts under pin:
+
+- generator determinism: same seed -> identical population, and the
+  population lowering confines candidate i's events to instance i;
+- eager validation: hand-edited search configs fail with
+  ScenarioError-grade messages before any array is built;
+- objective scoring reads EXACTLY what the engine's per-slot counter
+  blocks carry: the quorum column matches an independent host
+  derivation from the decisions stream, and every slot's block is
+  bit-identical to the same candidate's standalone B=1 run (the
+  serving parity pin as the search's correctness oracle);
+- the end-to-end acceptance: a CI-sized seeded hunt finds an IC
+  violation from a random population, ddmin-shrinks it, and the shrunk
+  spec replayed standalone reproduces the violation bit-exactly;
+- search-state checkpoints resume a hunt bit-exactly mid-hunt;
+- the depth-k no-blocking dispatch-count proof re-runs with the search
+  harness live;
+- the ``python -m ba_tpu.search`` corpus CLI is jax-free (subprocess
+  pin), and the COMMITTED ``examples/scenarios/found/`` reproducers
+  replay their provenance counters bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ba_tpu.scenario.spec import ScenarioError, from_dict, to_dict
+from ba_tpu.search.generate import (
+    SearchSpace,
+    campaign_fingerprint,
+    lower_population,
+    mutate_campaign,
+    sample_campaign,
+    sample_population,
+    space_from_dict,
+    space_to_dict,
+    validate_space,
+)
+from ba_tpu.search.objective import (
+    OBJECTIVES,
+    counters_dict,
+    get_objective,
+    score_rows,
+    violation_rows,
+)
+from ba_tpu.utils.snapshot import (
+    read_search_checkpoint,
+    write_search_checkpoint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One shared shape for the engine-touching tests: every hunt below
+# compiles the same coalesced megastep specializations, so the suite
+# pays the trace once (the persistent-cache discipline).
+SPACE = SearchSpace(
+    rounds=4, capacity=6, population=8, events_min=2, events_max=5
+)
+
+
+def _hunt(**kwargs):
+    from ba_tpu.search.loop import hunt
+
+    return hunt(**kwargs)
+
+
+# -- jax-free layers ---------------------------------------------------------
+
+
+def test_space_validation_eager():
+    with pytest.raises(ScenarioError, match="population"):
+        validate_space(SearchSpace(rounds=4, capacity=6, population=0))
+    with pytest.raises(ScenarioError, match="events_min"):
+        validate_space(
+            SearchSpace(
+                rounds=4, capacity=6, population=4,
+                events_min=5, events_max=2,
+            )
+        )
+    with pytest.raises(ScenarioError, match="strategies"):
+        validate_space(
+            SearchSpace(
+                rounds=4, capacity=6, population=4,
+                strategies=("nope",),
+            )
+        )
+    with pytest.raises(ScenarioError, match="kinds"):
+        validate_space(
+            SearchSpace(rounds=4, capacity=6, population=4, kinds=())
+        )
+    with pytest.raises(ScenarioError, match="faulty_max"):
+        validate_space(
+            SearchSpace(rounds=4, capacity=6, population=4, faulty_max=99)
+        )
+    with pytest.raises(ScenarioError, match="ids_per_event"):
+        validate_space(
+            SearchSpace(
+                rounds=4, capacity=6, population=4, ids_per_event=7
+            )
+        )
+    with pytest.raises(ScenarioError, match="order"):
+        validate_space(
+            SearchSpace(rounds=4, capacity=6, population=4, order="march")
+        )
+    # And the objective table is eager too.
+    with pytest.raises(ScenarioError, match="unknown search objective"):
+        get_objective("win")
+
+
+def test_space_doc_round_trip_and_unknown_keys():
+    doc = space_to_dict(SPACE)
+    assert space_to_dict(space_from_dict(json.loads(json.dumps(doc)))) == doc
+    with pytest.raises(ScenarioError, match="unknown search space"):
+        space_from_dict({**doc, "zap": 1})
+    with pytest.raises(ScenarioError, match="missing"):
+        space_from_dict({"rounds": 4})
+
+
+def test_generator_determinism_and_budgets():
+    pop1 = sample_population(SPACE, seed=11)
+    pop2 = sample_population(SPACE, seed=11)
+    assert [to_dict(c) for c in pop1] == [to_dict(c) for c in pop2]
+    assert len(pop1) == SPACE.population
+    # A different seed diverges (overwhelmingly; pinned for this seed
+    # pair so the test is deterministic).
+    pop3 = sample_population(SPACE, seed=12)
+    assert [to_dict(c) for c in pop1] != [to_dict(c) for c in pop3]
+    # Budgets hold on every sample, including under tight caps.
+    tight = SearchSpace(
+        rounds=4, capacity=6, population=16,
+        events_min=2, events_max=5, faulty_max=1, kill_max=2,
+        kinds=("kill", "set_faulty", "set_strategy"),
+    )
+    for c in sample_population(tight, seed=5):
+        assert len(c.events) <= tight.events_max
+        made_faulty = {
+            g for ev in c.events
+            if ev.kind == "set_faulty" and ev.value for g in ev.ids
+        }
+        killed = {
+            g for ev in c.events if ev.kind == "kill" for g in ev.ids
+        }
+        assert len(made_faulty) <= 1
+        assert len(killed) <= 2
+    # Revive-enabled spaces sample clean too: the kill branch excludes
+    # same-round revived generals (and vice versa), so the
+    # validates-by-construction contract holds for the full kind menu
+    # (regression: revive-then-kill of one general in one round used to
+    # raise ScenarioError from inside sample_campaign, aborting hunts).
+    from ba_tpu.scenario.spec import EVENT_KINDS
+
+    flap = SearchSpace(
+        rounds=2, capacity=4, population=4,
+        events_min=4, events_max=8, kinds=EVENT_KINDS,
+    )
+    for uid in range(300):
+        sample_campaign(flap, 0, uid)
+    # Mutation is deterministic per (seed, uid) and validates.
+    parent = pop1[0]
+    m1 = mutate_campaign(parent, SPACE, 11, 500)
+    m2 = mutate_campaign(parent, SPACE, 11, 500)
+    assert to_dict(m1) == to_dict(m2)
+    assert m1.name == "search-s11-u500"
+
+
+def test_lower_population_confines_events_to_instances():
+    pop = sample_population(SPACE, seed=11)
+    block = lower_population(pop, SPACE.capacity, SPACE.rounds)
+    assert block.batch == len(pop)
+    planes = block.chunk(0, SPACE.rounds)
+    from ba_tpu.scenario.compile import compile_scenario
+
+    for i, campaign in enumerate(pop):
+        single = compile_scenario(
+            campaign, batch=1, capacity=SPACE.capacity
+        )
+        np.testing.assert_array_equal(planes["kill"][:, i], single.kill[:, 0])
+        np.testing.assert_array_equal(
+            planes["set_faulty"][:, i], single.set_faulty[:, 0]
+        )
+        np.testing.assert_array_equal(
+            planes["set_strategy"][:, i], single.set_strategy[:, 0]
+        )
+    # Rows outside a candidate's instance never carry its events: sum
+    # of per-candidate mutated cells equals the population's.
+    assert (planes["set_faulty"] >= 0).sum() == sum(
+        (
+            compile_scenario(c, batch=1, capacity=SPACE.capacity)
+            .set_faulty >= 0
+        ).sum()
+        for c in pop
+    )
+
+
+def test_objective_scores_and_errors():
+    names = ("quorum_failures", "unanimous_rounds",
+             "equivocation_observed", "ic1_violations", "ic2_violations")
+    rows = np.array([[0, 4, 0, 0, 0], [2, 4, 1, 3, 1]], np.int32)
+    assert list(score_rows(rows, names, "ic")) == [0, 4]
+    assert list(score_rows(rows, names, "havoc")) == [0, 8 * 3 + 8 + 4 + 1]
+    assert list(violation_rows(rows, names, "ic")) == [False, True]
+    assert list(violation_rows(rows, names, "quorum")) == [False, True]
+    assert counters_dict(rows[1], names)["ic1_violations"] == 3
+    with pytest.raises(ScenarioError, match="not in the run's table"):
+        score_rows(rows, ("a", "b", "c", "d", "e"), "ic")
+    with pytest.raises(ScenarioError, match="expected"):
+        score_rows(rows[0], names, "ic")
+    assert set(OBJECTIVES) == {"ic1", "ic2", "ic", "quorum", "havoc"}
+
+
+def test_search_checkpoint_schema_rejects_corruption(tmp_path):
+    path = str(tmp_path / "hunt.json")
+    write_search_checkpoint(path, {"seed": 1}, run_id="run-abc")
+    meta, state = read_search_checkpoint(path)
+    assert state == {"seed": 1}
+    assert meta["run_id"] == "run-abc"
+    assert meta["format"] == "ba_tpu.search_state"
+    doc = json.load(open(path))
+    doc["state"]["seed"] = 2  # tamper: digest must catch it
+    open(path, "w").write(json.dumps(doc))
+    with pytest.raises(ValueError, match="digest mismatch"):
+        read_search_checkpoint(path)
+    open(path, "w").write("{not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        read_search_checkpoint(path)
+    open(path, "w").write('{"format": "other", "v": 1}')
+    with pytest.raises(ValueError, match="format"):
+        read_search_checkpoint(path)
+
+
+def test_cli_corpus_is_jax_free_subprocess():
+    # The BA301 host-tier contract, proven at runtime on the REAL
+    # committed corpus: the corpus/sample subcommands must never pull
+    # jax (CI runs them on accelerator-free hosts).
+    code = (
+        "import sys; from ba_tpu.search.__main__ import main; "
+        "rc = main(['corpus', 'examples/scenarios/found']); "
+        "assert 'jax' not in sys.modules, 'search CLI pulled jax'; "
+        "sys.exit(rc)"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "corpus OK" in out.stdout
+
+
+def test_export_refuses_non_bit_exact(tmp_path):
+    from ba_tpu.search.corpus import export_found
+
+    entry = {
+        "doc": to_dict(sample_campaign(SPACE, 11, 0)),
+        "uid": 0,
+        "generation": 0,
+        "score": 1,
+        "counters": {"ic1_violations": 1},
+        "bit_exact": False,
+    }
+    with pytest.raises(ScenarioError, match="parity oracle"):
+        export_found(
+            [entry], str(tmp_path), seed=11, objective="ic",
+            capacity=SPACE.capacity,
+        )
+
+
+# -- engine-touching contracts ------------------------------------------------
+
+
+def test_objective_vs_host_derivation_and_alone_parity():
+    # A seeded population KNOWN to contain violating campaigns
+    # (seed 3 over this space is the schema-check hunt's sweep).
+    from ba_tpu.core import UNDEFINED
+    from ba_tpu.search.loop import (
+        candidate_keys,
+        evaluate_alone,
+        evaluate_population,
+        population_state,
+    )
+
+    pop = sample_population(SPACE, seed=3)
+    uids = list(range(len(pop)))
+    block = lower_population(pop, SPACE.capacity, SPACE.rounds)
+    res = evaluate_population(
+        candidate_keys(3, uids),
+        population_state(len(pop), SPACE.capacity, SPACE.order),
+        block,
+        rounds=SPACE.rounds,
+    )
+    rows = res["counters"]
+    names = res["counter_names"]
+    scores = score_rows(rows, names, "ic")
+    violations = violation_rows(rows, names, "ic")
+    assert violations.any(), "seeded sweep lost its violating campaigns"
+    # Host derivation: the per-slot quorum_failures column is exactly
+    # the count of UNDEFINED decisions in that slot's stream.
+    q = list(names).index("quorum_failures")
+    np.testing.assert_array_equal(
+        rows[:, q], (res["decisions"] == UNDEFINED).sum(axis=0)
+    )
+    # The parity oracle: every slot's counter block (and decision /
+    # leader stream) is bit-identical to the candidate's own B=1 run.
+    for i in np.flatnonzero(violations)[:2]:
+        alone = evaluate_alone(
+            pop[i], seed=3, uid=uids[i], capacity=SPACE.capacity
+        )
+        np.testing.assert_array_equal(alone["counters"], rows[i])
+        np.testing.assert_array_equal(
+            alone["decisions"], res["decisions"][:, i]
+        )
+        np.testing.assert_array_equal(
+            alone["leaders"], res["leaders"][:, i]
+        )
+        assert int(scores[i]) >= 1
+
+
+def test_hunt_end_to_end_finds_shrinks_and_reproduces(tmp_path):
+    # ISSUE 15 acceptance: a CI-sized seeded hunt finds at least one
+    # IC-violating campaign from a random population, shrinks it, and
+    # the shrunk spec replayed STANDALONE reproduces the violation
+    # bit-exactly (decisions/leaders/counters — the oracle inside
+    # verify_minimized, re-checked here independently).
+    from ba_tpu.search.loop import evaluate_alone
+
+    res = _hunt(
+        space=SPACE, seed=3, generations=2, objective="ic",
+        minimize=True, minimize_max=2,
+        export_dir=str(tmp_path / "found"),
+    )
+    assert res["stats"]["found"] >= 1
+    assert res["minimized"], "hunt found nothing to minimize"
+    for m in res["minimized"]:
+        assert m["bit_exact"] is True
+        assert m["events_after"] <= m["events_before"]
+        assert m["score"] >= 1
+        shrunk = from_dict(m["doc"])
+        alone = evaluate_alone(
+            shrunk, seed=3, uid=m["uid"], capacity=SPACE.capacity
+        )
+        got = counters_dict(alone["counters"], alone["counter_names"])
+        assert got == m["counters"]
+        assert violation_rows(
+            np.asarray(alone["counters"])[None, :],
+            alone["counter_names"], "ic",
+        )[0]
+    # The export landed as ordinary provenance-stamped spec files that
+    # the corpus contract accepts.
+    from ba_tpu.search.corpus import load_corpus
+
+    specs = load_corpus(str(tmp_path / "found"))
+    assert len(specs) == len(res["minimized"])
+    assert all(
+        s.provenance["search"]["capacity"] == SPACE.capacity for s in specs
+    )
+    # Dedup: every found entry is a distinct campaign.
+    fps = [
+        campaign_fingerprint(from_dict(e["doc"])) for e in res["found"]
+    ]
+    assert len(fps) == len(set(fps))
+
+
+def test_hunt_checkpoint_resume_bit_exact(tmp_path):
+    ck = str(tmp_path / "hunt_g{generation}.json")
+    full = _hunt(
+        space=SPACE, seed=3, generations=3, objective="ic",
+        minimize=True, minimize_max=1, checkpoint_path=ck,
+    )
+    assert full["stats"]["checkpoints"] == 3
+    resumed = _hunt(
+        resume=str(tmp_path / "hunt_g1.json"), generations=3,
+        minimize=True, minimize_max=1,
+    )
+    # The resumed hunt's findings, elites and final state are
+    # bit-identical to the uninterrupted run's — and it joined the
+    # same flight ledger (run_id inherited from the checkpoint).
+    assert resumed["found"] == full["found"]
+    assert resumed["elites"] == full["elites"]
+    assert resumed["minimized"] == full["minimized"]
+    assert resumed["state"] == full["state"]
+    assert resumed["stats"]["run_id"] == full["stats"]["run_id"]
+    # A conflicting space is refused loudly.
+    other = SearchSpace(rounds=4, capacity=6, population=4)
+    with pytest.raises(ScenarioError, match="different search space"):
+        _hunt(
+            space=other, resume=str(tmp_path / "hunt_g1.json"),
+            generations=3,
+        )
+    # A completed hunt's checkpoint needs a larger generations=.
+    with pytest.raises(ScenarioError, match="outside hunt"):
+        _hunt(resume=str(tmp_path / "hunt_g3.json"), generations=3)
+
+
+def test_hunt_eager_validation():
+    with pytest.raises(ScenarioError, match="generations"):
+        _hunt(space=SPACE, generations=0)
+    with pytest.raises(ScenarioError, match="checkpoint_path"):
+        _hunt(space=SPACE, checkpoint_every=2)
+    with pytest.raises(ScenarioError, match="needs a search space"):
+        _hunt()
+    with pytest.raises(ScenarioError, match="unknown search objective"):
+        _hunt(space=SPACE, objective="win")
+    # Population/shard divisibility fails BEFORE any evaluation.
+    import jax
+
+    with pytest.raises(ScenarioError, match="does not divide"):
+        _hunt(
+            space=SearchSpace(rounds=4, capacity=6, population=5),
+            mesh=jax.devices()[:2],
+        )
+
+
+def test_search_depth_k_no_blocking_with_harness_live(monkeypatch):
+    # The dispatch-count proof, re-run with the search harness live:
+    # one population evaluation keeps depth+1 dispatches in flight and
+    # never calls block_until_ready — phases observed through the
+    # engine's execution seam.
+    import jax
+
+    from ba_tpu.search.loop import (
+        candidate_keys,
+        evaluate_population,
+        population_state,
+    )
+
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the search")
+
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    events = []
+
+    def seam(call, phase, d, lo, hi):
+        events.append((phase, d))
+        return call()
+
+    rounds, depth = 7, 3
+    space = SearchSpace(
+        rounds=rounds, capacity=SPACE.capacity, population=8,
+        events_min=2, events_max=5,
+    )
+    pop = sample_population(space, seed=3)
+    block = lower_population(pop, space.capacity, rounds)
+    evaluate_population(
+        candidate_keys(3, list(range(8))),
+        population_state(8, space.capacity, space.order),
+        block,
+        rounds=rounds, depth=depth, rounds_per_dispatch=1,
+        exec_seam=seam,
+    )
+    dispatches = [d for p, d in events if p == "dispatch"]
+    retires = [d for p, d in events if p == "retire"]
+    assert dispatches == list(range(rounds))
+    assert retires == list(range(rounds))
+    first_retire = events.index(("retire", 0))
+    assert events[:first_retire] == [
+        ("dispatch", i) for i in range(depth + 1)
+    ]
+    for r in range(rounds - depth):
+        assert events.index(("retire", r)) > events.index(
+            ("dispatch", r + depth)
+        )
+
+
+def test_mesh_sharded_hunt_bit_exact(eight_devices):
+    # Per-shard populations (mesh=): shard assignment is layout only —
+    # per-slot keys make every candidate's stream placement-free, so a
+    # 2-device hunt is bit-exact with the single-device hunt.
+    from ba_tpu.parallel import make_mesh
+
+    plain = _hunt(
+        space=SPACE, seed=3, generations=2, objective="ic",
+        minimize=False,
+    )
+    sharded = _hunt(
+        space=SPACE, seed=3, generations=2, objective="ic",
+        minimize=False, mesh=make_mesh((2, 1), ("data", "node")),
+    )
+    assert sharded["found"] == plain["found"]
+    assert sharded["elites"] == plain["elites"]
+    assert sharded["state"] == plain["state"]
+    assert sharded["stats"]["shards"] == 2
+
+
+def test_committed_reproducers_replay_bit_exact():
+    # Satellite pin: the COMMITTED examples/scenarios/found corpus —
+    # the specs the search engine discovered — replays its provenance
+    # counters bit-for-bit from (seed, uid, capacity) alone, and every
+    # spec still violates its recorded objective.
+    from ba_tpu.search.corpus import load_corpus
+    from ba_tpu.search.loop import evaluate_alone
+
+    specs = load_corpus(os.path.join(REPO, "examples", "scenarios", "found"))
+    assert len(specs) >= 2
+    for spec in specs:
+        pr = spec.provenance["search"]
+        alone = evaluate_alone(
+            spec, seed=pr["seed"], uid=pr["uid"], capacity=pr["capacity"]
+        )
+        got = counters_dict(alone["counters"], alone["counter_names"])
+        assert got == pr["counters"], spec.name
+        assert violation_rows(
+            np.asarray(alone["counters"])[None, :],
+            alone["counter_names"], pr["objective"],
+        )[0], spec.name
+
+
+def test_cluster_run_search_and_repl_smoke():
+    from ba_tpu.runtime.backends import JaxBackend, PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    cluster = Cluster(4, JaxBackend(), seed=0)
+    res = cluster.run_search(
+        space=SPACE, generations=1, objective="ic", minimize=False,
+    )
+    assert res is not None
+    assert res["stats"]["campaigns"] == SPACE.population
+    # The roster is untouched: the hunt runs from the canonical state.
+    assert len(cluster.generals) == 4
+    # REPL surface: output lines + one-line errors, no tracebacks.
+    lines = []
+    handle_command(
+        cluster, "search gens=1 objective=quorum", lines.append
+    )
+    assert any(line.startswith("Search:") for line in lines)
+    assert any(line.startswith("Search found:") for line in lines)
+    errs = []
+    handle_command(cluster, "search gens=zero", errs.append)
+    assert errs and errs[0].startswith("search error:")
+    errs2 = []
+    handle_command(cluster, "search objective=win", errs2.append)
+    assert errs2 and "unknown search objective" in errs2[0]
+    # Incapable backends stay silent, like scenario.
+    quiet = []
+    py_cluster = Cluster(4, PyBackend(), seed=0)
+    handle_command(py_cluster, "search gens=1", quiet.append)
+    assert quiet == []
