@@ -38,8 +38,13 @@ from ba_tpu.analysis.base import Rule, register
 # search loop (search/loop.py): its generation loop drives the
 # coalesced engine's dispatch stream, and a host sync there would
 # serialize population evaluation exactly like one in the engine.
+# ISSUE 16 added the host-crypto pool (crypto/pool.py): SignAheadLane
+# calls it from the engine's overlap slot, so a device sync there
+# blocks the dispatch loop exactly like one in the lane — and the
+# module is jax-free by contract anyway, so ANY jax touch is a bug.
 HOT_TREES = (
     "ba_tpu.parallel.", "ba_tpu.ops.scenario_step", "ba_tpu.search.loop",
+    "ba_tpu.crypto.pool",
 )
 # The round-loop modules: the ones whose steady-state statements run
 # once per round / per dispatch.  ISSUE 8 added the mesh scan core

@@ -10,20 +10,41 @@ them to SM(m) *signed* messages with batched Ed25519.  Layers:
 - ``ed25519`` — batched verification, one jittable program.
 - ``signed``  — the SM(m) bridge: host-sign round-1 orders, device-verify
   the batch, feed the validity mask into the relay rounds.
+- ``pool``    — host-tier signing/verify worker pool + signature-table
+  cache (ISSUE 16): jax-free BY CONTRACT, so pool worker processes never
+  pay a jax import.
+
+The package import is LAZY (PEP 562): ``ed25519``/``sha512``/``field``
+pull jax at module import, and the host tier (``ba_tpu.crypto.pool``
+workers, the serving front-end's plan construction) must be able to
+``import ba_tpu.crypto.pool`` without paying — or even having — jax.
+Attribute access resolves submodules and the re-exported names on first
+touch; ``from ba_tpu.crypto import signed`` works as before.
 """
 
-from ba_tpu.crypto import field, oracle, sha512, signed
-from ba_tpu.crypto.ed25519 import compress, decompress, verify
-from ba_tpu.crypto.signed import signed_sm_agreement, verify_received
+import importlib
 
-__all__ = [
-    "field",
-    "oracle",
-    "sha512",
-    "signed",
-    "compress",
-    "decompress",
-    "verify",
-    "signed_sm_agreement",
-    "verify_received",
-]
+_SUBMODULES = ("ed25519", "field", "oracle", "pool", "sha512", "signed")
+# name -> (submodule, attr) for the re-exported convenience names.
+_REEXPORTS = {
+    "compress": ("ed25519", "compress"),
+    "decompress": ("ed25519", "decompress"),
+    "verify": ("ed25519", "verify"),
+    "signed_sm_agreement": ("signed", "signed_sm_agreement"),
+    "verify_received": ("signed", "verify_received"),
+}
+
+__all__ = list(_SUBMODULES) + list(_REEXPORTS)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"{__name__}.{name}")
+    if name in _REEXPORTS:
+        mod, attr = _REEXPORTS[name]
+        return getattr(importlib.import_module(f"{__name__}.{mod}"), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

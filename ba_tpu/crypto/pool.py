@@ -1,0 +1,564 @@
+"""Host-crypto pool + signature-table cache: the ISSUE 16 tentpole.
+
+PR 14's sign-ahead lane moved signing/verify off the signed megastep's
+critical path, but ONE host core still did all the work —
+``BENCH_signed_r14.json``'s sweep leg reads 0.998x because the lane's
+overlap slot saturates at ~11k verifies/s/core.  This module breaks
+that wall twice over:
+
+- :class:`SignPool` — N worker PROCESSES (subprocess + length-prefixed
+  pickle pipes, not ``multiprocessing`` — no ``__main__`` re-import
+  hazard under pytest, full lifecycle control) that shard
+  ``sign_round_tables`` / ``verify_host_exact`` work.  Sharding is
+  DETERMINISTIC and output-invariant: work splits into contiguous
+  index ranges, results reassemble BY INDEX, and every unit's bytes
+  depend only on its own inputs (Ed25519 is deterministic), so worker
+  count, shard order and completion order can never affect a single
+  output byte.  A dead worker (broken pipe, EOF, timeout) degrades
+  that shard to the in-process path, is counted
+  (:attr:`SignPool.degraded`), and never wedges a dispatch.
+- :class:`SigTableCache` — a bounded, bytes-keyed LRU over per-round
+  signature tables AND their host verdict planes.  Deterministic
+  Ed25519 over round-bound messages means identical
+  ``(key-set, instance, round, value)`` claims re-sign identical bytes
+  across cohorts and repeated campaigns: a warm hit skips sign AND
+  verify, bit-exactly, which is where repeat signed serving traffic
+  stops paying host crypto at all.
+
+jax-free BY CONTRACT: workers import exactly this module (plus
+``ba_tpu.crypto.signed``'s host tier), so a pool never pays — or even
+needs — a jax install.  ``tests/test_sign_pool.py`` pins the import
+with a subprocess.
+
+Env dials:
+
+- ``BA_TPU_SIGN_POOL`` — worker count.  Unset/``auto`` derives from
+  ``os.cpu_count() - 1`` (capped at 8); ``0`` keeps the in-process
+  path (and is what a 1-core host derives).
+- ``BA_TPU_SIGN_CACHE`` — cache capacity in round-table entries
+  (default 256); ``0`` disables.
+- ``BA_TPU_SIGN_CACHE_BYTES`` — cache byte budget (default 128 MiB);
+  the LRU evicts on whichever bound trips first.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import pickle
+import struct
+import subprocess
+import sys
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+_LEN = struct.Struct("<Q")
+
+# Generous by design: the timeout exists to keep a HUNG worker from
+# wedging a dispatch forever, not to police slow shards — a worker that
+# trips it is killed and its shard re-runs in-process.
+_DEFAULT_TIMEOUT_S = 120.0
+
+
+def pool_size_from_env() -> int:
+    """Worker count from ``BA_TPU_SIGN_POOL``: explicit int, or the
+    ``os.cpu_count()``-derived default (cores minus the one the lane
+    itself occupies, capped at 8 — more workers than cores only adds
+    scheduler churn).  ``0`` keeps the in-process path."""
+    env = os.environ.get("BA_TPU_SIGN_POOL", "").strip().lower()
+    if env in ("", "auto"):
+        return max(0, min(8, (os.cpu_count() or 1) - 1))
+    n = int(env)
+    if n < 0:
+        raise ValueError(f"BA_TPU_SIGN_POOL must be >= 0, got {env!r}")
+    return n
+
+
+def _send(fh, obj) -> None:
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    fh.write(_LEN.pack(len(blob)))
+    fh.write(blob)
+    fh.flush()
+
+
+def _read_exact(fh, size: int) -> bytes:
+    """Read exactly ``size`` bytes (raw pipes may return short reads)."""
+    buf = io.BytesIO()
+    remaining = size
+    while remaining:
+        chunk = fh.read(min(remaining, 1 << 20))
+        if not chunk:
+            raise EOFError("pool worker closed its pipe mid-frame")
+        buf.write(chunk)
+        remaining -= len(chunk)
+    return buf.getvalue()
+
+
+def _recv(fh):
+    (size,) = _LEN.unpack(_read_exact(fh, _LEN.size))
+    return pickle.loads(_read_exact(fh, size))
+
+
+def _worker_main() -> None:  # pragma: no cover - runs in the workers
+    """Worker process entry: a blocking task loop over stdin/stdout.
+
+    Tasks arrive as length-prefixed pickles; each reply is written
+    before the next task is read (ONE outstanding task per worker —
+    the pipe-deadlock-free discipline the parent enforces too).  Keys
+    derive worker-side from the (seed, batch) identity — deterministic
+    ``commander_keys``, so no key material crosses the pipe — and are
+    cached per key-set for the worker's lifetime.
+    """
+    from ba_tpu.crypto import signed as _signed
+
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    keysets: dict = {}
+
+    def keys_for(seed: int, batch: int, n_values: int):
+        ident = (seed, batch, n_values)
+        if ident not in keysets:
+            sks, pks = _signed.commander_keys(batch, seed)
+            keysets[ident] = (pks,) + _signed.key_table_arrays(
+                sks, pks, n_values
+            )
+        return keysets[ident]
+
+    while True:
+        try:
+            task = _recv(stdin)
+        except EOFError:
+            return
+        kind = task[0]
+        if kind == "exit":
+            return
+        try:
+            if kind == "sign":
+                _, seed, batch, n_values, base, rounds = task
+                pks, sk_rep, pk_rep = keys_for(seed, batch, n_values)
+                sigs = np.empty(
+                    (len(rounds), batch, n_values, 64), np.uint8
+                )
+                for i, r in enumerate(rounds):
+                    msgs = _signed._round_table_msgs(
+                        batch, r, n_values, base
+                    )
+                    sigs[i] = _signed.sign_table_msgs_arrays(
+                        sk_rep, pk_rep, msgs
+                    )
+                reply = ("ok", sigs)
+            elif kind == "verify":
+                _, pks, msgs, sigs = task
+                reply = ("ok", _signed.verify_host_exact(pks, msgs, sigs))
+            else:
+                reply = ("err", f"unknown task kind {kind!r}")
+        except Exception as exc:  # noqa: BLE001 - worker must answer
+            reply = ("err", f"{type(exc).__name__}: {exc}")
+        _send(stdout, reply)
+
+
+class _Worker:
+    __slots__ = ("proc", "alive")
+
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.alive = True
+
+
+class SignPool:
+    """N signing/verify worker processes with deterministic sharding.
+
+    The degradation ladder (never wedge, never change bytes):
+
+    1. healthy worker — shard runs in its process;
+    2. dead/hung worker (broken pipe, EOF, reply timeout, ``err``
+       reply) — the worker is killed and retired, :attr:`degraded`
+       counts the event, and the shard re-runs IN-PROCESS via the same
+       jax-free bodies the worker would have called;
+    3. every worker dead — the pool behaves as the in-process path
+       (workers == 0) for the rest of its life.
+
+    Because sign/verify are per-row deterministic and shards reassemble
+    by index, every rung produces identical bytes.
+    """
+
+    def __init__(
+        self, workers: int | None = None, *, timeout_s: float | None = None
+    ):
+        if workers is None:
+            workers = pool_size_from_env()
+        if workers < 0:
+            raise ValueError(f"workers={workers} must be >= 0")
+        self.requested = workers
+        self.degraded = 0
+        self.pool_s = 0.0
+        self.shards = 0
+        self._lock = threading.Lock()
+        self._timeout_s = (
+            float(os.environ.get("BA_TPU_SIGN_POOL_TIMEOUT_S", "0"))
+            or _DEFAULT_TIMEOUT_S
+            if timeout_s is None
+            else timeout_s
+        )
+        self._workers: list[_Worker] = []
+        for _ in range(workers):
+            self._workers.append(_Worker(self._spawn()))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _spawn(self) -> subprocess.Popen:
+        env = dict(os.environ)
+        # Workers are computation, not observation: strip the telemetry
+        # sinks so a worker never double-emits into the parent's stream,
+        # and pin the package path so an uninstalled checkout resolves.
+        for k in ("BA_TPU_METRICS", "BA_TPU_TRACE"):
+            env.pop(k, None)
+        import ba_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(ba_tpu.__file__))
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_root
+        )
+        return subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "from ba_tpu.crypto.pool import _worker_main; _worker_main()",
+            ],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            # Unbuffered pipes on the PARENT side: the reply `select`
+            # polls the raw fd, and a buffered reader's read-ahead
+            # would strand a frame in Python-side memory the fd poll
+            # can't see.
+            bufsize=0,
+            env=env,
+        )
+
+    @property
+    def workers(self) -> int:
+        """Live worker count (dead workers retire permanently)."""
+        return sum(1 for w in self._workers if w.alive)
+
+    def close(self) -> None:
+        """Drain: ask every live worker to exit, then reap (kill on
+        timeout).  Idempotent; the pool is in-process-only afterward."""
+        for w in self._workers:
+            if not w.alive:
+                continue
+            try:
+                _send(w.proc.stdin, ("exit",))
+                w.proc.stdin.close()
+            except (BrokenPipeError, OSError, ValueError):
+                pass
+            w.alive = False
+        for w in self._workers:
+            try:
+                w.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                w.proc.wait()
+
+    def __del__(self):  # pragma: no cover - GC-order dependent
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- the deterministic shard round-trip ---------------------------------
+
+    def _kill(self, w: _Worker) -> None:
+        w.alive = False
+        self.degraded += 1
+        try:
+            w.proc.kill()
+        except OSError:
+            pass
+
+    def _round_trip(self, assignments, fallback):
+        """One task per live worker, write-all then read-all (a worker
+        never holds more than one outstanding task, so neither side can
+        block on a full pipe).  ``assignments`` is ``[(worker, task,
+        shard_args)]``; any failure degrades that shard to
+        ``fallback(shard_args)``.  Returns results in assignment
+        order."""
+        t0 = time.perf_counter()
+        sent = []
+        for w, task, shard_args in assignments:
+            ok = False
+            if w is not None and w.alive:
+                try:
+                    _send(w.proc.stdin, task)
+                    ok = True
+                except (BrokenPipeError, OSError, ValueError):
+                    self._kill(w)
+            sent.append((w, ok, shard_args))
+        results = []
+        deadline = time.perf_counter() + self._timeout_s
+        for w, ok, shard_args in sent:
+            reply = None
+            if ok:
+                try:
+                    if hasattr(w.proc.stdout, "fileno"):
+                        import selectors
+
+                        sel = selectors.DefaultSelector()
+                        sel.register(w.proc.stdout, selectors.EVENT_READ)
+                        budget = max(0.0, deadline - time.perf_counter())
+                        if not sel.select(timeout=budget):
+                            raise TimeoutError("pool worker reply timeout")
+                        sel.close()
+                    reply = _recv(w.proc.stdout)
+                except (EOFError, OSError, TimeoutError, ValueError):
+                    self._kill(w)
+                    reply = None
+            if reply is not None and reply[0] == "ok":
+                results.append(reply[1])
+            else:
+                if reply is not None:  # structured worker error
+                    self._kill(w)
+                results.append(fallback(shard_args))
+        with self._lock:
+            self.pool_s += time.perf_counter() - t0
+            self.shards += len(assignments)
+        return results
+
+    def _live(self) -> list[_Worker]:
+        return [w for w in self._workers if w.alive]
+
+    @staticmethod
+    def _split(n: int, parts: int) -> list[tuple[int, int]]:
+        """Contiguous index ranges covering [0, n): shard boundaries
+        depend only on (n, parts), never on scheduling."""
+        parts = max(1, min(parts, n))
+        step, extra = divmod(n, parts)
+        spans, lo = [], 0
+        for i in range(parts):
+            hi = lo + step + (1 if i < extra else 0)
+            spans.append((lo, hi))
+            lo = hi
+        return spans
+
+    def sign_rounds(
+        self,
+        seed: int,
+        batch: int,
+        n_values: int,
+        base: int,
+        rounds: list[int],
+        fallback,
+    ) -> np.ndarray:
+        """Shard ``rounds`` across the workers -> sigs uint8
+        [len(rounds), batch, n_values, 64], reassembled by round index.
+        ``fallback(rounds_slice)`` is the in-process body (degradation
+        rung 2)."""
+        live = self._live()
+        if not rounds:
+            return np.empty((0, batch, n_values, 64), np.uint8)
+        if not live:
+            return fallback(rounds)
+        spans = self._split(len(rounds), len(live))
+        assignments = [
+            (
+                live[i],
+                ("sign", seed, batch, n_values, base, rounds[lo:hi]),
+                rounds[lo:hi],
+            )
+            for i, (lo, hi) in enumerate(spans)
+        ]
+        parts = self._round_trip(
+            assignments, lambda rs: np.asarray(fallback(rs), np.uint8)
+        )
+        return np.concatenate([np.asarray(p, np.uint8) for p in parts])
+
+    def verify_rows(
+        self, pks: np.ndarray, msgs: np.ndarray, sigs: np.ndarray
+    ) -> np.ndarray:
+        """Shard a flattened [N, ...] verify across the workers ->
+        bool [N, n] verdicts, reassembled by row index.  Degraded
+        shards re-verify in-process via the same host body."""
+        from ba_tpu.crypto.signed import verify_host_exact
+
+        pks = np.ascontiguousarray(pks, np.uint8)
+        msgs = np.ascontiguousarray(msgs, np.uint8)
+        sigs = np.ascontiguousarray(sigs, np.uint8)
+        live = self._live()
+        if not live:
+            return verify_host_exact(pks, msgs, sigs)
+        spans = self._split(msgs.shape[0], len(live))
+        assignments = [
+            (
+                live[i],
+                ("verify", pks[lo:hi], msgs[lo:hi], sigs[lo:hi]),
+                (lo, hi),
+            )
+            for i, (lo, hi) in enumerate(spans)
+        ]
+        parts = self._round_trip(
+            assignments,
+            lambda span: verify_host_exact(
+                pks[span[0] : span[1]],
+                msgs[span[0] : span[1]],
+                sigs[span[0] : span[1]],
+            ),
+        )
+        return np.concatenate([np.asarray(p, np.bool_) for p in parts])
+
+
+class SigTableCache:
+    """Bounded bytes-keyed LRU over per-round signature tables.
+
+    One entry = one round's ``(sigs [B, V, 64], host verdicts [B, V]
+    or None)`` under a key hashed over the PUBLIC inputs that determine
+    them — the key-set's pk table and the round's message table bytes
+    (which bind instance base, round index and values).  Ed25519
+    determinism is the correctness argument: same pks + same message
+    bytes re-sign to the same signature bytes and re-verify to the same
+    verdicts, so a hit is bit-identical to a recompute by construction.
+
+    Verdict planes are cached only when they were derived ON HOST
+    (native verify route / pool) — a device-verify platform caches
+    signatures alone and ``ok=None`` tells the lane to still dispatch
+    its verify.
+
+    Double-bounded: ``max_entries`` entries AND ``max_bytes`` of table
+    payload, LRU-evicted on whichever trips first.  Thread-safe (the
+    serving front-end's dispatcher and a campaign thread may share the
+    process default).
+    """
+
+    def __init__(self, max_entries: int = 256, max_bytes: int = 128 << 20):
+        if max_entries < 1:
+            raise ValueError(f"max_entries={max_entries} must be >= 1")
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.nbytes = 0
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+
+    @staticmethod
+    def round_key(pks: np.ndarray, msgs: np.ndarray) -> bytes:
+        """The cache key grammar: sha256 over ``pks`` bytes || ``msgs``
+        bytes (shapes ride along to split any theoretical concat
+        ambiguity).  Everything that determines the output is in the
+        hash; nothing else is."""
+        h = hashlib.sha256()
+        h.update(repr(pks.shape).encode())
+        h.update(np.ascontiguousarray(pks).tobytes())
+        h.update(repr(msgs.shape).encode())
+        h.update(np.ascontiguousarray(msgs).tobytes())
+        return h.digest()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: bytes):
+        """-> (sigs, ok_or_None) or None; a hit refreshes LRU order."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry
+
+    def put(self, key: bytes, sigs: np.ndarray, ok: np.ndarray | None):
+        with self._lock:
+            if key in self._entries:
+                old = self._entries.pop(key)
+                self.nbytes -= old[0].nbytes + (
+                    old[1].nbytes if old[1] is not None else 0
+                )
+            self._entries[key] = (sigs, ok)
+            self.nbytes += sigs.nbytes + (ok.nbytes if ok is not None else 0)
+            while self._entries and (
+                len(self._entries) > self.max_entries
+                or self.nbytes > self.max_bytes
+            ):
+                _, (esigs, eok) = self._entries.popitem(last=False)
+                self.nbytes -= esigs.nbytes + (
+                    eok.nbytes if eok is not None else 0
+                )
+                self.evictions += 1
+
+
+# -- process defaults (lifecycle owned by the serving front-end) ------------
+
+_default_pool: SignPool | None = None
+_default_pool_made = False
+_default_cache: SigTableCache | None = None
+_default_cache_made = False
+_defaults_lock = threading.Lock()
+
+
+def default_pool() -> SignPool | None:
+    """The process-wide pool per ``BA_TPU_SIGN_POOL`` (None when the
+    env derives 0 workers — the in-process path).  Lazily created on
+    first use; ``AgreementService.open()`` creates it eagerly and
+    ``stop()`` drains it (the service owns the lifecycle)."""
+    global _default_pool, _default_pool_made
+    with _defaults_lock:
+        if not _default_pool_made:
+            n = pool_size_from_env()
+            _default_pool = SignPool(n) if n else None
+            _default_pool_made = True
+        return _default_pool
+
+
+def default_cache() -> SigTableCache | None:
+    """The process-wide signature-table cache per ``BA_TPU_SIGN_CACHE``
+    (None when disabled with ``=0``)."""
+    global _default_cache, _default_cache_made
+    with _defaults_lock:
+        if not _default_cache_made:
+            env = os.environ.get("BA_TPU_SIGN_CACHE", "").strip().lower()
+            cap = 256 if env in ("", "auto") else int(env)
+            if cap < 0:
+                raise ValueError(
+                    f"BA_TPU_SIGN_CACHE must be >= 0, got {env!r}"
+                )
+            max_bytes = int(
+                os.environ.get("BA_TPU_SIGN_CACHE_BYTES", str(128 << 20))
+            )
+            _default_cache = (
+                SigTableCache(cap, max_bytes) if cap else None
+            )
+            _default_cache_made = True
+        return _default_cache
+
+
+def close_default_pool() -> None:
+    """Drain just the default pool (the cache keeps its warm entries)
+    — ``AgreementService.stop()``'s half of the lifecycle it owns.  A
+    later ``default_pool()`` re-derives from the env."""
+    global _default_pool, _default_pool_made
+    with _defaults_lock:
+        if _default_pool is not None:
+            _default_pool.close()
+        _default_pool = None
+        _default_pool_made = False
+
+
+def shutdown_defaults() -> None:
+    """Drain the default pool and drop both defaults (they re-derive
+    from the env on next use) — the service's ``stop()`` hook, and the
+    reset seam tests/bench legs use between env reconfigurations."""
+    global _default_pool, _default_pool_made
+    global _default_cache, _default_cache_made
+    with _defaults_lock:
+        if _default_pool is not None:
+            _default_pool.close()
+        _default_pool = None
+        _default_pool_made = False
+        _default_cache = None
+        _default_cache_made = False

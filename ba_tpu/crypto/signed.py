@@ -137,40 +137,75 @@ def sign_value_tables(
     return msgs, _sign_table_msgs(sks, pks, msgs)
 
 
+def key_table_arrays(
+    sks: list[bytes], pks: np.ndarray, n_values: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """The per-signature-row key arrays ``sign_table_msgs_arrays`` wants
+    — sk/pk uint8 [B*V, 32], each key repeated once per value column.
+
+    These are INVARIANT for a fixed key-set: ``SignAheadLane`` hoists
+    them to construction (ISSUE 16 small fix) instead of re-deriving
+    them inside every window's signing call, where the np.frombuffer
+    stack over B secret keys was a measurable per-round host cost.
+    """
+    sk_arr = np.stack([np.frombuffer(s, np.uint8) for s in sks])
+    return (
+        np.repeat(sk_arr, n_values, axis=0),
+        np.repeat(np.asarray(pks, np.uint8), n_values, axis=0),
+    )
+
+
+def sign_table_msgs_arrays(
+    sk_rep: np.ndarray, pk_rep: np.ndarray, msgs: np.ndarray
+) -> np.ndarray:
+    """Host-sign a [N, V, MSG_LEN] message table with PRECOMPUTED key
+    arrays (``key_table_arrays``, possibly np.tile'd over a window of
+    rounds) -> sigs uint8 [N, V, 64].
+
+    jax-free BY CONTRACT: this is the signing body pool worker
+    processes call (``ba_tpu.crypto.pool``), so it must never touch the
+    device tier.  Native C++ batch path when available, per-call signer
+    otherwise; Ed25519 determinism makes both byte-identical.
+    """
+    N, n_values = msgs.shape[:2]
+    with obs.timed_span("host_sign", "host_sign_s", batch=N, values=n_values):
+        nat = _native_or_none()
+        if nat is not None:
+            sigs = nat.sign_batch(
+                sk_rep, pk_rep, msgs.reshape(N * n_values, MSG_LEN)
+            ).reshape(N, n_values, 64)
+        else:
+            sigs = np.zeros((N, n_values, 64), np.uint8)
+            flat_sk = sk_rep.reshape(N * n_values, 32)
+            flat_pk = pk_rep.reshape(N * n_values, 32)
+            for i in range(N):
+                for v in range(n_values):
+                    row = i * n_values + v
+                    sigs[i, v] = np.frombuffer(
+                        host_sign(
+                            flat_sk[row].tobytes(),
+                            flat_pk[row].tobytes(),
+                            msgs[i, v].tobytes(),
+                        ),
+                        np.uint8,
+                    )
+    obs.default_registry().counter("host_signs_total").inc(N * n_values)
+    return sigs
+
+
 def _sign_table_msgs(sks: list[bytes], pks: np.ndarray, msgs: np.ndarray) -> np.ndarray:
     """Host-sign a [B, V, MSG_LEN] message table -> sigs uint8 [B, V, 64].
 
     The one signing body behind :func:`sign_value_tables` and the
     round-bound :func:`sign_round_tables` (sign-ahead lane, ISSUE 14):
-    native C++ batch path when available, per-call signer otherwise.
-    Host signing is exactly the lane the pipelined engine's host_work
-    hook overlaps with device compute, so it is span-traced +
-    histogrammed: the trace shows whether signing fits inside the
-    device window or spills past it.
+    builds the repeated key arrays per call and delegates to
+    :func:`sign_table_msgs_arrays` — callers with an invariant key-set
+    (the lane) hoist :func:`key_table_arrays` and call the arrays body
+    directly (ISSUE 16).
     """
-    B, n_values = msgs.shape[:2]
-    with obs.timed_span("host_sign", "host_sign_s", batch=B, values=n_values):
-        nat = _native_or_none()
-        if nat is not None:
-            sk_arr = np.repeat(
-                np.stack([np.frombuffer(s, np.uint8) for s in sks]),
-                n_values,
-                axis=0,
-            )
-            pk_arr = np.repeat(np.asarray(pks, np.uint8), n_values, axis=0)
-            sigs = nat.sign_batch(
-                sk_arr, pk_arr, msgs.reshape(B * n_values, MSG_LEN)
-            ).reshape(B, n_values, 64)
-        else:
-            sigs = np.zeros((B, n_values, 64), np.uint8)
-            for b, sk in enumerate(sks):
-                pk = pks[b].tobytes()
-                for v in range(n_values):
-                    sigs[b, v] = np.frombuffer(
-                        host_sign(sk, pk, msgs[b, v].tobytes()), np.uint8
-                    )
-    obs.default_registry().counter("host_signs_total").inc(B * n_values)
-    return sigs
+    n_values = msgs.shape[1]
+    sk_rep, pk_rep = key_table_arrays(sks, pks, n_values)
+    return sign_table_msgs_arrays(sk_rep, pk_rep, msgs)
 
 
 def round_message(instance: int, round_index: int, value: int) -> bytes:
@@ -432,6 +467,69 @@ def _verify_received_exact(pks, msgs, sigs):
         for o in range(0, total + pad, chunk)
     ]
     return jnp.concatenate(oks)[:total].reshape(B, n)
+
+
+def host_verify_route() -> bool:
+    """True when :func:`_verify_received_exact` would route this
+    process's verifies through the HOST (native C++ batch verifier)
+    rather than a device dispatch — the condition under which the
+    sign-ahead lane may keep verdicts in host numpy (and hence cache /
+    pool-shard them, ISSUE 16) without changing a single code path's
+    bytes.  Imports jax for the platform probe, so this is lane-side
+    only; pool workers never call it.
+    """
+    mode = os.environ.get("BA_TPU_VERIFY_NATIVE", "auto")
+    if mode == "1":
+        return True
+    if mode != "auto":
+        return False
+    import jax
+
+    return (
+        jax.devices()[0].platform == "cpu" and _native_or_none() is not None
+    )
+
+
+def verify_host_exact(pks, msgs, sigs) -> np.ndarray:
+    """Exact per-signature verification ON HOST -> bool [B, n] numpy.
+
+    jax-free BY CONTRACT: the verify body pool worker processes call
+    (``ba_tpu.crypto.pool``), and the lane's own CPU leg at coalesced
+    sizes.  Byte-identical verdicts to ``_verify_received_exact``'s
+    native branch (it IS that branch, minus the device wrap); the
+    per-call ``cryptography``/oracle ladder is the no-compiler
+    fallback, verdict-identical by RFC 8032 (tests pin it).
+    """
+    pks_np = np.asarray(pks, np.uint8)
+    msgs_np = np.asarray(msgs, np.uint8)
+    sigs_np = np.asarray(sigs, np.uint8)
+    B, n = msgs_np.shape[:2]
+    nat = _native_or_none()
+    if nat is not None:
+        pk_bn = np.repeat(pks_np, n, axis=0)
+        return nat.verify_batch(
+            pk_bn, msgs_np.reshape(B * n, -1), sigs_np.reshape(B * n, 64)
+        ).reshape(B, n)
+    ok = np.zeros((B, n), np.bool_)
+    for b in range(B):
+        pk = pks_np[b].tobytes()
+        for i in range(n):
+            msg = msgs_np[b, i].tobytes()
+            sig = sigs_np[b, i].tobytes()
+            if _HAVE_NATIVE:
+                from cryptography.exceptions import InvalidSignature
+                from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                    Ed25519PublicKey,
+                )
+
+                try:
+                    Ed25519PublicKey.from_public_bytes(pk).verify(sig, msg)
+                    ok[b, i] = True
+                except (InvalidSignature, ValueError):
+                    ok[b, i] = False
+            else:
+                ok[b, i] = oracle.verify(pk, msg, sig)
+    return ok
 
 
 def fresh_rlc_coeffs(total: int) -> np.ndarray:

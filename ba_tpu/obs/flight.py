@@ -79,6 +79,10 @@ RUN_SCOPED_EVENTS = frozenset(
         "search_found",
         "search_minimized",
         "search_checkpoint",
+        # The host-crypto pool family (ISSUE 16): the sign-ahead lane
+        # stamps an explicit id (active scope, else its own derived
+        # key-set identity), so the record always carries one.
+        "sign_pool",
     }
 )
 

@@ -44,10 +44,16 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from ba_tpu import obs
+from ba_tpu.crypto import pool as _pool_mod
 from ba_tpu.crypto.signed import (
+    _round_table_msgs,
     _verify_received_exact,
     commander_keys,
+    host_verify_route,
+    key_table_arrays,
     sign_round_tables,
+    sign_table_msgs_arrays,
+    verify_host_exact,
 )
 from ba_tpu.core.types import ATTACK, RETREAT, UNDEFINED
 from ba_tpu.utils import metrics as _metrics
@@ -72,7 +78,14 @@ class SignAheadLane:
     live.
     """
 
-    def __init__(self, batch: int, seed: int = 0, n_values: int = 2):
+    def __init__(
+        self,
+        batch: int,
+        seed: int = 0,
+        n_values: int = 2,
+        pool: _pool_mod.SignPool | None = None,
+        cache: _pool_mod.SigTableCache | None = None,
+    ):
         if batch < 1:
             raise ValueError(f"batch={batch} must be >= 1")
         if n_values < 1:
@@ -82,9 +95,48 @@ class SignAheadLane:
         self.n_values = n_values
         with obs.span("sign_ahead_keys", batch=batch):
             self.sks, self.pks = commander_keys(batch, seed)
+        # ISSUE 16 small fix: the per-signature-row key arrays are
+        # INVARIANT for the lane's key-set — hoisted here once instead
+        # of re-stacked from the sk byte strings inside every window's
+        # signing call (pinned no-behavior-change by
+        # tests/test_sign_pool.py).
+        self._sk_rep, self._pk_rep = key_table_arrays(
+            self.sks, self.pks, n_values
+        )
+        # ``pool``/``cache``: an explicit object wins; None takes the
+        # process default (``BA_TPU_SIGN_POOL`` / ``BA_TPU_SIGN_CACHE``
+        # — the serving front-end owns the default pool's lifecycle);
+        # 0/False forces the in-process, uncached path.  (isinstance,
+        # not truthiness: an EMPTY SigTableCache is len()-falsy.)
+        if pool is None:
+            self.pool = _pool_mod.default_pool()
+        else:
+            self.pool = pool if isinstance(pool, _pool_mod.SignPool) else None
+        if cache is None:
+            self.cache = _pool_mod.default_cache()
+        else:
+            self.cache = (
+                cache if isinstance(cache, _pool_mod.SigTableCache) else None
+            )
         self.sign_ahead_s = 0.0
         self.windows = 0
         self.rounds_signed = 0
+        # ISSUE 16 accounting: per-lane splits the engine's stats and
+        # the sign_pool record family read.
+        self.sign_s = 0.0
+        self.verify_s = 0.0
+        self.pool_s = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.sigs_signed = 0
+        self.sigs_verified = 0
+        self._run_id = obs.flight.derive_run_id(
+            "sign-pool", seed, batch, n_values
+        )
+
+    @property
+    def pool_workers(self) -> int:
+        return self.pool.workers if self.pool is not None else 0
 
     def round_tables(self, round_index: int):
         """One round's (msgs, sigs) tables — host numpy, the unit the
@@ -97,48 +149,226 @@ class SignAheadLane:
     def stage(self, lo: int, hi: int):
         """Sign + dispatch-verify rounds ``[lo, hi)`` -> device bool
         ``[hi-lo, B, V]`` verdict planes.  Never fetches."""
-        if not 0 <= lo < hi:
-            raise ValueError(f"bad sign-ahead window [{lo}, {hi})")
-        t0 = time.perf_counter()
-        nr = hi - lo
-        parts = [self.round_tables(r) for r in range(lo, hi)]
-        msgs = np.concatenate([m for m, _ in parts])  # [nr*B, V, LEN]
-        sigs = np.concatenate([s for _, s in parts])
-        pks_w = np.tile(self.pks, (nr, 1))
-        # The EXACT per-signature verifier, deliberately sidestepping
-        # the BA_TPU_VERIFY_RLC knob: the RLC wrapper's accept/fallback
-        # decision is a BLOCKING fetch (it would serialize this lane
-        # against the in-flight dispatches it exists to overlap), and
-        # its cofactored verdict is batch-dependent — per-round table
-        # verdicts feed the sig_rejections counter, so they must be
-        # per-signature semantics whatever window they were batched in.
-        # The exact path dispatches the chunked device program (or the
-        # native batch verifier on CPU backends) and returns WITHOUT
-        # fetching; the reshape is a lazy device view.
-        ok = _verify_received_exact(pks_w, msgs, sigs).reshape(
-            nr, self.batch, self.n_values
+        return self.stage_windows([(lo, hi)])[0]
+
+    def _sign_inprocess(self, rounds: list[int]) -> np.ndarray:
+        """In-process signing body over the hoisted key arrays: ONE
+        native batch call for the whole coalesced group -> sigs
+        [len(rounds), B, V, 64].  Also the pool's degradation fallback
+        — per-row Ed25519 determinism makes every route byte-equal."""
+        k = len(rounds)
+        msgs = np.concatenate(
+            [
+                _round_table_msgs(self.batch, r, self.n_values, 0)
+                for r in rounds
+            ]
         )
+        return sign_table_msgs_arrays(
+            np.tile(self._sk_rep, (k, 1)),
+            np.tile(self._pk_rep, (k, 1)),
+            msgs,
+        ).reshape(k, self.batch, self.n_values, 64)
+
+    def stage_windows(self, windows):
+        """Sign + verify a GROUP of round windows ``[(lo, hi), ...]`` in
+        one coalesced pass -> one device bool ``[hi-lo, B, V]`` verdict
+        plane per window.  Never fetches.
+
+        The ISSUE 16 tentpole lives here, behind the PR 14 window
+        grammar:
+
+        - **cache** — each round's table is probed in the bytes-keyed
+          LRU first; a hit skips sign AND (host-route) verify,
+          bit-exactly by Ed25519 determinism.
+        - **pool** — cache-miss rounds shard across the worker
+          processes (contiguous round ranges, reassembled by index);
+          verify rows shard the same way.  A dead worker degrades that
+          shard in-process, counted, never wedging.
+        - **amortization** — misses across ALL the group's windows
+          sign in one batch call and verify in ONE coalesced
+          ``verify_host_exact`` / ``_verify_received_exact`` call (the
+          native C++ verifier sees the coalesced size), instead of one
+          call per window.
+
+        Verdicts use the EXACT per-signature verifier, deliberately
+        sidestepping the BA_TPU_VERIFY_RLC knob: the RLC wrapper's
+        accept/fallback decision is a BLOCKING fetch (it would
+        serialize this lane against the in-flight dispatches it exists
+        to overlap), and its cofactored verdict is batch-dependent —
+        per-round table verdicts feed the sig_rejections counter, so
+        they must be per-signature semantics whatever group they were
+        batched in.  On the host route (pool live, or the CPU backend's
+        native verifier) verdicts are host numpy wrapped into device
+        arrays without a sync; on device platforms the chunked verify
+        program dispatches WITHOUT fetching and verdict planes stay
+        lazy device views (the cache then holds signatures only).
+        """
+        if not windows:
+            raise ValueError("stage_windows needs at least one window")
+        for lo, hi in windows:
+            if not 0 <= lo < hi:
+                raise ValueError(f"bad sign-ahead window [{lo}, {hi})")
+        t0 = time.perf_counter()
+        B, V = self.batch, self.n_values
+        rounds = [r for lo, hi in windows for r in range(lo, hi)]
+        msgs_by_r = {
+            r: _round_table_msgs(B, r, V, 0) for r in rounds
+        }
+        # Host-verdict route: the pool verifies on host by contract;
+        # otherwise mirror _verify_received_exact's own routing (native
+        # on CPU backends) so the host-kept verdicts are the SAME bytes
+        # that path would wrap.
+        pool_live = self.pool is not None and self.pool.workers > 0
+        host_route = pool_live or host_verify_route()
+        sigs_by_r: dict = {}
+        ok_by_r: dict = {}
+        keys_by_r: dict = {}
+        hits = misses = 0
+        if self.cache is not None:
+            for r in rounds:
+                key_r = _pool_mod.SigTableCache.round_key(
+                    self.pks, msgs_by_r[r]
+                )
+                keys_by_r[r] = key_r
+                entry = self.cache.get(key_r)
+                if entry is None:
+                    misses += 1
+                else:
+                    sigs_by_r[r], ok_by_r[r] = entry
+                    hits += 1
+        miss_rounds = [r for r in rounds if r not in sigs_by_r]
+        # -- sign (cache misses only) ---------------------------------
+        t_sign = time.perf_counter()
+        pool_s0 = 0.0
+        if miss_rounds:
+            if pool_live:
+                p0 = time.perf_counter()
+                signed_block = self.pool.sign_rounds(
+                    self.seed, B, V, 0, miss_rounds, self._sign_inprocess
+                )
+                pool_s0 += time.perf_counter() - p0
+            else:
+                signed_block = self._sign_inprocess(miss_rounds)
+            for i, r in enumerate(miss_rounds):
+                sigs_by_r[r] = signed_block[i]
+        sign_wall = time.perf_counter() - t_sign
+        # -- verify (coalesced across the whole group) ------------------
+        t_verify = time.perf_counter()
+        need = [r for r in rounds if ok_by_r.get(r) is None]
+        n_verified = len(need) * B * V
+        if host_route:
+            if need:
+                msgs_cat = np.concatenate([msgs_by_r[r] for r in need])
+                sigs_cat = np.concatenate([sigs_by_r[r] for r in need])
+                pks_w = np.tile(self.pks, (len(need), 1))
+                if pool_live:
+                    p0 = time.perf_counter()
+                    ok_cat = self.pool.verify_rows(pks_w, msgs_cat, sigs_cat)
+                    pool_s0 += time.perf_counter() - p0
+                else:
+                    # ONE native C++ batch call at the coalesced size.
+                    ok_cat = verify_host_exact(pks_w, msgs_cat, sigs_cat)
+                ok_cat = np.asarray(ok_cat, np.bool_).reshape(
+                    len(need), B, V
+                )
+                for i, r in enumerate(need):
+                    ok_by_r[r] = ok_cat[i]
+            if self.cache is not None:
+                for r in miss_rounds:
+                    self.cache.put(keys_by_r[r], sigs_by_r[r], ok_by_r[r])
+            planes = [
+                jnp.asarray(
+                    np.stack([ok_by_r[r] for r in range(lo, hi)])
+                )
+                for lo, hi in windows
+            ]
+        else:
+            # Device-verify platform: signatures cache (ok=None rider),
+            # verdicts stay a lazy device view of ONE coalesced chunked
+            # dispatch — no fetch, no host verdict copy.
+            if self.cache is not None:
+                for r in miss_rounds:
+                    self.cache.put(keys_by_r[r], sigs_by_r[r], None)
+            msgs_cat = np.concatenate([msgs_by_r[r] for r in rounds])
+            sigs_cat = np.concatenate([sigs_by_r[r] for r in rounds])
+            pks_w = np.tile(self.pks, (len(rounds), 1))
+            n_verified = len(rounds) * B * V
+            ok_all = _verify_received_exact(
+                pks_w, msgs_cat, sigs_cat
+            ).reshape(len(rounds), B, V)
+            planes, cursor = [], 0
+            for lo, hi in windows:
+                planes.append(ok_all[cursor : cursor + (hi - lo)])
+                cursor += hi - lo
+        verify_wall = time.perf_counter() - t_verify
         wall = time.perf_counter() - t0
+
+        # -- accounting + records --------------------------------------
+        n_rounds = len(rounds)
         self.sign_ahead_s += wall
-        self.windows += 1
-        self.rounds_signed += nr
+        self.rounds_signed += n_rounds
+        self.sign_s += sign_wall
+        self.verify_s += verify_wall
+        self.pool_s += pool_s0
+        self.cache_hits += hits
+        self.cache_misses += misses
+        self.sigs_signed += len(miss_rounds) * B * V
+        self.sigs_verified += n_verified
         reg = obs.default_registry()
-        reg.counter("pipeline_sign_ahead_windows_total").inc()
-        reg.counter("pipeline_sign_ahead_rounds_total").inc(nr)
-        if _metrics.default_sink().enabled:
+        reg.counter("pipeline_sign_ahead_rounds_total").inc(n_rounds)
+        if self.sign_s > 0 and self.sigs_signed:
+            reg.gauge("host_sign_throughput_sigs_per_s").set(
+                round(self.sigs_signed / self.sign_s, 1)
+            )
+        if self.verify_s > 0 and self.sigs_verified:
+            reg.gauge("host_verify_throughput_sigs_per_s").set(
+                round(self.sigs_verified / self.verify_s, 1)
+            )
+        if self.cache is not None:
+            reg.counter("sign_cache_hits_total").inc(hits)
+            reg.counter("sign_cache_misses_total").inc(misses)
+        sink_live = _metrics.default_sink().enabled
+        for lo, hi in windows:
+            nr = hi - lo
+            self.windows += 1
+            reg.counter("pipeline_sign_ahead_windows_total").inc()
+            if sink_live:
+                _metrics.emit(
+                    {
+                        "event": "sign_ahead",
+                        "v": _metrics.SCHEMA_VERSION,
+                        "lo": lo,
+                        "hi": hi,
+                        "batch": B,
+                        "values": V,
+                        # The group's wall, attributed by round share
+                        # (the group is ONE coalesced pass; per-window
+                        # walls no longer exist as measurements).
+                        "wall_s": round(wall * nr / n_rounds, 6),
+                        # msgs (MSG_LEN) + sigs (64) per table cell —
+                        # same arithmetic the pre-coalescing stage()
+                        # read off its window's concatenated arrays.
+                        "table_bytes": int(nr * B * V * (16 + 64)),
+                    }
+                )
+        if sink_live and self.pool is not None:
             _metrics.emit(
                 {
-                    "event": "sign_ahead",
+                    "event": "sign_pool",
                     "v": _metrics.SCHEMA_VERSION,
-                    "lo": lo,
-                    "hi": hi,
-                    "batch": self.batch,
-                    "values": self.n_values,
-                    "wall_s": round(wall, 6),
-                    "table_bytes": int(msgs.nbytes + sigs.nbytes),
+                    "run_id": _metrics.active_run_id() or self._run_id,
+                    "workers": self.pool.workers,
+                    "requested": self.pool.requested,
+                    "degraded": self.pool.degraded,
+                    "rounds": n_rounds,
+                    "cache_hits": hits,
+                    "cache_misses": misses,
+                    "sign_s": round(sign_wall, 6),
+                    "verify_s": round(verify_wall, 6),
+                    "pool_s": round(pool_s0, 6),
                 }
             )
-        return ok
+        return planes
 
 
 @functools.partial(jax.jit, static_argnums=2)

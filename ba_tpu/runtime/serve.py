@@ -590,6 +590,16 @@ class AgreementService:
         with self._cond:
             self._open = True
         self._sampler.prime()
+        # Host-crypto pool lifecycle (ISSUE 16): the SERVICE owns the
+        # process-default signing/verify pool — spawn it at open (per
+        # BA_TPU_SIGN_POOL; a 0 derivation is the in-process path and
+        # spawns nothing), drain it at stop.  Jax-free host tier.
+        from ba_tpu.crypto import pool as _sign_pool_mod
+
+        pool = _sign_pool_mod.default_pool()
+        self._reg.gauge("serve_sign_pool_workers").set(
+            pool.workers if pool is not None else 0
+        )
         if self._cfg.warm and self._warmup is None:
             from ba_tpu.runtime import warmup as warmup_mod
 
@@ -602,6 +612,10 @@ class AgreementService:
                 # the thing that sheds live traffic.
                 gate=lambda: self._tier == 0 and not self._wedged,
                 registry=self._reg,
+                # Warm path pre-populates the signature-table cache
+                # (ISSUE 16): signed cohorts after the warm barrier
+                # probe, they don't sign.
+                prime=warmup_mod.sign_cache_primer(self._cfg),
             )
             self._warmup.start()
 
@@ -635,6 +649,12 @@ class AgreementService:
             self._warmup.stop()
         if self._thread is not None:
             self._thread.join(timeout)
+        # The other half of the pool lifecycle the service owns
+        # (ISSUE 16): drain the signing/verify workers.  The signature
+        # cache keeps its warm entries — it is memory, not processes.
+        from ba_tpu.crypto import pool as _sign_pool_mod
+
+        _sign_pool_mod.close_default_pool()
         # Whatever is left (no dispatcher ever ran, or drain=False):
         # fail loudly rather than leaving callers blocked forever.
         leftovers = []

@@ -293,6 +293,39 @@ def service_plan(config) -> list:
     return deduped
 
 
+def sign_cache_primer(config):
+    """The warm path's signature-table cache hook (ISSUE 16): a thunk
+    that stages the service's expected signed round range through a
+    batch-1 ``SignAheadLane`` under the shared sign seed, populating
+    the process-default :class:`ba_tpu.crypto.pool.SigTableCache` with
+    exactly the per-round entries every serving signed cohort
+    (``coalesced_sweep(signed=True)``) will probe.  None when the
+    config doesn't warm signed cohorts, or the cache is disabled —
+    the runner then skips priming entirely.
+
+    Per-ROUND cache granularity makes the hint forgiving: priming
+    rounds ``[0, R)`` warms every request of R or fewer rounds, and a
+    longer request simply misses on its tail rounds.
+    """
+    if not getattr(config, "warm_signed", False):
+        return None
+    rounds = getattr(config, "warm_rounds", None) or getattr(
+        config, "rounds_per_dispatch", 1
+    )
+
+    def prime() -> int:
+        from ba_tpu.crypto import pool as pool_mod
+
+        if pool_mod.default_cache() is None:
+            return 0
+        from ba_tpu.parallel.signing import SignAheadLane
+
+        SignAheadLane(1, seed=0).stage(0, rounds)
+        return rounds
+
+    return prime
+
+
 def health_gate(max_occupancy: float | None = None, registry=None):
     """A standalone warmup gate off the live health view
     (``obs/health.py``): True while the engine's depth-occupancy window
@@ -335,11 +368,17 @@ class WarmupRunner:
         registry=None,
         run_id: str | None = None,
         pause_s: float = 0.02,
+        prime=None,
     ):
         self._cache = cache
         self._plan = list(plan)
         self._gate = gate
         self._pause_s = pause_s
+        # Optional host-side primer (ISSUE 16: the signature-table
+        # cache, see :func:`sign_cache_primer`) run on the runner
+        # thread before the compile plan — same never-raise contract
+        # as a plan signature.
+        self._prime = prime
         self._reg = registry if registry is not None else (
             obs.default_registry()
         )
@@ -412,6 +451,24 @@ class WarmupRunner:
         t0 = time.perf_counter()
         self._emit("start", planned=len(self._plan))
         obs.instant("warmup_start", planned=len(self._plan))
+        if self._prime is not None and not self._stop.is_set():
+            # Pre-populate the signature-table cache (ISSUE 16): the
+            # first signed cohort after the warm barrier then pays
+            # lookups, not host crypto.  Counted as an error on
+            # failure, never raised — the warmup-pass discipline.
+            try:
+                primed = self._prime()
+            except Exception as e:
+                self.errors += 1
+                self._errors_c.inc()
+                self._emit(
+                    "signature", fn="sign_cache_prime", status="error",
+                    error=f"{type(e).__name__}: {e}",
+                )
+            else:
+                self._reg.gauge("serve_warmup_sign_cache_rounds").set(
+                    int(primed or 0)
+                )
         for fn, axes in self._plan:
             if self._stop.is_set():
                 break

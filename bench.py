@@ -3068,6 +3068,190 @@ def bench_signed_ab(jax, jnp, jr):
     }
 
 
+def bench_signed_throughput(jax, jnp, jr):
+    """ISSUE 16: the host-crypto wall A/B — the sweep-discipline SIGNED
+    pipeline (``pipeline_sweep(signed=True)``) run as five legs that
+    differ ONLY in the sign-ahead lane's host-crypto configuration,
+    every leg bit-exact asserted against the in-process baseline
+    (decisions, histograms, counters — including ``sig_rejections`` /
+    ``commander_equivocations``) before any timing is believed:
+
+    1. ``inproc``   — ``BA_TPU_SIGN_POOL=0 BA_TPU_SIGN_CACHE=0``: the
+       single-core baseline every other leg's speedup is against.
+    2. ``pool1/2/4`` — the subprocess signing/verify pool at 1/2/4
+       workers, cache off (cold crypto every rep).  On a multi-core
+       host these legs scale the ~11k-sigs/s/core Ed25519 wall with
+       worker count; on a 1-core container they pin the sharded path's
+       bit-exactness and report the (honest) pipe overhead.
+    3. ``cache_warm`` — pool off, signature-table cache on, timed
+       AFTER a populating run: repeat traffic under the shared sign
+       seed (the serving front-end's signed-cohort shape) skips sign
+       AND host verify bit-exactly by Ed25519 determinism.
+
+    The acceptance booleans gated by the trajectory sentinel:
+    ``pool_bit_exact`` (every pooled leg byte-identical, run outputs
+    AND a direct signature-table + verdict-plane comparison),
+    ``cache_bit_exact`` (same for the warm-cache leg), and
+    ``speedup_ge_3x`` (the best leg >= 3x the in-process baseline —
+    on a 1-core host that leg is the warm cache, which is the point:
+    the wall breaks on repeat traffic even before cores help).
+    ``host_sign_fraction``/``host_verify_fraction`` decompose every
+    leg's wall so the artifact shows WHERE the crypto went.
+    """
+    import numpy as np
+
+    from ba_tpu.crypto import pool as pool_mod
+    from ba_tpu.crypto.signed import _round_table_msgs
+    from ba_tpu.parallel import fresh_copy, make_sweep_state
+    from ba_tpu.parallel.pipeline import pipeline_sweep
+    from ba_tpu.parallel.signing import SignAheadLane
+
+    B = int(os.environ.get("BA_TPU_BENCH_SIGNED_BATCH", 1024))
+    cap = int(os.environ.get("BA_TPU_BENCH_SIGNED_CAP", 256))
+    rounds = int(os.environ.get("BA_TPU_BENCH_SIGNED_ROUNDS", 12))
+    depth = int(os.environ.get("BA_TPU_PIPELINE_DEPTH", 2))
+    rpd, m, collapsed, seed = 4, 3, True, 52
+    reps = 2
+
+    state0 = make_sweep_state(make_key(seed), B, cap)
+    key = make_key(seed + 1)
+
+    def run_pipe():
+        return pipeline_sweep(
+            key, fresh_copy(state0), rounds, signed=True, m=m,
+            collapsed=collapsed, depth=depth, rounds_per_dispatch=rpd,
+            collect_decisions=True,
+        )
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("BA_TPU_SIGN_POOL", "BA_TPU_SIGN_CACHE")
+    }
+    legs, ref_out = {}, None
+    try:
+        for name, pool_env, cache_env in (
+            ("inproc", "0", "0"),
+            ("pool1", "1", "0"),
+            ("pool2", "2", "0"),
+            ("pool4", "4", "0"),
+            ("cache_warm", "0", "256"),
+        ):
+            os.environ["BA_TPU_SIGN_POOL"] = pool_env
+            os.environ["BA_TPU_SIGN_CACHE"] = cache_env
+            pool_mod.shutdown_defaults()
+            # Off the clock: compiles, the pool spawn, and (the
+            # cache_warm leg's whole point) the cache-populating pass.
+            out = run_pipe()
+            if ref_out is None:
+                ref_out = out
+            t = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                out = run_pipe()
+                t = min(t, time.perf_counter() - t0)
+            bit = (
+                np.array_equal(out["histograms"], ref_out["histograms"])
+                and np.array_equal(out["decisions"], ref_out["decisions"])
+                and out["counters"] == ref_out["counters"]
+            )
+            st = out["stats"]
+            legs[name] = {
+                "wall_s": round(t, 4),
+                "bit_exact": bool(bit),
+                "pool_workers": st["sign_pool_workers"],
+                "pool_s": st["sign_pool_s"],
+                "cache_hits": st["sign_cache_hits"],
+                "host_sign_s": st["host_sign_s"],
+                "host_verify_s": st["host_verify_s"],
+                "host_sign_fraction": round(st["host_sign_s"] / t, 4),
+                "host_verify_fraction": round(st["host_verify_s"] / t, 4),
+                "rounds_per_sec": round(B * rounds / t, 1),
+            }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        pool_mod.shutdown_defaults()
+
+    base = legs["inproc"]["wall_s"]
+    for leg in legs.values():
+        leg["speedup"] = round(base / leg["wall_s"], 3)
+
+    # Direct table/plane bit-exactness, below the engine: the pooled
+    # and cached lanes must reproduce the in-process lane's signature
+    # TABLES and verdict PLANES byte-for-byte, not just the verdicts
+    # the sweep consumed.  (The cache doubles as the window into the
+    # pooled signatures.)
+    lane_b, lane_v, lane_seed = 8, 2, 7
+    wins = [(0, 4), (4, 6)]
+    ref_lane = SignAheadLane(lane_b, seed=lane_seed, pool=0, cache=0)
+    ref_planes = [np.asarray(p) for p in ref_lane.stage_windows(wins)]
+    ref_sigs = [ref_lane.round_tables(r)[1] for r in range(6)]
+    pool2 = pool_mod.SignPool(2)
+    try:
+        pcache = pool_mod.SigTableCache(64)
+        pool_lane = SignAheadLane(
+            lane_b, seed=lane_seed, pool=pool2, cache=pcache
+        )
+        pool_planes = [np.asarray(p) for p in pool_lane.stage_windows(wins)]
+        tables_exact = all(
+            np.array_equal(
+                pcache.get(
+                    pool_mod.SigTableCache.round_key(
+                        pool_lane.pks,
+                        _round_table_msgs(lane_b, r, lane_v, 0),
+                    )
+                )[0],
+                ref_sigs[r],
+            )
+            for r in range(6)
+        )
+        # The warm replay: a SECOND staging over the same cache must
+        # be pure hits and byte-identical planes.
+        warm_planes = [np.asarray(p) for p in pool_lane.stage_windows(wins)]
+    finally:
+        pool2.close()
+    planes_pool_exact = all(
+        np.array_equal(a, b) for a, b in zip(ref_planes, pool_planes)
+    )
+    planes_warm_exact = all(
+        np.array_equal(a, b) for a, b in zip(ref_planes, warm_planes)
+    )
+
+    best = max(legs, key=lambda n: legs[n]["speedup"])
+    pool_bit_exact = bool(
+        all(legs[n]["bit_exact"] for n in ("pool1", "pool2", "pool4"))
+        and planes_pool_exact
+        and tables_exact
+    )
+    cache_bit_exact = bool(
+        legs["cache_warm"]["bit_exact"] and planes_warm_exact
+    )
+    return {
+        "rounds_per_sec": legs[best]["rounds_per_sec"],
+        "elapsed_s": legs[best]["wall_s"],
+        "batch": B, "n_max": cap, "m": m, "collapsed": collapsed,
+        "rounds": rounds, "rounds_per_dispatch": rpd,
+        "legs": legs,
+        "best_leg": best,
+        "best_speedup": legs[best]["speedup"],
+        "pool_bit_exact": pool_bit_exact,
+        "cache_bit_exact": cache_bit_exact,
+        "speedup_ge_3x": bool(legs[best]["speedup"] >= 3.0),
+        "bound": "host-crypto lane only: identical key schedule, round "
+                 "tables, verdict planes and sweep outputs on every leg "
+                 "— the delta is WHO runs the Ed25519 wall (one core, N "
+                 "worker processes, or nobody on a warm cache hit)",
+        "note": "pool legs on a 1-core container pin bit-exactness and "
+                "honest pipe overhead (no second core to scale into); "
+                "the >=3x acceptance leg there is cache_warm — repeat "
+                "signed cohorts under the shared sign seed, the serving "
+                "front-end's steady state",
+    }
+
+
 def bench_adversary_search(jax, jnp, jr):
     """Adversary-search config (ISSUE 15 acceptance): a seeded
     CI-sized hunt — random populations of candidate campaigns lowered
@@ -3167,6 +3351,7 @@ CONFIGS = {
     "scenario_sweep": bench_scenario_sweep,
     "megastep_ab": bench_megastep_ab,
     "signed_ab": bench_signed_ab,
+    "signed_throughput": bench_signed_throughput,
     "scenario_long": bench_scenario_long,
     "resilience": bench_resilience,
     "serving": bench_serving,
@@ -3187,14 +3372,17 @@ CONFIGS = {
 # the legacy strategy formulation per rep + runs the Pallas interpreter
 # leg (minutes of compile/interpretation by design), and
 # adversary_search runs a multi-generation hunt whose minimizer replays
-# dozens of shrink trials — all opt in explicitly: `--configs
-# scenario_long` / `resilience` / `multichip` / `serving` /
-# `serving_warm` / `megastep_ab` / `adversary_search`.
+# dozens of shrink trials, and signed_throughput runs the signed sweep
+# five times over (pool spawns + a cache-populating pass per leg) —
+# all opt in explicitly: `--configs scenario_long` / `resilience` /
+# `multichip` / `serving` / `serving_warm` / `megastep_ab` /
+# `adversary_search` / `signed_throughput`.
 DEFAULT_CONFIGS = [
     n for n in CONFIGS
     if n not in (
         "scenario_long", "resilience", "multichip", "serving",
         "serving_warm", "megastep_ab", "signed_ab", "adversary_search",
+        "signed_throughput",
     )
 ]
 

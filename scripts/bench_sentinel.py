@@ -55,7 +55,8 @@ EXCLUDE = {"BENCH_trajectory.json", "BENCH_detail.json"}
 _RATIO_KEY = re.compile(r"(speedup|_ratio|ratio_|overhead_frac|overhead_pct)")
 _ACCEPT_KEY = re.compile(
     r"(within|bounded|bit_exact|_ok$|^ok$|recovery_within"
-    r"|no_request_path_compiles)"  # ISSUE 11: the warm-serving boolean
+    r"|no_request_path_compiles"  # ISSUE 11: the warm-serving boolean
+    r"|speedup_ge)"  # ISSUE 16: signed_throughput's speedup_ge_3x gate
 )
 
 

@@ -24,6 +24,9 @@ plus (ISSUE 15) a short deterministic ADVERSARY SEARCH session (two
 hunt generations, one checkpoint, one minimized finding) driving the
 real ``search_generation``/``search_found``/``search_checkpoint``/
 ``search_minimized`` emitters and the ``search_*`` gauge family —
+plus (ISSUE 16) a POOLED signed campaign (one explicit signing/verify
+worker + a live signature-table cache) driving the real ``sign_pool``
+emitter and the host sign/verify throughput gauges —
 into a temp sink, then validates every line, including the typed shape of the device-tier, resilience, flight
 and serving records, and the presence/shape of ``run_id`` on every
 record family that carries it.  Run by ``scripts/ci.sh`` before
@@ -106,6 +109,33 @@ def main() -> int:
             [jr.key(13), jr.key(14)], fresh_copy(_st_pair), 2,
             rounds_per_dispatch=2, signed=True,
         )
+        # Host-crypto pool records (ISSUE 16): a tiny POOLED signed
+        # campaign (one explicit worker, process defaults reset around
+        # it) drives the real sign_pool emitter — workers/degraded/
+        # cache tallies + the run_id the lane stamps (RUN_SCOPED_EVENTS
+        # contract) — and leaves the host sign/verify throughput
+        # gauges behind, both asserted below.
+        from ba_tpu.crypto import pool as _sign_pool
+
+        _saved_pool_env = {
+            k: os.environ.get(k)
+            for k in ("BA_TPU_SIGN_POOL", "BA_TPU_SIGN_CACHE")
+        }
+        os.environ["BA_TPU_SIGN_POOL"] = "1"
+        os.environ["BA_TPU_SIGN_CACHE"] = "16"
+        _sign_pool.shutdown_defaults()
+        try:
+            pipeline_sweep(
+                jr.key(15), make_sweep_state(jr.key(16), 4, 4), 4,
+                signed=True, rounds_per_dispatch=2, engine="xla",
+            )
+        finally:
+            for k, v in _saved_pool_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            _sign_pool.shutdown_defaults()
         # Streaming-engine records (ISSUE 6): a tiny sparse campaign
         # with checkpoint_every drives the real scenario_checkpoint
         # emitter (carry serialization inside the retire fetch).
@@ -490,6 +520,37 @@ def main() -> int:
                         file=sys.stderr,
                     )
                     bad += 1
+            elif rec.get("event") == "sign_pool":
+                # Host-crypto pool records (ISSUE 16): one per staged
+                # window GROUP while a pool object is live — worker
+                # census, degradation tally, cache hit/miss split and
+                # the sign/verify/pool wall decomposition.  run_id is
+                # required (RUN_SCOPED_EVENTS); its shape is validated
+                # by the generic run_id pass above.
+                if not (
+                    isinstance(rec.get("workers"), int)
+                    and rec.get("workers") >= 0
+                    and isinstance(rec.get("requested"), int)
+                    and rec.get("requested") >= 0
+                    and isinstance(rec.get("degraded"), int)
+                    and rec.get("degraded") >= 0
+                    and isinstance(rec.get("rounds"), int)
+                    and rec.get("rounds") >= 1
+                    and isinstance(rec.get("cache_hits"), int)
+                    and rec.get("cache_hits") >= 0
+                    and isinstance(rec.get("cache_misses"), int)
+                    and rec.get("cache_misses") >= 0
+                    and isinstance(rec.get("sign_s"), (int, float))
+                    and isinstance(rec.get("verify_s"), (int, float))
+                    and isinstance(rec.get("pool_s"), (int, float))
+                    and isinstance(rec.get("run_id"), str)
+                ):
+                    print(
+                        f"schema check: line {i} malformed sign_pool: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
             elif rec.get("event") == "flight_span":
                 if not (
                     rec.get("phase") == "retire"
@@ -786,6 +847,13 @@ def main() -> int:
                     # and window counter behind.
                     "host_sign_ahead_s",
                     "pipeline_sign_ahead_windows_total",
+                    # Host-crypto pool family (ISSUE 16): the pooled
+                    # signed campaign must have left the lane's
+                    # throughput gauges and cache counters behind.
+                    "host_sign_throughput_sigs_per_s",
+                    "host_verify_throughput_sigs_per_s",
+                    "sign_cache_hits_total",
+                    "sign_cache_misses_total",
                 ):
                     snap = metrics_blk.get(g)
                     if not (
@@ -814,6 +882,7 @@ def main() -> int:
             "shed",
             "warmup",
             "sign_ahead",
+            "sign_pool",
             "search_generation",
             "search_found",
             "search_minimized",

@@ -152,6 +152,13 @@ mutate_and_expect BA101 ops/scenario_step.py \
 mutate_and_expect BA101 parallel/signing.py \
     'def _mut101_signing(x):
     return x.block_until_ready()' || exit 1
+# ISSUE 16: the host-crypto pool (crypto/pool.py) joined the BA101
+# hot-path scope — SignAheadLane calls it inside the engine's overlap
+# slot, where a device sync would block the dispatch loop (and the
+# module is jax-free by contract besides).  Prove the scope covers it.
+mutate_and_expect BA101 crypto/pool.py \
+    'def _mut101_pool(x):
+    return x.block_until_ready()' || exit 1
 # ISSUE 9: BA301 grew the symmetric host-tier scope — obs modules
 # (the flight recorder and health sampler in particular) must never
 # import through ba_tpu.core/ba_tpu.ops.  Prove the direction is live.

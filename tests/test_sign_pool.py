@@ -1,0 +1,398 @@
+"""Host-crypto pool + signature-table cache tests (ISSUE 16).
+
+The contract under test, layer by layer:
+
+- the pool/cache host tier (``ba_tpu.crypto.pool``) imports jax-free —
+  worker processes never pay (or need) a jax import;
+- pooled signing/verify is BIT-EXACT with the in-process path —
+  signature tables AND verdict planes, at every worker count, because
+  sharding is deterministic contiguous ranges reassembled by index
+  over per-row-deterministic Ed25519;
+- a dead worker degrades its shard to the in-process path, counted,
+  never wedging — and a whole signed campaign over a half-dead pool
+  still completes bit-exact;
+- the signature-table cache returns byte-identical tables/planes on a
+  hit, enforces its LRU bounds, counts hits/misses/evictions, and
+  ``BA_TPU_SIGN_CACHE=0`` opts out;
+- the depth-k no-blocking dispatch-count proof still holds with pool +
+  cache + cross-window coalescing ALL live (cold and warm);
+- the ISSUE 16 small fix — hoisting the invariant key arrays out of
+  the window loop — changed no behavior: hoisted-path signatures equal
+  the per-call path's byte-for-byte.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.random as jr  # noqa: E402
+
+from ba_tpu.crypto import pool as pool_mod  # noqa: E402
+from ba_tpu.crypto.signed import (  # noqa: E402
+    _round_table_msgs,
+    commander_keys,
+    key_table_arrays,
+    sign_round_tables,
+    verify_host_exact,
+)
+from ba_tpu.parallel.pipeline import fresh_copy, pipeline_sweep  # noqa: E402
+from ba_tpu.parallel.signing import SignAheadLane  # noqa: E402
+
+from test_signed_pipeline import churn_state  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_defaults():
+    """Every test leaves the process-default pool/cache as it found
+    them: drained and re-derived from the (restored) env on next use."""
+    yield
+    pool_mod.shutdown_defaults()
+
+
+def _drain(pool):
+    pool.close()
+
+
+# -- jax-free host tier -------------------------------------------------------
+
+
+def test_pool_module_imports_jax_free():
+    # A subprocess pin, not an in-process check: this suite already
+    # imported jax, so only a fresh interpreter can prove the module
+    # never pulls it (the pool-worker contract).
+    code = (
+        "import sys; import ba_tpu.crypto.pool; "
+        "assert 'jax' not in sys.modules, 'jax leaked into the pool tier'; "
+        "import ba_tpu.crypto.signed; "
+        "assert 'jax' not in sys.modules, 'jax leaked via crypto.signed'"
+    )
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess.run(
+        [sys.executable, "-c", code], check=True, env=env, timeout=120
+    )
+
+
+# -- pooled vs in-process bit-exactness ---------------------------------------
+
+
+def test_pool_sign_verify_bit_exact_vs_inprocess():
+    B, V, seed = 6, 2, 9
+    sks, pks = commander_keys(B, seed)
+    rounds = list(range(5))
+    ref_sigs = np.stack(
+        [sign_round_tables(sks, pks, r, V)[1] for r in rounds]
+    )
+    msgs = np.concatenate([_round_table_msgs(B, r, V, 0) for r in rounds])
+    pks_w = np.tile(pks, (len(rounds), 1))
+    # Corrupt a few signatures so the verdict planes carry real False
+    # rows, not an all-True plane any bug could fake.
+    sigs_bad = ref_sigs.reshape(len(rounds) * B, V, 64).copy()
+    sigs_bad[3, 1, 0] ^= 0xFF
+    sigs_bad[11, 0, 5] ^= 0x01
+    ref_ok = verify_host_exact(pks_w, msgs, sigs_bad)
+    assert not ref_ok.all() and ref_ok.any()
+
+    pool = pool_mod.SignPool(2)
+    try:
+        assert pool.workers == 2
+
+        def fallback(rs):
+            return np.stack(
+                [sign_round_tables(sks, pks, r, V)[1] for r in rs]
+            )
+
+        got_sigs = pool.sign_rounds(seed, B, V, 0, rounds, fallback)
+        got_ok = pool.verify_rows(pks_w, msgs, sigs_bad)
+    finally:
+        _drain(pool)
+    np.testing.assert_array_equal(got_sigs, ref_sigs)
+    np.testing.assert_array_equal(got_ok, ref_ok)
+    assert pool.degraded == 0
+
+
+def test_pool_lane_planes_and_tables_bit_exact():
+    B, wins = 5, [(0, 3), (3, 4)]
+    ref_lane = SignAheadLane(B, seed=4, pool=0, cache=0)
+    ref_planes = [np.asarray(p) for p in ref_lane.stage_windows(wins)]
+    pool = pool_mod.SignPool(2)
+    cache = pool_mod.SigTableCache(32)
+    try:
+        lane = SignAheadLane(B, seed=4, pool=pool, cache=cache)
+        planes = [np.asarray(p) for p in lane.stage_windows(wins)]
+    finally:
+        _drain(pool)
+    for a, b in zip(ref_planes, planes):
+        np.testing.assert_array_equal(a, b)
+    # TABLES too, through the cache (it holds exactly what the pool
+    # signed): byte-equal to the per-round reference signer.
+    for r in range(4):
+        key_r = pool_mod.SigTableCache.round_key(
+            lane.pks, _round_table_msgs(B, r, 2, 0)
+        )
+        sigs_r, ok_r = cache.get(key_r)
+        np.testing.assert_array_equal(
+            sigs_r, ref_lane.round_tables(r)[1]
+        )
+        assert ok_r is not None  # host route cached the verdicts too
+
+
+# -- deterministic sharding ---------------------------------------------------
+
+
+def test_sharding_deterministic_under_worker_count():
+    # The shard boundaries are a pure function of (n, parts)...
+    for n in (1, 2, 5, 8, 13):
+        for parts in (1, 2, 3, 8):
+            spans = pool_mod.SignPool._split(n, parts)
+            assert spans == pool_mod.SignPool._split(n, parts)
+            assert spans[0][0] == 0 and spans[-1][1] == n
+            for (lo, hi), (lo2, _) in zip(spans, spans[1:]):
+                assert hi == lo2 and hi > lo
+    # ...and the OUTPUT is invariant under the worker count: 1, 2 and
+    # 3 workers produce byte-identical tables and verdicts.
+    B, V, seed, rounds = 4, 2, 17, list(range(6))
+    sks, pks = commander_keys(B, seed)
+
+    def fallback(rs):
+        return np.stack([sign_round_tables(sks, pks, r, V)[1] for r in rs])
+
+    ref = fallback(rounds)
+    msgs = np.concatenate([_round_table_msgs(B, r, V, 0) for r in rounds])
+    pks_w = np.tile(pks, (len(rounds), 1))
+    sigs_flat = ref.reshape(len(rounds) * B, V, 64)
+    ref_ok = verify_host_exact(pks_w, msgs, sigs_flat)
+    for workers in (1, 2, 3):
+        pool = pool_mod.SignPool(workers)
+        try:
+            np.testing.assert_array_equal(
+                pool.sign_rounds(seed, B, V, 0, rounds, fallback), ref
+            )
+            np.testing.assert_array_equal(
+                pool.verify_rows(pks_w, msgs, sigs_flat), ref_ok
+            )
+        finally:
+            _drain(pool)
+
+
+# -- degradation ladder -------------------------------------------------------
+
+
+def test_dead_worker_degrades_counted_and_stays_bit_exact():
+    B, V, seed, rounds = 4, 2, 23, list(range(4))
+    sks, pks = commander_keys(B, seed)
+
+    def fallback(rs):
+        return np.stack([sign_round_tables(sks, pks, r, V)[1] for r in rs])
+
+    ref = fallback(rounds)
+    pool = pool_mod.SignPool(2)
+    try:
+        # Kill one worker process out from under the pool: its shard
+        # must degrade to the in-process body, counted, and the result
+        # must not change by a byte.
+        pool._workers[0].proc.kill()
+        pool._workers[0].proc.wait()
+        got = pool.sign_rounds(seed, B, V, 0, rounds, fallback)
+        np.testing.assert_array_equal(got, ref)
+        assert pool.degraded >= 1
+        assert pool.workers == 1  # the dead worker retired permanently
+        # The survivor keeps serving...
+        np.testing.assert_array_equal(
+            pool.sign_rounds(seed, B, V, 0, rounds, fallback), ref
+        )
+        # ...and an all-dead pool degrades whole calls in-process.
+        pool._workers[1].proc.kill()
+        pool._workers[1].proc.wait()
+        np.testing.assert_array_equal(
+            pool.sign_rounds(seed, B, V, 0, rounds, fallback), ref
+        )
+        assert pool.workers == 0
+    finally:
+        _drain(pool)
+
+
+def test_campaign_over_half_dead_pool_completes_bit_exact(monkeypatch):
+    state = churn_state(4, 8)
+    key = jr.key(31)
+    monkeypatch.setenv("BA_TPU_SIGN_POOL", "0")
+    monkeypatch.setenv("BA_TPU_SIGN_CACHE", "0")
+    pool_mod.shutdown_defaults()
+    ref = pipeline_sweep(
+        key, fresh_copy(state), 6, signed=True, m=2,
+        rounds_per_dispatch=2, collect_decisions=True,
+    )
+    monkeypatch.setenv("BA_TPU_SIGN_POOL", "2")
+    pool_mod.shutdown_defaults()
+    pool = pool_mod.default_pool()
+    assert pool is not None and pool.workers == 2
+    pool._workers[0].proc.kill()
+    pool._workers[0].proc.wait()
+    try:
+        out = pipeline_sweep(
+            key, fresh_copy(state), 6, signed=True, m=2,
+            rounds_per_dispatch=2, collect_decisions=True,
+        )
+    finally:
+        pool_mod.shutdown_defaults()
+    np.testing.assert_array_equal(out["histograms"], ref["histograms"])
+    np.testing.assert_array_equal(out["decisions"], ref["decisions"])
+    assert out["counters"] == ref["counters"]
+    assert pool.degraded >= 1
+    assert out["stats"]["sign_pool_workers"] == 1
+
+
+# -- signature-table cache ----------------------------------------------------
+
+
+def test_cache_hits_are_bit_exact_and_counted():
+    B, wins = 4, [(0, 2), (2, 5)]
+    cache = pool_mod.SigTableCache(32)
+    lane = SignAheadLane(B, seed=6, pool=0, cache=cache)
+    cold = [np.asarray(p) for p in lane.stage_windows(wins)]
+    assert cache.misses == 5 and cache.hits == 0
+    warm = [np.asarray(p) for p in lane.stage_windows(wins)]
+    assert cache.hits == 5  # every round a pure lookup the second time
+    for a, b in zip(cold, warm):
+        np.testing.assert_array_equal(a, b)
+    assert lane.cache_hits == 5 and lane.cache_misses == 5
+    # A DIFFERENT key-set never hits the first lane's entries: the pk
+    # table is inside the key.
+    lane2 = SignAheadLane(B, seed=7, pool=0, cache=cache)
+    lane2.stage(0, 2)
+    assert lane2.cache_hits == 0 and lane2.cache_misses == 2
+    # ...and a second lane over the SAME key-set shares them (the
+    # serving-cohort shape: repeat traffic under the shared sign seed).
+    lane3 = SignAheadLane(B, seed=6, pool=0, cache=cache)
+    replay = [np.asarray(p) for p in lane3.stage_windows(wins)]
+    assert lane3.cache_hits == 5
+    for a, b in zip(cold, replay):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_cache_lru_bounds_and_eviction():
+    cache = pool_mod.SigTableCache(max_entries=3)
+    sigs = np.zeros((2, 2, 64), np.uint8)
+    ok = np.ones((2, 2), bool)
+    for i in range(5):
+        cache.put(bytes([i]) * 32, sigs, ok)
+    assert len(cache) == 3 and cache.evictions == 2
+    assert cache.get(bytes([0]) * 32) is None  # oldest evicted
+    assert cache.get(bytes([4]) * 32) is not None  # newest kept
+    # A hit refreshes recency: touch the oldest survivor, insert one
+    # more, and the UNtouched middle entry is the one to go.
+    assert cache.get(bytes([2]) * 32) is not None
+    cache.put(bytes([5]) * 32, sigs, ok)
+    assert cache.get(bytes([3]) * 32) is None
+    assert cache.get(bytes([2]) * 32) is not None
+    # The byte bound trips independently of the entry bound.
+    small = pool_mod.SigTableCache(max_entries=64, max_bytes=sigs.nbytes * 2)
+    for i in range(4):
+        small.put(bytes([i]) * 32, sigs, None)
+    assert small.nbytes <= sigs.nbytes * 2 and small.evictions >= 2
+
+
+def test_cache_env_optout_and_default(monkeypatch):
+    monkeypatch.setenv("BA_TPU_SIGN_CACHE", "0")
+    pool_mod.shutdown_defaults()
+    assert pool_mod.default_cache() is None
+    lane = SignAheadLane(3, seed=1)
+    assert lane.cache is None
+    lane.stage(0, 2)  # uncached staging still works
+    assert lane.cache_hits == 0 and lane.cache_misses == 0
+    monkeypatch.setenv("BA_TPU_SIGN_CACHE", "7")
+    pool_mod.shutdown_defaults()
+    cache = pool_mod.default_cache()
+    assert cache is not None and cache.max_entries == 7
+    assert SignAheadLane(3, seed=1).cache is cache
+
+
+def test_pool_env_sizing(monkeypatch):
+    monkeypatch.setenv("BA_TPU_SIGN_POOL", "0")
+    pool_mod.shutdown_defaults()
+    assert pool_mod.default_pool() is None
+    assert SignAheadLane(2, seed=0).pool_workers == 0
+    monkeypatch.delenv("BA_TPU_SIGN_POOL", raising=False)
+    assert pool_mod.pool_size_from_env() == max(
+        0, min(8, (os.cpu_count() or 1) - 1)
+    )
+    with pytest.raises(ValueError):
+        pool_mod.SignPool(-1)
+    # close() is idempotent and leaves an in-process-equivalent pool.
+    pool = pool_mod.SignPool(1)
+    pool.close()
+    pool.close()
+    assert pool.workers == 0
+
+
+# -- no-blocking proof with pool + cache + coalescing live --------------------
+
+
+def test_signed_no_blocking_dispatch_count_with_pool_and_cache(monkeypatch):
+    def _forbidden(*a, **k):
+        raise AssertionError("block_until_ready called inside the engine")
+
+    monkeypatch.setenv("BA_TPU_SIGN_POOL", "1")
+    monkeypatch.setenv("BA_TPU_SIGN_CACHE", "64")
+    monkeypatch.setenv("BA_TPU_SIGN_COALESCE", "3")
+    pool_mod.shutdown_defaults()
+    monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+    B, cap, R, depth = 4, 8, 7, 3
+    try:
+        for leg in ("cold", "warm"):  # warm = pure cache hits
+            events = []
+            out = pipeline_sweep(
+                jr.key(23), churn_state(B, cap), R, signed=True,
+                depth=depth, rounds_per_dispatch=1,
+                on_event=lambda kind, i: events.append((kind, i)),
+            )
+            dispatches = [i for kind, i in events if kind == "dispatch"]
+            retires = [i for kind, i in events if kind == "retire"]
+            assert dispatches == list(range(R))
+            assert retires == list(range(R))
+            first_retire = events.index(("retire", 0))
+            assert events[:first_retire] == [
+                ("dispatch", i) for i in range(depth + 1)
+            ]
+            for r in range(R - depth):
+                assert events.index(("retire", r)) > events.index(
+                    ("dispatch", r + depth)
+                )
+            assert out["stats"]["max_in_flight"] == depth + 1
+            assert out["stats"]["sign_pool_workers"] == 1
+            if leg == "warm":
+                assert out["stats"]["sign_cache_hits"] == R
+    finally:
+        pool_mod.shutdown_defaults()
+
+
+# -- the small fix: hoisted key arrays, no behavior change --------------------
+
+
+def test_hoisted_key_arrays_no_behavior_change():
+    B, V = 5, 2
+    lane = SignAheadLane(B, seed=12, pool=0, cache=0)
+    # The hoisted arrays are exactly the per-call derivation's.
+    sk_rep, pk_rep = key_table_arrays(lane.sks, lane.pks, V)
+    np.testing.assert_array_equal(lane._sk_rep, sk_rep)
+    np.testing.assert_array_equal(lane._pk_rep, pk_rep)
+    assert sk_rep.shape == (B * V, 32) and pk_rep.shape == (B * V, 32)
+    # And the hoisted signing path (stage -> _sign_inprocess) produces
+    # the SAME bytes as the unhoisted per-round signer.
+    for r in (0, 3):
+        np.testing.assert_array_equal(
+            lane._sign_inprocess([r])[0], lane.round_tables(r)[1]
+        )
+    # Single-window stage() is stage_windows' degenerate case, and a
+    # coalesced group equals the windows staged one at a time (fresh
+    # lanes: no cache crosstalk).
+    a = SignAheadLane(B, seed=12, pool=0, cache=0)
+    b = SignAheadLane(B, seed=12, pool=0, cache=0)
+    grouped = [np.asarray(p) for p in a.stage_windows([(0, 2), (2, 4)])]
+    np.testing.assert_array_equal(grouped[0], np.asarray(b.stage(0, 2)))
+    np.testing.assert_array_equal(grouped[1], np.asarray(b.stage(2, 4)))
