@@ -26,6 +26,11 @@ Three parts, layered bottom-up (docs/DESIGN.md §8):
   live metrics (rounds/s, retire-lag p50/p99, watchdog margin,
   per-shard imbalance) and ``health_snapshot`` records
   (``pipeline_sweep(health_every=)``; REPL ``stats --live``).
+- **SLO engine** (``obs.slo``, ISSUE 17): streaming per-phase latency
+  attribution and per-(cohort, tenant) error budgets over the request
+  record stream; ``slo_report`` / ``slo_alert`` / ``autoscale_signal``
+  records ride the health sampler's cadence (``BA_TPU_SLO`` installs a
+  policy on the serving front-end).
 
 Everything MODULE-LEVEL here is HOST-side and jax-free (``obs.xla``
 imports jax only inside its opt-in functions): spans and emissions must
@@ -36,7 +41,15 @@ buffers, and triggers no extra compiles — the overhead-guard tests in
 tests/test_obs.py and tests/test_obs_xla.py pin it.
 """
 
-from ba_tpu.obs import aotcache, flight, health, instrument, registry, trace, xla
+from ba_tpu.obs import (
+    aotcache,
+    flight,
+    health,
+    instrument,
+    registry,
+    trace,
+    xla,
+)
 from ba_tpu.obs.instrument import (
     classify_compile,
     compile_or_dispatch_span,
@@ -47,6 +60,18 @@ from ba_tpu.obs.instrument import (
 )
 from ba_tpu.obs.registry import MetricsRegistry, default_registry
 from ba_tpu.obs.trace import Tracer, default_tracer, instant, span
+
+
+def __getattr__(name):
+    # obs.slo loads lazily so its ``python -m ba_tpu.obs.slo`` CLI runs
+    # without runpy's found-in-sys.modules warning (the package would
+    # otherwise import the submodule before runpy executes it as
+    # __main__).  Everything else stays eager.
+    if name == "slo":
+        import importlib
+
+        return importlib.import_module("ba_tpu.obs.slo")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "MetricsRegistry",
@@ -64,6 +89,7 @@ __all__ = [
     "instrument",
     "registry",
     "reset_first_calls",
+    "slo",
     "span",
     "timed_span",
     "trace",

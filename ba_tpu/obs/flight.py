@@ -83,6 +83,12 @@ RUN_SCOPED_EVENTS = frozenset(
         # stamps an explicit id (active scope, else its own derived
         # key-set identity), so the record always carries one.
         "sign_pool",
+        # The SLO family (ISSUE 17): the engine stamps an explicit id
+        # (env pin > active scope > its own policy-fingerprint
+        # derivation), so every report/alert/signal is joinable.
+        "slo_report",
+        "slo_alert",
+        "autoscale_signal",
     }
 )
 

@@ -54,6 +54,11 @@ HEALTH_GAUGES = (
     "health_watchdog_margin_s",
     "health_plane_imbalance",
     "health_carry_imbalance",
+    # Written by an installed SLO engine (obs/slo.py, ISSUE 17), not by
+    # the sampler itself — listed here because they are part of the
+    # same lock-free `health_*` read surface (REPL, shed ladder).
+    "health_slo_burn",
+    "health_slo_worst_p99_s",
 )
 
 
@@ -73,27 +78,10 @@ def _hist_peek(reg, name: str):
 
 
 def _delta_quantile(hist, counts_then, counts_now, q: float):
-    """Approximate quantile of the samples recorded BETWEEN two peeks:
-    the upper edge of the bucket where the delta-cumulative count
-    crosses ``q`` (inf for the overflow bucket; None for an empty
-    window)."""
-    if counts_then is None:
-        counts_then = [0] * len(counts_now)
-    deltas = [
-        max(0, now - then) for now, then in zip(counts_now, counts_then)
-    ]
-    total = sum(deltas)
-    if not total:
-        return None
-    need = q * total
-    cum = 0
-    for i, c in enumerate(deltas):
-        cum += c
-        if cum >= need:
-            if i == len(deltas) - 1:
-                return float("inf")
-            return hist.edge(i)
-    return None
+    """Back-compat alias: the windowed-quantile walk now lives on the
+    registry as :func:`ba_tpu.obs.registry.delta_quantile` (ISSUE 17
+    promoted it so the SLO engine shares the one implementation)."""
+    return _registry.delta_quantile(hist, counts_then, counts_now, q)
 
 
 class HealthSampler:
@@ -289,6 +277,21 @@ class HealthSampler:
                 },
             }
             (sink or _metrics.default_sink()).emit(record)
+
+        # ISSUE 17: an installed SLO engine reports on THIS sampler's
+        # cadence — the same host_work overlap slot, so SLO evaluation
+        # adds zero synchronization to the dispatch schedule.  Its own
+        # report_every_s throttle decides whether a record is actually
+        # due.  An engine bug must never take down the sweep that is
+        # sampling, hence the counted-not-raised error path.
+        from ba_tpu.obs import slo as _slo  # local: obs→obs, optional
+
+        eng = _slo.installed()
+        if eng is not None:
+            try:
+                eng.maybe_report(sink=sink)
+            except Exception:
+                reg.counter("slo_report_errors_total").inc()
         return snap
 
 

@@ -154,6 +154,38 @@ class Histogram:
         return out
 
 
+def delta_quantile(hist: Histogram, counts_then, counts_now, q: float):
+    """Approximate quantile of the samples recorded BETWEEN two
+    ``Histogram.peek`` calls: the upper edge of the bucket where the
+    delta-cumulative count crosses ``q`` (``inf`` for the overflow
+    bucket; ``None`` for an empty window).
+
+    The repo's ONE windowed-quantile implementation (ISSUE 17): the
+    health sampler (``obs/health.py``) and the SLO engine
+    (``obs/slo.py``) both difference lock-free ``peek()`` snapshots
+    through this helper, so a bucket-walk fix lands in every consumer
+    at once.  ``counts_then`` may be ``None`` (no baseline yet — the
+    whole histogram is the window).
+    """
+    if counts_then is None:
+        counts_then = [0] * len(counts_now)
+    deltas = [
+        max(0, now - then) for now, then in zip(counts_now, counts_then)
+    ]
+    total = sum(deltas)
+    if not total:
+        return None
+    need = q * total
+    cum = 0
+    for i, c in enumerate(deltas):
+        cum += c
+        if cum >= need:
+            if i == len(deltas) - 1:
+                return float("inf")
+            return hist.edge(i)
+    return None
+
+
 class MetricsRegistry:
     """Thread-safe name → instrument map with snapshot/exposition dumps."""
 

@@ -1856,8 +1856,15 @@ def _coalesced_loop(
     max_in_flight = 0
     warm_dispatches = 0
     request_path_compiles = 0
+    # Engine-side phase walls for the SLO attribution join (ISSUE 17):
+    # the service subtracts these from its dispatched→retired span so a
+    # request's dispatch_s is pure device/dispatch time, with compiles
+    # and retire fetches attributed to their own phases.
+    compile_s = 0.0
+    retire_fetch_s = 0.0
 
     def retire():
+        nonlocal retire_fetch_s
         d, ys, t_sub, lo, hi = inflight.popleft()
         with obs.timed_span("retire", lag_h, dispatch=d) as lag_box:
             with obs.xla.annotate("coalesced_retire", dispatch=d):
@@ -1867,6 +1874,7 @@ def _coalesced_loop(
                 else:
                     host_ys = exec_seam(fetch, "retire", d, lo, hi)
                 retired.append(host_ys)
+        retire_fetch_s += lag_box.elapsed_s or 0.0
         latency_s = (time.perf_counter_ns() - t_sub) / 1e9
         lat_h.record(latency_s)
         ret_c.inc()
@@ -1924,6 +1932,7 @@ def _coalesced_loop(
             else None
         )
         fell_back: list = []
+        t_disp = time.perf_counter()
         with _dispatch_span(
             "coalesced_megastep", axes, exe is not None,
             dispatch=d, rounds=nr,
@@ -1965,6 +1974,10 @@ def _coalesced_loop(
             warm_dispatches += 1
         elif phase == "compile" or fell_back:
             request_path_compiles += 1
+            # A cold dispatch's block wall is dominated by tracing +
+            # XLA compile (the async dispatch itself returns in µs) —
+            # attribute the whole block to the compile phase.
+            compile_s += time.perf_counter() - t_disp
         round_base = hi
         t_sub = time.perf_counter_ns()
         disp_c.inc()
@@ -1997,6 +2010,11 @@ def _coalesced_loop(
             "max_in_flight": max_in_flight,
             "warm_dispatches": warm_dispatches,
             "request_path_compiles": request_path_compiles,
+            # SLO attribution inputs (ISSUE 17): engine-side phase
+            # walls for this batch, both 6-dp rounded like the records
+            # they feed.
+            "compile_s": round(compile_s, 6),
+            "retire_fetch_s": round(retire_fetch_s, 6),
         },
     }
     if is_scenario:

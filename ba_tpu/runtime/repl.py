@@ -528,6 +528,24 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
                 if v is None:
                     continue
                 out(f"{k} {'+Inf' if v == float('inf') else v}")
+            # One SLO line (ISSUE 17): worst gate burn + worst cohort
+            # p99 attribution, read lock-free from the installed
+            # engine's last report (GIL-atomic attribute read) — no
+            # engine or no report yet prints nothing; errors are one
+            # line, PyBackend-safe (everything here is host-tier).
+            try:
+                eng = obs.slo.installed()
+                worst = eng.last_worst if eng is not None else None
+                if worst is not None:
+                    out(
+                        f"slo_worst burn={worst['burn']} "
+                        f"cohort={worst['cohort']} "
+                        f"tenant={worst['tenant']} "
+                        f"p99_s={worst['p99_s']} "
+                        f"phase={worst['phase']}"
+                    )
+            except Exception as e:
+                out(f"slo_worst error: {e}")
             return True
         for ln in obs.default_registry().prometheus_text().splitlines():
             out(ln)

@@ -65,9 +65,24 @@ reached lazily from the dispatcher thread (``_execute``), exactly the
   boolean — while an unwarmed cohort still serves via compile-on-miss
   (counted in ``serve_warmup_miss_total``).
 
+- **SLO engine** (ISSUE 17).  Every terminal ``request`` record now
+  carries the full lifecycle decomposition — ``queue_s`` (admitted →
+  popped), ``coalesce_s`` (popped → dispatched), ``compile_s`` /
+  ``dispatch_s`` (the engine's own measured walls), ``retire_lag_s``
+  (retire fetch + delivery) — telescoping EXACTLY to ``wall_s``, plus
+  the request's ``tenant`` label and ``cohort`` string (tenants are
+  ACCOUNTING, never isolation: the cohort key is unchanged, so tenants
+  coalesce together).  With a policy configured (``BA_TPU_SLO``), the
+  service installs an ``obs/slo.py`` engine: request/admission records
+  fold into per-(cohort, tenant) phase histograms and per-objective
+  burn windows, and ``slo_report`` / ``slo_alert`` /
+  ``autoscale_signal`` records ride the pressure sampler's cadence —
+  the shed ladder reads the ``health_slo_burn`` gauge as a
+  first-class pressure signal (``burn_soft`` / ``burn_hard`` dials).
+
 Environment: ``BA_TPU_SERVE_BATCH`` / ``BA_TPU_SERVE_QUEUE`` /
 ``BA_TPU_SERVE_WINDOW_S`` / ``BA_TPU_SERVE_DEADLINE_S`` /
-``BA_TPU_SERVE_RETRIES`` / ``BA_TPU_WARM`` override
+``BA_TPU_SERVE_RETRIES`` / ``BA_TPU_WARM`` / ``BA_TPU_SLO`` override
 :meth:`ServeConfig.from_env`; ``BA_TPU_AOT_CACHE`` places (or
 disables) the executable-cache directory.
 """
@@ -101,6 +116,15 @@ ORDERS = ("attack", "retreat")
 ENGINE_TOKENS = ("xla", "pallas", "interpret", "auto")
 # Admission outcomes the `admission` record's `reason` field may carry.
 REJECT_REASONS = ("queue_full", "shed_interactive", "shed_all")
+
+# ISSUE 17: the documented retry-after hint for a COLD service — no
+# batch has completed yet, so there is no observed service rate to
+# scale queue depth by.  0.1 s is one order above the default coalesce
+# window and well under any deadline budget: a cold fleet retries
+# promptly without hammering, instead of the old degenerate
+# max(coalesce_window_s, 1 ms) hint that told a 64-deep queue to retry
+# in 5 ms.
+COLD_RETRY_AFTER_S = 0.1
 
 
 class ServeError(RuntimeError):
@@ -210,6 +234,13 @@ class ServeConfig:
     #                                 dispatch time, part of the
     #                                 cohort key so engines never
     #                                 share a batch
+    slo: object = None             # ISSUE 17: SLO policy — None = no
+    #                                 engine; True = obs.slo default
+    #                                 policy; a path string loads a
+    #                                 policy JSON; an SLOPolicy is used
+    #                                 as-is (resolved at service init)
+    burn_soft: float = 1.0         # tier-1 health_slo_burn threshold
+    burn_hard: float = 8.0         # tier-2 health_slo_burn threshold
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -265,6 +296,19 @@ class ServeConfig:
             raise ValueError(
                 f"engine={self.engine!r} not in {ENGINE_TOKENS}"
             )
+        if self.slo is not None and not isinstance(self.slo, (bool, str)):
+            # Anything else must quack like a policy (obs.slo.SLOPolicy
+            # — checked structurally so this module stays import-light).
+            if not hasattr(self.slo, "objectives"):
+                raise ValueError(
+                    f"slo={self.slo!r} must be None, a bool, a policy "
+                    f"path, or an obs.slo.SLOPolicy"
+                )
+        if not 0 < self.burn_soft <= self.burn_hard:
+            raise ValueError(
+                f"need 0 < burn_soft <= burn_hard, got "
+                f"{self.burn_soft}/{self.burn_hard}"
+            )
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -284,6 +328,12 @@ class ServeConfig:
             env["warm"] = os.environ["BA_TPU_WARM"] not in ("", "0")
         if os.environ.get("BA_TPU_ENGINE"):
             env["engine"] = os.environ["BA_TPU_ENGINE"]
+        if "BA_TPU_SLO" in os.environ:
+            raw = os.environ["BA_TPU_SLO"]
+            # "" / "0" off, "1" default policy, anything else a path.
+            env["slo"] = (
+                None if raw in ("", "0") else True if raw == "1" else raw
+            )
         env.update(overrides)
         return cls(**env)
 
@@ -298,31 +348,43 @@ class ServeConfig:
         )
 
 
-def shed_tier(queue_frac, lag_p99_s, occupancy, config: ServeConfig) -> int:
+def shed_tier(
+    queue_frac, lag_p99_s, occupancy, config: ServeConfig, burn=None
+) -> int:
     """The load-shedding tier from the pressure signals (pure, pinned
     by unit tests):
 
     - tier 3 — queue full: reject everything;
-    - tier 2 — queue past ``queue_hard_frac`` or retire-lag p99 past
-      ``lag_hard_s`` (inf — the overflow bucket — counts): shed
-      interactive work, keep admitting campaigns;
+    - tier 2 — queue past ``queue_hard_frac``, retire-lag p99 past
+      ``lag_hard_s`` (inf — the overflow bucket — counts), or the SLO
+      gate burn rate (ISSUE 17: the ``health_slo_burn`` gauge an
+      installed ``obs/slo.py`` engine maintains) past ``burn_hard``:
+      shed interactive work, keep admitting campaigns;
     - tier 1 — queue past ``queue_soft_frac``, lag past ``lag_soft_s``,
-      or the engine's depth-occupancy at/over the configured depth
-      (every pipeline slot full — the device is saturated): halve the
-      coalescing window, admit everything;
+      burn past ``burn_soft``, or the engine's depth-occupancy at/over
+      the configured depth (every pipeline slot full — the device is
+      saturated): halve the coalescing window, admit everything;
     - tier 0 — healthy.
 
-    ``lag_p99_s``/``occupancy`` are ``obs/health.py`` sample fields and
-    may be None (no window yet) — absent signals never raise the tier.
+    ``lag_p99_s``/``occupancy``/``burn`` are sampled signals and may be
+    None (no window yet, no SLO engine) — absent signals never raise
+    the tier.
     """
     if queue_frac >= 1.0:
         return 3
     lag_hard = lag_p99_s is not None and lag_p99_s >= config.lag_hard_s
-    if queue_frac >= config.queue_hard_frac or lag_hard:
+    burn_hard = burn is not None and burn >= config.burn_hard
+    if queue_frac >= config.queue_hard_frac or lag_hard or burn_hard:
         return 2
     lag_soft = lag_p99_s is not None and lag_p99_s >= config.lag_soft_s
+    burn_soft = burn is not None and burn >= config.burn_soft
     saturated = occupancy is not None and occupancy >= config.depth
-    if queue_frac >= config.queue_soft_frac or lag_soft or saturated:
+    if (
+        queue_frac >= config.queue_soft_frac
+        or lag_soft
+        or burn_soft
+        or saturated
+    ):
         return 1
     return 0
 
@@ -355,6 +417,11 @@ class AgreementRequest:
     # coalesced megastep).
     m: int | None = None
     signed: bool = False
+    # ISSUE 17: optional accounting label.  DELIBERATELY not a cohort
+    # key member — tenants coalesce together (the label attributes
+    # spend, it never isolates); the SLO engine accounts per
+    # (cohort, tenant) from the request records.
+    tenant: str | None = None
 
 
 def validate_request(req: AgreementRequest) -> AgreementRequest:
@@ -383,6 +450,12 @@ def validate_request(req: AgreementRequest) -> AgreementRequest:
         not isinstance(req.m, int) or isinstance(req.m, bool) or req.m < 1
     ):
         raise ValueError(f"m={req.m!r} must be an int >= 1 (or None)")
+    if req.tenant is not None and (
+        not isinstance(req.tenant, str) or not req.tenant
+    ):
+        raise ValueError(
+            f"tenant={req.tenant!r} must be None or a non-empty string"
+        )
     if req.kind == "scenario":
         if req.spec is None:
             raise ValueError("kind='scenario' needs a spec")
@@ -427,6 +500,19 @@ def cohort_key(
     )
 
 
+def cohort_label(key: tuple) -> str:
+    """The cohort key's compact record-field spelling (ISSUE 17):
+    ``{scenario|plain}.r<rounds>.c<capacity>.<engine>.m<m>[.signed]``
+    — a stable string the SLO engine / report tooling group on, so the
+    JSONL stream never carries raw tuples."""
+    is_scenario, rounds, cap, engine, m, signed = key
+    label = (
+        f"{'scenario' if is_scenario else 'plain'}"
+        f".r{rounds}.c{cap}.{engine}.m{m}"
+    )
+    return label + ".signed" if signed else label
+
+
 class Ticket:
     """The caller's handle on a submitted request (a tiny future):
     ``result(timeout=None)`` blocks for the terminal state and returns
@@ -437,8 +523,15 @@ class Ticket:
         self.request = request
         self.id = req_id
         self.deadline_t = deadline_t  # perf_counter deadline or None
+        # Lifecycle marks (ISSUE 17): admitted → popped (left the queue
+        # into a cohort, or expired at pop) → dispatched (cohort batch
+        # handed to the engine) → retired (engine returned) →
+        # delivered (the record-emission instant).  The request
+        # record's phase decomposition telescopes over these.
         self.enqueued_t = time.perf_counter()
+        self.popped_t = None
         self.dispatched_t = None
+        self.retired_t = None
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -509,6 +602,18 @@ class AgreementService:
         # custom registry (engine pressure is process-global by
         # design; serve bookkeeping is what registry= isolates).
         self._sampler = obs.health.HealthSampler()
+        # SLO engine (ISSUE 17): resolved EAGERLY — a bad policy path
+        # or document fails at construction, not mid-traffic — and
+        # installed process-wide at open() (the health sampler's hook
+        # target; reports ride the pressure-sampling cadence).
+        self._slo = None
+        if self._cfg.slo:
+            policy = self._cfg.slo
+            if policy is True:
+                policy = obs.slo.default_policy()
+            elif isinstance(policy, str):
+                policy = obs.slo.SLOPolicy.load(policy)
+            self._slo = obs.slo.SLOEngine(policy, registry=self._reg)
         from ba_tpu.runtime.supervisor import (
             SupervisorConfig,
             derive_timeout_s,
@@ -590,6 +695,8 @@ class AgreementService:
         with self._cond:
             self._open = True
         self._sampler.prime()
+        if self._slo is not None:
+            obs.slo.install(self._slo)
         # Host-crypto pool lifecycle (ISSUE 16): the SERVICE owns the
         # process-default signing/verify pool — spawn it at open (per
         # BA_TPU_SIGN_POOL; a 0 derivation is the in-process path and
@@ -668,6 +775,13 @@ class AgreementService:
             self._failed_c.inc()
             t._fail(ServeError("service stopped before dispatch"))
             self._emit_request(t, status="failed", fault=None)
+        if self._slo is not None:
+            # One final forced report (the leftovers above folded in),
+            # then uninstall — a stopped service must not leave its
+            # engine wired to the process-wide sampler hook.
+            self._slo.maybe_report(force=True)
+            if obs.slo.installed() is self._slo:
+                obs.slo.install(None)
 
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
@@ -747,19 +861,27 @@ class AgreementService:
             reason, depth, tier = reject
             retry_after = self._retry_after(depth)
             self._rejected_c.inc()
-            _metrics.emit(
-                {
-                    "event": "admission",
-                    "v": _metrics.SCHEMA_VERSION,
-                    "decision": "reject",
-                    "reason": reason,
-                    "kind": request.kind,
-                    "tier": tier,
-                    "queue_depth": depth,
-                    "queue_limit": self._cfg.max_queue,
-                    "retry_after_s": retry_after,
-                }
-            )
+            rec = {
+                "event": "admission",
+                "v": _metrics.SCHEMA_VERSION,
+                "decision": "reject",
+                "reason": reason,
+                "kind": request.kind,
+                # Accounting labels (ISSUE 17): a rejection is
+                # attributable to its tenant/cohort like any terminal
+                # outcome — rejected work burns error budget too.
+                "tenant": request.tenant,
+                "cohort": cohort_label(
+                    cohort_key(request, self._cfg.engine, self._cfg.m)
+                ),
+                "tier": tier,
+                "queue_depth": depth,
+                "queue_limit": self._cfg.max_queue,
+                "retry_after_s": retry_after,
+            }
+            _metrics.emit(rec)
+            if self._slo is not None:
+                self._slo.fold(rec)
             obs.instant(
                 "serve_reject", reason=reason, tier=tier, queue=depth
             )
@@ -775,10 +897,12 @@ class AgreementService:
         return ticket
 
     def _retry_after(self, queue_depth: int) -> float:
+        # Cold service (no batch observed yet): the documented default,
+        # not a degenerate coalesce-window hint (ISSUE 17 satellite).
         per_batch = (
             self._batch_s
             if self._batch_s is not None
-            else max(self._cfg.coalesce_window_s, 0.001)
+            else COLD_RETRY_AFTER_S
         )
         batches_ahead = max(
             1, -(-max(1, queue_depth) // self._cfg.max_batch)
@@ -823,6 +947,11 @@ class AgreementService:
             head = None
             while self._queue:
                 t = self._queue.popleft()
+                # The pop mark (ISSUE 17): the instant the ticket left
+                # the queue for good — into a cohort or into expiry.
+                # Tickets parked on `keep` below re-queue unstamped;
+                # their queue phase is still running.
+                t.popped_t = now
                 if t.deadline_t is not None and now >= t.deadline_t:
                     expired.append(t)
                     continue
@@ -843,6 +972,7 @@ class AgreementService:
                             t.deadline_t is not None
                             and now >= t.deadline_t
                         ):
+                            t.popped_t = now
                             expired.append(t)
                         elif (
                             len(cohort) < self._cfg.max_batch
@@ -851,6 +981,7 @@ class AgreementService:
                             )
                             == ckey
                         ):
+                            t.popped_t = now
                             cohort.append(t)
                         else:
                             keep.append(t)
@@ -893,15 +1024,26 @@ class AgreementService:
         returns."""
         if self._wedged:
             return
-        snap = self._sampler.sample()
         with self._cond:
             depth = len(self._queue)
         frac = depth / self._cfg.max_queue
+        if self._slo is not None:
+            # Stamp queue pressure BEFORE sampling: sample() fires the
+            # installed engine's maybe_report, and the autoscale_signal
+            # it emits folds this very reading in (GIL-atomic write).
+            self._slo.queue_frac = frac
+        snap = self._sampler.sample()
+        # The SLO gate burn as a pressure signal (ISSUE 17): lock-free
+        # gauge read, None when no engine ever reported — absent
+        # signals never raise the tier (shed_tier docstring).
+        burn_inst = self._reg.get("health_slo_burn")
+        burn = burn_inst.value if burn_inst is not None else None
         tier = shed_tier(
             frac,
             snap.get("retire_lag_p99_s"),
             snap.get("depth_occupancy"),
             self._cfg,
+            burn=burn,
         )
         if tier != self._tier:
             self._transition_tier(tier, depth, snap=snap, frac=frac)
@@ -1010,7 +1152,7 @@ class AgreementService:
         watchdog.start()
         try:
             try:
-                results, run_id = self._execute(live)
+                results, run_id, phases = self._execute(live)
             except Exception as e:  # per-cohort fault isolation
                 att = fault_attribution(e)
                 self._failed_c.inc(len(live))
@@ -1037,7 +1179,10 @@ class AgreementService:
             # next _refresh_tier decays the forced tier 3 normally.
             watchdog.cancel()
             self._wedged = False
-        wall = time.perf_counter() - t0
+        t_retired = time.perf_counter()
+        for t in live:
+            t.retired_t = t_retired
+        wall = t_retired - t0
         self._batch_s = (
             wall
             if self._batch_s is None
@@ -1052,6 +1197,7 @@ class AgreementService:
             self._emit_request(
                 t, status="ok", fault=None,
                 batch=len(live), slot=result["slot"], run_id=run_id,
+                phases=phases,
             )
 
     def _execute(self, live):
@@ -1172,13 +1318,50 @@ class AgreementService:
             if is_scenario:
                 result["leaders"] = [int(v) for v in out["leaders"][:, i]]
             results.append(result)
-        return results, out["stats"]["run_id"]
+        # Engine-side phase walls for the SLO attribution join
+        # (ISSUE 17): every request in the cohort EXPERIENCED the whole
+        # batch's compile and retire-fetch time — attribution reports
+        # latency as felt, it does not cost-split across slots.
+        phases = {
+            "compile_s": out["stats"].get("compile_s", 0.0),
+            "retire_fetch_s": out["stats"].get("retire_fetch_s", 0.0),
+        }
+        return results, out["stats"]["run_id"], phases
 
     # -- records / stats ----------------------------------------------------
 
     def _emit_request(self, ticket, *, status, fault, batch=None,
-                      slot=None, run_id=None) -> None:
+                      slot=None, run_id=None, phases=None) -> None:
+        # Phase decomposition (ISSUE 17): consecutive perf_counter
+        # marks telescope, so for an ok row
+        #   queue_s + coalesce_s + compile_s + dispatch_s + retire_lag_s
+        # sums EXACTLY to wall_s (modulo 6-dp rounding) — the pinned
+        # attribution invariant.  Non-ok rows carry whatever phases
+        # they reached (number-or-null, same keys) so failures are
+        # attributable too, never just ok rows.
         now = time.perf_counter()
+        admitted = ticket.enqueued_t
+        popped = ticket.popped_t
+        dispatched = ticket.dispatched_t
+        retired = ticket.retired_t
+        queue_s = (popped if popped is not None else now) - admitted
+        coalesce_s = compile_s = dispatch_s = retire_lag_s = None
+        if popped is not None and dispatched is not None:
+            coalesce_s = dispatched - popped
+        if status == "ok" and dispatched is not None and retired is not None:
+            compile_s = (phases or {}).get("compile_s", 0.0)
+            fetch_s = (phases or {}).get("retire_fetch_s", 0.0)
+            # dispatch_s is the residual of the engine span: batch
+            # staging + device execution, with the measured compile and
+            # retire-fetch walls attributed to their own phases.
+            dispatch_s = max(
+                0.0, (retired - dispatched) - compile_s - fetch_s
+            )
+            retire_lag_s = fetch_s + (now - retired)
+        elif status == "failed" and dispatched is not None:
+            # A failed cohort's engine span is all dispatch — there is
+            # no retire mark to split against.
+            dispatch_s = now - dispatched
         rec = {
             "event": "request",
             "v": _metrics.SCHEMA_VERSION,
@@ -1186,10 +1369,24 @@ class AgreementService:
             "kind": ticket.request.kind,
             "status": status,
             "rounds": request_rounds(ticket.request),
-            "queue_s": round(
-                (ticket.dispatched_t or now) - ticket.enqueued_t, 6
+            "tenant": ticket.request.tenant,
+            "cohort": cohort_label(
+                cohort_key(ticket.request, self._cfg.engine, self._cfg.m)
             ),
-            "wall_s": round(now - ticket.enqueued_t, 6),
+            "queue_s": round(queue_s, 6),
+            "coalesce_s": (
+                None if coalesce_s is None else round(coalesce_s, 6)
+            ),
+            "compile_s": (
+                None if compile_s is None else round(compile_s, 6)
+            ),
+            "dispatch_s": (
+                None if dispatch_s is None else round(dispatch_s, 6)
+            ),
+            "retire_lag_s": (
+                None if retire_lag_s is None else round(retire_lag_s, 6)
+            ),
+            "wall_s": round(now - admitted, 6),
         }
         if fault is not None:
             rec["fault"] = fault
@@ -1200,6 +1397,8 @@ class AgreementService:
         if run_id is not None:
             rec["run_id"] = run_id
         _metrics.emit(rec)
+        if self._slo is not None:
+            self._slo.fold(rec)
 
     def stats(self) -> dict:
         with self._cond:
@@ -1230,6 +1429,12 @@ class AgreementService:
             ),
             "compiles_on_request_path": self._rpc_n,
             "warm": self._cfg.warm,
+            # ISSUE 17: whether an SLO engine is wired, and how many
+            # reports it has emitted (0 until the sampler cadence hits).
+            "slo": self._slo is not None,
+            "slo_reports": (
+                self._slo.reports if self._slo is not None else 0
+            ),
             # ISSUE 13: the configured default engine dial (per-request
             # overrides ride the cohort key; what actually RAN is the
             # engine's own pipeline_engine gauge + stats).

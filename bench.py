@@ -2049,6 +2049,378 @@ def bench_serving_warm(jax, jnp, jr):
     }
 
 
+def bench_serving_slo(jax, jnp, jr):
+    """SLO-engine config (ISSUE 17 acceptance): does the streaming SLO
+    engine attribute every request's latency, fire/clear its burn alert
+    through a burst, and stay bit-exact + compile-free while doing it?
+
+    One warm service with a LIVE SLO policy serves four phases into a
+    captured metrics stream:
+
+    1. ``serve`` — a mixed-tenant client fleet (tenant per client) over
+       one warmed cohort; per-request bit-exactness vs the B=1 alone
+       refs (``bit_exact_vs_alone``), zero request-path compiles after
+       the warm barrier (``no_request_path_compiles``).
+    2. ``quiet`` — an idle gap longer than the slow burn window, so the
+       healthy traffic ages out of every ring.
+    3. ``burst`` — the committed ``examples/faults/deadline_storm.json``
+       CLIENT plan shapes a storm (slow clients, an abandon, then
+       near-zero deadlines): expired/rejected requests burn error
+       budget until BOTH burn windows exceed threshold — the alert must
+       FIRE (``slo_alert`` state=fire) and an ``autoscale_signal`` must
+       recommend scaling up.
+    4. ``recover`` — the burst drains, the fast window empties, the
+       alert must CLEAR, and a probe request serves normally.
+
+    The acceptance booleans are recomputed from the CAPTURED JSONL (the
+    same stream ``scripts/obs_report.py --slo`` renders), not from
+    in-process state: ``attribution_sums_ok`` (every ok request's five
+    phases telescope to its wall within ATTRIB_TOL_S),
+    ``burn_alert_fired_and_cleared``, ``tenant_accounting_ok`` (final
+    report's per-tenant ok tallies match the fleet), plus the two
+    serving pins above — all asserted, not just recorded.
+    """
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from ba_tpu import obs
+    from ba_tpu.core.state import SimState
+    from ba_tpu.core.types import COMMAND_DTYPE, command_from_name
+    from ba_tpu.obs import slo as slo_mod
+    from ba_tpu.obs.registry import MetricsRegistry
+    from ba_tpu.parallel.pipeline import coalesced_sweep, fresh_copy
+    from ba_tpu.runtime import chaos as chaos_mod
+    from ba_tpu.runtime.serve import (
+        AgreementRequest,
+        AgreementService,
+        Overloaded,
+        ServeConfig,
+    )
+    from ba_tpu.utils import metrics as metrics_mod
+
+    clients = int(os.environ.get("BA_TPU_BENCH_SERVE_CLIENTS", 4))
+    per_client = int(os.environ.get("BA_TPU_BENCH_SERVE_REQS", 3))
+    rounds = int(os.environ.get("BA_TPU_BENCH_SERVE_ROUNDS", 16))
+    max_batch = int(os.environ.get("BA_TPU_BENCH_SERVE_BATCH", 4))
+    burst_n = int(os.environ.get("BA_TPU_BENCH_SLO_BURST", 120))
+    cap = 4
+    fast_w, slow_w = 1.0, 3.0
+
+    def request(c, j, tenant=None):
+        i = c * per_client + j
+        return AgreementRequest(
+            kind="run-rounds",
+            order=("attack", "retreat")[i % 2],
+            n=4,
+            faulty=((2,), (), (1, 3))[i % 3],
+            seed=3000 + i,
+            rounds=rounds,
+            tenant=tenant or f"tenant-{c}",
+        )
+
+    requests = [
+        request(c, j) for c in range(clients) for j in range(per_client)
+    ]
+
+    def alone(req):
+        faulty = np.zeros((1, cap), np.bool_)
+        alive = np.zeros((1, cap), np.bool_)
+        alive[0, : req.n] = True
+        for i in req.faulty:
+            faulty[0, i] = True
+        state = fresh_copy(
+            SimState(
+                order=jnp.full(
+                    (1,), command_from_name(req.order), COMMAND_DTYPE
+                ),
+                leader=jnp.zeros((1,), jnp.int32),
+                faulty=jnp.asarray(faulty),
+                alive=jnp.asarray(alive),
+                ids=jnp.asarray(
+                    np.arange(1, cap + 1, dtype=np.int32)[None, :]
+                ),
+            )
+        )
+        return coalesced_sweep(
+            [jr.key(req.seed)], state, rounds, rounds_per_dispatch=8
+        )
+
+    alone(requests[0])  # B=1 specialization warms off the clock
+    refs = {}
+    for req in requests:
+        out = alone(req)
+        refs[req.seed] = (
+            [int(v) for v in out["decisions"][:, 0]],
+            {
+                name: int(v)
+                for name, v in zip(out["counter_names"], out["counters"][0])
+            },
+        )
+
+    policy = slo_mod.SLOPolicy(
+        objectives=(
+            slo_mod.SLOObjective(
+                name="serve-wall",
+                latency_s=30.0,  # ok == good; expired/rejected burn
+                target=0.5,  # burn = 2 * bad_frac: all-bad burns at 2.0
+                window_s=60.0,
+                fast_window_s=fast_w,
+                slow_window_s=slow_w,
+                burn_threshold=1.5,
+            ),
+        ),
+        report_every_s=0.05,
+    )
+
+    fd, capture = tempfile.mkstemp(suffix=".jsonl")
+    os.close(fd)
+    prev_target = metrics_mod.default_sink().target
+    obs.reset_first_calls()
+    metrics_mod.configure(capture)
+    try:
+        with tempfile.TemporaryDirectory() as aot_dir:
+            svc = AgreementService(
+                ServeConfig(
+                    max_batch=max_batch, max_queue=4 * max_batch,
+                    coalesce_window_s=0.01, rounds_per_dispatch=8,
+                    warm=True, warm_rounds=rounds, aot_cache=aot_dir,
+                    warm_scenarios=False, slo=policy,
+                ),
+                registry=MetricsRegistry(),
+            )
+            t0 = time.perf_counter()
+            svc.open()
+            assert svc.warm_barrier(timeout=600), "warm barrier timed out"
+            t_warmup = time.perf_counter() - t0
+            svc.start()
+
+            # Phase 1: the mixed-tenant fleet.
+            latencies = [0.0] * len(requests)
+            results = {}
+            errors = []
+            lock = threading.Lock()
+
+            def client(c):
+                for j in range(per_client):
+                    req = request(c, j)
+                    t1 = time.perf_counter()
+                    try:
+                        out = svc.submit(req, deadline_s=None).result(
+                            timeout=600
+                        )
+                    except Exception as e:
+                        errors.append(f"{type(e).__name__}: {e}")
+                        return
+                    wall = time.perf_counter() - t1
+                    with lock:
+                        latencies[c * per_client + j] = wall
+                        results[req.seed] = (
+                            out["decisions"], out["counters"]
+                        )
+
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=900)
+            t_serve = time.perf_counter() - t0
+            assert not errors, errors
+            mismatches = [
+                seed for seed, got in results.items() if got != refs[seed]
+            ]
+            assert not mismatches, f"serving diverged: seeds {mismatches}"
+
+            # Phase 2: quiet gap — healthy traffic ages out of the slow
+            # ring (reports keep flowing on the dispatcher's idle ticks).
+            time.sleep(slow_w + 0.3)
+
+            # Phase 3: the storm — committed client plan shapes it; once
+            # deadline_storm fires, the client floods back-to-back with
+            # near-zero budgets: the queue fills (rejects burn), queued
+            # tickets expire at pop (expiries burn), and burn climbs
+            # past threshold in BOTH windows.  Burst traffic carries a
+            # dedicated tenant so the per-tenant accounting pin on the
+            # fleet tenants below stays exact regardless of how the
+            # served/expired race splits inside the storm.
+            storm_plan = chaos_mod.load("examples/faults/deadline_storm.json")
+            injector = chaos_mod.ChaosInjector(storm_plan)
+            storming = threading.Event()
+            burst_counts = {"submitted": 0, "rejected": 0}
+            t0 = time.perf_counter()
+            for ordinal in range(burst_n):
+                for f in injector.client_faults(ordinal):
+                    if f.kind == "slow_client":
+                        time.sleep(f.seconds)
+                    elif f.kind == "deadline_storm":
+                        storming.set()
+                deadline = 0.002 if storming.is_set() else 5.0
+                req = request(
+                    ordinal % clients, ordinal % per_client,
+                    tenant="tenant-burst",
+                )
+                try:
+                    svc.submit(req, deadline_s=deadline)
+                    burst_counts["submitted"] += 1
+                except Overloaded:
+                    burst_counts["rejected"] += 1
+                if not storming.is_set():
+                    time.sleep(0.005)
+            # Drain: every burst ticket popped (expired) or served.
+            for _ in range(600):
+                if svc.stats()["queue_depth"] == 0:
+                    break
+                time.sleep(0.05)
+            t_burst = time.perf_counter() - t0
+
+            # Phase 4: recovery — the fast window empties, the alert
+            # clears, and a probe request serves normally.
+            time.sleep(fast_w + 0.4)
+            probe_req = request(0, 0)
+            probe = None
+            for _ in range(200):
+                try:
+                    probe = svc.submit(probe_req, deadline_s=None).result(
+                        timeout=600
+                    )
+                    break
+                except Overloaded:
+                    time.sleep(0.05)
+            assert probe is not None, "service never recovered post-burst"
+            probe_ok = probe["decisions"] == refs[probe_req.seed][0]
+            stats = svc.stats()
+            svc.stop()
+    finally:
+        metrics_mod.configure(prev_target)
+
+    # Recompute the acceptance booleans from the CAPTURED stream.
+    recs = []
+    with open(capture, encoding="utf-8") as f:
+        for line in f:
+            recs.append(json.loads(line))
+    ok_reqs = [
+        r for r in recs
+        if r.get("event") == "request" and r.get("status") == "ok"
+    ]
+    expired = sum(
+        1
+        for r in recs
+        if r.get("event") == "request" and r.get("status") == "expired"
+    )
+    attrib_bad = []
+    for r in ok_reqs:
+        phases = [r.get(k) for k in slo_mod.PHASES]
+        if not all(isinstance(p, (int, float)) for p in phases) or abs(
+            sum(phases) - r["wall_s"]
+        ) > slo_mod.ATTRIB_TOL_S:
+            attrib_bad.append(r["id"])
+    attribution_sums_ok = not attrib_bad
+    assert attribution_sums_ok, f"attribution broke: request ids {attrib_bad}"
+
+    alerts = [r for r in recs if r.get("event") == "slo_alert"]
+    states = [a["state"] for a in alerts]
+    fired_and_cleared = (
+        "fire" in states
+        and "clear" in states
+        and states.index("fire") < len(states) - 1 - states[::-1].index(
+            "clear"
+        )
+    )
+    assert fired_and_cleared, f"alert lifecycle broke: {states}"
+
+    signals = [r for r in recs if r.get("event") == "autoscale_signal"]
+    scale_up = [s for s in signals if s["recommended"] > s["replicas"]]
+    autoscale_scale_up_ok = bool(scale_up)
+    assert autoscale_scale_up_ok, "no scale-up autoscale_signal in the burst"
+
+    reports = [r for r in recs if r.get("event") == "slo_report"]
+    assert reports, "no slo_report records captured"
+    # Fleet tenants must tally EXACTLY (fleet + probe); the storm rode
+    # a dedicated tenant, so its racy served/expired split lands in its
+    # own group and must show burned budget there.
+    want_ok = {}
+    for req in requests:
+        want_ok[req.tenant] = want_ok.get(req.tenant, 0) + 1
+    want_ok["tenant-0"] += 1  # the recovery probe
+    got_ok = {
+        g["tenant"]: g["counts"].get("ok", 0)
+        for g in reports[-1]["groups"]
+    }
+    burst_burned = sum(
+        g["counts"].get("expired", 0) + g["counts"].get("rejected", 0)
+        for g in reports[-1]["groups"]
+        if g["tenant"] == "tenant-burst"
+    )
+    tenant_accounting_ok = (
+        all(got_ok.get(tenant, 0) == n for tenant, n in want_ok.items())
+        and burst_burned > 0
+    )
+    assert tenant_accounting_ok, (
+        f"want {want_ok}, got {got_ok}, burst burned {burst_burned}"
+    )
+    assert stats["compiles_on_request_path"] == 0, (
+        f"SLO service compiled on the request path "
+        f"({stats['compiles_on_request_path']}x after the barrier)"
+    )
+    os.unlink(capture)  # asserts passed — a failing run keeps its stream
+
+    lat = sorted(latencies)
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    peak_burn = max(
+        (o["burn"] for r in reports for o in r["objectives"]
+         if o["burn"] is not None),
+        default=None,
+    )
+    return {
+        "rounds_per_sec": round(len(requests) * rounds / t_serve, 1),
+        "clients": clients,
+        "requests": len(requests),
+        "tenants": clients,
+        "rounds": rounds,
+        "n_max": cap,
+        "max_batch": max_batch,
+        "warmup_wall_s": round(t_warmup, 4),
+        "serve_elapsed_s": round(t_serve, 4),
+        "p50_latency_s": round(p50, 4),
+        "p99_latency_s": round(p99, 4),
+        "burst_submitted": burst_counts["submitted"],
+        "burst_rejected": burst_counts["rejected"],
+        "burst_expired": expired,
+        "burst_elapsed_s": round(t_burst, 4),
+        "slo_reports": len(reports),
+        "slo_alerts": states,
+        "peak_gate_burn": peak_burn,
+        "attribution_checked": sum(
+            g["attribution_checked"] for g in reports[-1]["groups"]
+        ),
+        "attribution_sums_ok": attribution_sums_ok,
+        "burn_alert_fired_and_cleared": fired_and_cleared,
+        "autoscale_scale_up_ok": autoscale_scale_up_ok,
+        "tenant_accounting_ok": tenant_accounting_ok,
+        "bit_exact_vs_alone": not mismatches and probe_ok,
+        "no_request_path_compiles": (
+            stats["compiles_on_request_path"] == 0
+        ),
+        "bound": "the serve phase is bit-identical to the B=1 alone "
+                 "refs per request (asserted); every acceptance "
+                 "boolean is recomputed from the captured JSONL stream "
+                 "and asserted — a regression fails the bench, it "
+                 "never just flips a committed boolean",
+        "note": "burn windows are deliberately tiny (fast 1 s / slow "
+                "3 s, target 0.5, threshold 1.5) so the committed "
+                "deadline-storm client plan drives a full "
+                "fire->clear alert lifecycle in seconds; phase "
+                "attribution runs through the same warm executables "
+                "the no-compile pin covers",
+    }
+
+
 _MULTICHIP_CHILD = r'''
 import dataclasses, hashlib, json, sys, time
 
@@ -3356,6 +3728,7 @@ CONFIGS = {
     "resilience": bench_resilience,
     "serving": bench_serving,
     "serving_warm": bench_serving_warm,
+    "serving_slo": bench_serving_slo,
     "multichip": bench_multichip,
     "sweep10k_signed": bench_sweep10k_signed,
     "sm1_n64_signed": bench_sm1_n64_signed,
@@ -3372,17 +3745,19 @@ CONFIGS = {
 # the legacy strategy formulation per rep + runs the Pallas interpreter
 # leg (minutes of compile/interpretation by design), and
 # adversary_search runs a multi-generation hunt whose minimizer replays
-# dozens of shrink trials, and signed_throughput runs the signed sweep
-# five times over (pool spawns + a cache-populating pass per leg) —
+# dozens of shrink trials, signed_throughput runs the signed sweep
+# five times over (pool spawns + a cache-populating pass per leg), and
+# serving_slo sleeps through real burn windows (quiet gap + recovery)
+# around a deadline-storm burst —
 # all opt in explicitly: `--configs scenario_long` / `resilience` /
-# `multichip` / `serving` / `serving_warm` / `megastep_ab` /
-# `adversary_search` / `signed_throughput`.
+# `multichip` / `serving` / `serving_warm` / `serving_slo` /
+# `megastep_ab` / `adversary_search` / `signed_throughput`.
 DEFAULT_CONFIGS = [
     n for n in CONFIGS
     if n not in (
         "scenario_long", "resilience", "multichip", "serving",
-        "serving_warm", "megastep_ab", "signed_ab", "adversary_search",
-        "signed_throughput",
+        "serving_warm", "serving_slo", "megastep_ab", "signed_ab",
+        "adversary_search", "signed_throughput",
     )
 ]
 

@@ -56,7 +56,8 @@ _RATIO_KEY = re.compile(r"(speedup|_ratio|ratio_|overhead_frac|overhead_pct)")
 _ACCEPT_KEY = re.compile(
     r"(within|bounded|bit_exact|_ok$|^ok$|recovery_within"
     r"|no_request_path_compiles"  # ISSUE 11: the warm-serving boolean
-    r"|speedup_ge)"  # ISSUE 16: signed_throughput's speedup_ge_3x gate
+    r"|speedup_ge"  # ISSUE 16: signed_throughput's speedup_ge_3x gate
+    r"|fired_and_cleared)"  # ISSUE 17: serving_slo burn-alert lifecycle
 )
 
 

@@ -324,6 +324,51 @@ def main() -> int:
             )
             return 1
 
+        # SLO-engine records (ISSUE 17): a TWO-TENANT serve session with
+        # an installed SLO policy drives the real slo_report /
+        # slo_alert / autoscale_signal emitters (every one
+        # run_id-stamped — the RUN_SCOPED_EVENTS contract) plus the
+        # tenant/cohort/phase-decomposition fields on request records;
+        # stop() forces a final report, so at least one of each
+        # reporting family is guaranteed.  The 1 ms latency objective
+        # is unmeetable by construction — the burn alert must FIRE,
+        # giving the slo_alert validator a real record.
+        from ba_tpu.obs import slo as _slo
+
+        slo_policy = _slo.SLOPolicy(
+            objectives=(
+                _slo.SLOObjective(
+                    name="ci-wall", latency_s=0.001, target=0.5,
+                    window_s=60.0, fast_window_s=5.0, slow_window_s=10.0,
+                    burn_threshold=1.5,
+                ),
+            ),
+            report_every_s=0.01,
+        )
+        slo_svc = AgreementService(
+            ServeConfig(
+                max_batch=2, max_queue=4, coalesce_window_s=0.005,
+                rounds_per_dispatch=2, slo=slo_policy,
+            )
+        )
+        slo_svc.start()
+        slo_tickets = [
+            slo_svc.submit(
+                AgreementRequest(
+                    kind="run-rounds", n=4, seed=20 + i, rounds=2,
+                    tenant=("tenant-a" if i % 2 == 0 else "tenant-b"),
+                )
+            )
+            for i in range(4)
+        ]
+        for t in slo_tickets:
+            t.result(timeout=300)
+        slo_stats = slo_svc.stats()
+        slo_svc.stop()
+        if not slo_stats["slo"]:
+            print("schema check: SLO engine not wired", file=sys.stderr)
+            return 1
+
         obs.default_registry().emit_snapshot(sink=sink, source="ci-check")
         sink.close()
 
@@ -622,6 +667,20 @@ def main() -> int:
                     and isinstance(rec.get("rounds"), int)
                     and isinstance(rec.get("queue_s"), (int, float))
                     and isinstance(rec.get("wall_s"), (int, float))
+                    # SLO attribution (ISSUE 17): every terminal record
+                    # carries the tenant label (string or null), the
+                    # human cohort label, and ALL five phase fields
+                    # (number-or-null — non-ok rows null what they
+                    # never reached).
+                    and (
+                        rec.get("tenant") is None
+                        or isinstance(rec.get("tenant"), str)
+                    )
+                    and isinstance(rec.get("cohort"), str)
+                    and _num_or_null(rec.get("coalesce_s"))
+                    and _num_or_null(rec.get("compile_s"))
+                    and _num_or_null(rec.get("dispatch_s"))
+                    and _num_or_null(rec.get("retire_lag_s"))
                 )
                 if ok_shape and rec["status"] == "ok":
                     ok_shape = (
@@ -629,6 +688,28 @@ def main() -> int:
                         and isinstance(rec.get("batch"), int)
                         and isinstance(rec.get("slot"), int)
                     )
+                    # ok rows have the full decomposition: all five
+                    # phases numeric and telescoping to the wall.
+                    phases = [
+                        rec.get(k)
+                        for k in (
+                            "queue_s", "coalesce_s", "compile_s",
+                            "dispatch_s", "retire_lag_s",
+                        )
+                    ]
+                    ok_shape = ok_shape and all(
+                        isinstance(p, (int, float)) for p in phases
+                    )
+                    if ok_shape and abs(
+                        sum(phases) - rec["wall_s"]
+                    ) > 2e-3:
+                        print(
+                            f"schema check: line {i} request phase sum "
+                            f"{sum(phases):.6f} != wall "
+                            f"{rec['wall_s']:.6f}",
+                            file=sys.stderr,
+                        )
+                        bad += 1
                 if ok_shape and rec["status"] == "failed":
                     ok_shape = rec.get("fault") in (
                         None, "transient", "fatal", "oom",
@@ -650,10 +731,110 @@ def main() -> int:
                     and isinstance(rec.get("queue_limit"), int)
                     and isinstance(rec.get("retry_after_s"), (int, float))
                     and rec.get("retry_after_s") > 0
+                    # ISSUE 17: rejects carry tenant/cohort so the SLO
+                    # engine can charge them to the right group.
+                    and (
+                        rec.get("tenant") is None
+                        or isinstance(rec.get("tenant"), str)
+                    )
+                    and isinstance(rec.get("cohort"), str)
                 ):
                     print(
                         f"schema check: line {i} malformed admission: "
                         f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "slo_report":
+                # SLO engine (ISSUE 17): per-window report — run_id
+                # required (RUN_SCOPED_EVENTS), groups keyed by
+                # (cohort, tenant), objectives carry burn rates.
+                groups = rec.get("groups")
+                objectives = rec.get("objectives")
+                ok_shape = (
+                    _flight.valid_run_id(rec.get("run_id"))
+                    and isinstance(groups, list)
+                    and isinstance(objectives, list)
+                    and _num_or_null(rec.get("worst_burn"))
+                    and _num_or_null(rec.get("worst_p99_s"))
+                )
+                if ok_shape:
+                    for g in groups:
+                        if not (
+                            isinstance(g, dict)
+                            and isinstance(g.get("cohort"), str)
+                            and isinstance(g.get("tenant"), str)
+                            and isinstance(g.get("window_events"), int)
+                            and isinstance(g.get("counts"), dict)
+                            and all(
+                                isinstance(v, int)
+                                for v in g["counts"].values()
+                            )
+                            and isinstance(g.get("phases"), dict)
+                            and all(
+                                isinstance(ph, dict)
+                                and _num_or_null(ph.get("p50"))
+                                and _num_or_null(ph.get("p99"))
+                                for ph in g["phases"].values()
+                            )
+                            and isinstance(
+                                g.get("attribution_checked"), int
+                            )
+                            and isinstance(g.get("attribution_bad"), int)
+                        ):
+                            ok_shape = False
+                    for o in objectives:
+                        if not (
+                            isinstance(o, dict)
+                            and isinstance(o.get("name"), str)
+                            and isinstance(o.get("target"), (int, float))
+                            and isinstance(
+                                o.get("latency_s"), (int, float)
+                            )
+                            and isinstance(o.get("good"), int)
+                            and isinstance(o.get("bad"), int)
+                            and _num_or_null(o.get("burn_fast"))
+                            and _num_or_null(o.get("burn_slow"))
+                            and _num_or_null(o.get("burn"))
+                            and _num_or_null(o.get("budget_remaining"))
+                            and isinstance(o.get("alerting"), bool)
+                        ):
+                            ok_shape = False
+                if not ok_shape:
+                    print(
+                        f"schema check: line {i} malformed slo_report: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "slo_alert":
+                if not (
+                    _flight.valid_run_id(rec.get("run_id"))
+                    and isinstance(rec.get("objective"), str)
+                    and rec.get("state") in ("fire", "clear")
+                    and isinstance(rec.get("burn_fast"), (int, float))
+                    and isinstance(rec.get("burn_slow"), (int, float))
+                    and isinstance(rec.get("threshold"), (int, float))
+                ):
+                    print(
+                        f"schema check: line {i} malformed slo_alert: "
+                        f"{line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
+            elif rec.get("event") == "autoscale_signal":
+                if not (
+                    _flight.valid_run_id(rec.get("run_id"))
+                    and isinstance(rec.get("queue_frac"), (int, float))
+                    and _num_or_null(rec.get("burn"))
+                    and isinstance(rec.get("replicas"), int)
+                    and isinstance(rec.get("recommended"), int)
+                    and rec.get("recommended") >= 1
+                    and isinstance(rec.get("reason"), str)
+                ):
+                    print(
+                        f"schema check: line {i} malformed "
+                        f"autoscale_signal: {line[:160]}",
                         file=sys.stderr,
                     )
                     bad += 1
@@ -887,6 +1068,9 @@ def main() -> int:
             "search_found",
             "search_minimized",
             "search_checkpoint",
+            "slo_report",
+            "slo_alert",
+            "autoscale_signal",
         }
         if not want <= events:
             print(

@@ -192,6 +192,12 @@ mutate_and_expect BA301 runtime/warmup.py \
     'from ba_tpu.parallel import pipeline as _mut_engine' || exit 1
 mutate_and_expect BA301 obs/aotcache.py \
     'from ba_tpu.core import om as _mut_core' || exit 1
+# ISSUE 17: the SLO engine is an obs module — the STRICTER obs rule
+# (even function-local core/ops imports are breaches) covers it
+# automatically via the ba_tpu.obs.* scope.  Prove the coverage is
+# live, not just inherited on paper.
+mutate_and_expect BA301 obs/slo.py \
+    'from ba_tpu.core import om as _mut_core' || exit 1
 # ISSUE 15: the adversary search loop (search/loop.py) joined the BA101
 # hot-path scope — its generation loop drives the coalesced engine's
 # dispatch stream, and a host sync there would serialize population
@@ -224,6 +230,17 @@ fi
 # tests/test_search.py).
 if ! python -m ba_tpu.search corpus examples/scenarios/found; then
     echo "search corpus validation failed" >&2
+    exit 1
+fi
+
+echo "== SLO policy round-trip (jax-free) =="
+# ISSUE 17: the committed SLO policy must load, eagerly validate, and
+# round-trip exactly through to_doc/from_doc — `python -m
+# ba_tpu.obs.slo` is jax-free by construction (subprocess-pinned in
+# tests/test_slo.py), so this mirrors the scenario/chaos stages above
+# at the same sub-second cost.
+if ! python -m ba_tpu.obs.slo validate examples/slo/*.json; then
+    echo "SLO policy validation failed" >&2
     exit 1
 fi
 

@@ -252,6 +252,112 @@ def report_flight(path: str, run_id: str | None = None) -> int:
     return 0
 
 
+def report_slo(path: str, run_id: str | None = None) -> int:
+    """Render the SLO stream (ISSUE 17): the last ``slo_report``'s
+    per-(cohort, tenant) phase-attribution table, the per-objective
+    error-budget timeline across every report, and the alert /
+    autoscale trails.  Stdlib-only like everything in this script."""
+    reports: list = []
+    alerts: list = []
+    signals: list = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if run_id is not None and rec.get("run_id") != run_id:
+                continue
+            if rec.get("event") == "slo_report":
+                reports.append(rec)
+            elif rec.get("event") == "slo_alert":
+                alerts.append(rec)
+            elif rec.get("event") == "autoscale_signal":
+                signals.append(rec)
+    if not reports:
+        which = f" for run {run_id!r}" if run_id else ""
+        print(
+            f"(no slo_report record{which} in {path} — was the service "
+            f"run with an SLO policy and a file-backed metrics sink?)",
+            file=sys.stderr,
+        )
+        return 1
+    # The attribution quantiles are PER-REPORT-WINDOW deltas; the last
+    # report of a drained service usually saw an empty window.  Render
+    # the freshest report that actually observed traffic.
+    last = reports[-1]
+    for rep in reversed(reports):
+        if any(g.get("window_events") for g in rep.get("groups", [])):
+            last = rep
+            break
+    print(f"== slo attribution ({last.get('run_id')}) ==")
+    print(
+        f"  {'cohort':<26} {'tenant':<10} {'ok':>5} {'exp':>5} "
+        f"{'rej':>5} {'fail':>5} {'wall p99':>10} {'dominant phase':>22}"
+    )
+    phase_names = (
+        "queue_s", "coalesce_s", "compile_s", "dispatch_s", "retire_lag_s"
+    )
+    for g in last.get("groups", []):
+        phases = g.get("phases", {})
+        p99s = {
+            n: (phases.get(n, {}).get("p99") or 0.0) for n in phase_names
+        }
+        dom = max(p99s, key=p99s.get) if any(p99s.values()) else "-"
+        wall = phases.get("wall_s", {}).get("p99")
+        counts = g.get("counts", {})
+        print(
+            f"  {g.get('cohort', '?'):<26} {g.get('tenant', '?'):<10} "
+            f"{counts.get('ok', 0):>5} {counts.get('expired', 0):>5} "
+            f"{counts.get('rejected', 0):>5} {counts.get('failed', 0):>5} "
+            f"{_fmt_s(wall) if wall is not None else '-':>10} "
+            f"{dom + ' ' + _fmt_s(p99s[dom]) if dom != '-' else '-':>22}"
+        )
+        bad = g.get("attribution_bad", 0)
+        if bad:
+            print(
+                f"    !! {bad}/{g.get('attribution_checked')} requests "
+                f"failed sum(phases) ~= wall"
+            )
+    print("== error-budget timeline ==")
+    print(
+        f"  {'ts':>14} {'objective':<16} {'burn':>8} {'fast':>8} "
+        f"{'slow':>8} {'budget':>8} {'alert':>6}"
+    )
+    for rep in reports:
+        ts = rep.get("ts")
+        for o in rep.get("objectives", []):
+            print(
+                f"  {ts if ts is not None else '-':>14} "
+                f"{o.get('name', '?'):<16} "
+                f"{o.get('burn') if o.get('burn') is not None else '-':>8} "
+                f"{o.get('burn_fast') if o.get('burn_fast') is not None else '-':>8} "
+                f"{o.get('burn_slow') if o.get('burn_slow') is not None else '-':>8} "
+                f"{o.get('budget_remaining') if o.get('budget_remaining') is not None else '-':>8} "
+                f"{'FIRE' if o.get('alerting') else 'ok':>6}"
+            )
+    if alerts:
+        print("== alerts ==")
+        for a in alerts:
+            print(
+                f"  {a.get('ts', '-'):>14} {a.get('objective'):<16} "
+                f"{a.get('state'):<6} fast={a.get('burn_fast')} "
+                f"slow={a.get('burn_slow')} threshold={a.get('threshold')}"
+            )
+    if signals:
+        print("== autoscale signals ==")
+        for s in signals[-10:]:
+            print(
+                f"  {s.get('ts', '-'):>14} replicas {s.get('replicas')} "
+                f"-> {s.get('recommended')} ({s.get('reason')}; "
+                f"queue_frac={s.get('queue_frac')} burn={s.get('burn')})"
+            )
+    return 0
+
+
 def report_metrics(path: str) -> None:
     events: dict = {}
     snapshot = None
@@ -320,6 +426,10 @@ def main() -> int:
     ap.add_argument("--run-id", default=None,
                     help="which run's flight to render (default: the "
                          "stream's last flight_summary)")
+    ap.add_argument("--slo", action="store_true",
+                    help="render the SLO stream (ISSUE 17): phase "
+                         "attribution table, error-budget timeline, "
+                         "alert + autoscale trails")
     args = ap.parse_args()
     trace, metrics = args.trace, args.metrics
     if args.dir:
@@ -332,6 +442,11 @@ def main() -> int:
             print(f"(missing: {metrics})", file=sys.stderr)
             return 1
         return report_flight(metrics, run_id=args.run_id)
+    if args.slo:
+        if not metrics or not os.path.exists(metrics):
+            print(f"(missing: {metrics})", file=sys.stderr)
+            return 1
+        return report_slo(metrics, run_id=args.run_id)
     found = False
     for path, render in ((trace, report_trace), (metrics, report_metrics)):
         if path and os.path.exists(path):

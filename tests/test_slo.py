@@ -1,0 +1,552 @@
+"""SLO engine tests (ISSUE 17).
+
+Two layers, mirroring tests/test_serve.py's split:
+
+- the jax-free streaming layer: policy grammar + round-trip, the
+  promoted ``registry.delta_quantile`` helper, window rings, the
+  replica-recommendation ladder, deterministic burn-alert fire/clear on
+  a synthetic record stream with an injected clock, and the
+  ``python -m ba_tpu.obs.slo`` CLI subprocess pin;
+- the engine-backed serving layer: the ATTRIBUTION-SUM invariant
+  (``sum(phases) ≈ wall_s`` on every ok record, pinned under a chaos
+  retire stall that inflates exactly one phase), per-tenant accounting
+  inside ONE coalesced batch, and the no-blocking proof with a live
+  installed engine (reports ride the health sampler's host_work slot —
+  zero added syncs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ba_tpu.obs import health, slo
+from ba_tpu.obs.registry import Histogram, MetricsRegistry, delta_quantile
+from ba_tpu.runtime import chaos
+from ba_tpu.runtime.serve import (
+    COLD_RETRY_AFTER_S,
+    AgreementRequest,
+    AgreementService,
+    ServeConfig,
+    cohort_key,
+    cohort_label,
+    shed_tier,
+)
+from ba_tpu.utils import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- jax-free streaming layer -------------------------------------------------
+
+
+def test_delta_quantile_promoted_and_shared():
+    # ISSUE 17 satellite: the windowed-quantile helper is PUBLIC on the
+    # registry module (the repo's one implementation) and the health
+    # sampler's old private name delegates to it bit-for-bit.
+    hist = Histogram(threading.Lock())
+    for v in (0.001, 0.002, 0.004, 0.1):
+        hist.record(v)
+    base = hist.peek()["counts"]
+    for v in (0.01, 0.02, 0.03):
+        hist.record(v)
+    now = hist.peek()["counts"]
+    # Windowed: only the 3 post-baseline values count; the p50 upper
+    # edge must cover 0.02 but not the baseline's 0.1.
+    p50 = delta_quantile(hist, base, now, 0.5)
+    assert p50 is not None and 0.02 <= p50 < 0.1
+    assert delta_quantile(hist, base, base, 0.5) is None  # empty window
+    # Full-history (no baseline) agrees between public and health alias.
+    assert health._delta_quantile(hist, None, now, 0.99) == delta_quantile(
+        hist, None, now, 0.99
+    )
+    # Overflow bucket reads as +inf (callers null it for strict JSON).
+    hist.record(1e9)
+    assert delta_quantile(hist, now, hist.peek()["counts"], 0.99) == float(
+        "inf"
+    )
+
+
+def test_policy_validation_and_round_trip():
+    with pytest.raises(slo.SLOPolicyError):
+        slo.SLOPolicy(objectives=())
+    with pytest.raises(slo.SLOPolicyError):  # duplicate names
+        slo.SLOPolicy(
+            objectives=(
+                slo.SLOObjective(name="a", latency_s=0.1),
+                slo.SLOObjective(name="a", latency_s=0.2),
+            )
+        )
+    with pytest.raises(slo.SLOPolicyError):  # target must be in (0, 1)
+        slo.SLOObjective(name="a", latency_s=0.1, target=1.0)
+    with pytest.raises(slo.SLOPolicyError):  # window nesting
+        slo.SLOObjective(
+            name="a", latency_s=0.1, fast_window_s=60.0, slow_window_s=30.0
+        )
+    with pytest.raises(slo.SLOPolicyError):
+        slo.SLOObjective(name="a", latency_s=0.0)
+    # to_doc -> from_doc is a fixed point (the CLI's validate pin).
+    pol = slo.default_policy()
+    doc = pol.to_doc()
+    assert slo.SLOPolicy.from_doc(doc).to_doc() == doc
+    assert doc["format"] == slo.POLICY_FORMAT and doc["v"] == 1
+    with pytest.raises(slo.SLOPolicyError):  # unknown keys rejected
+        slo.SLOPolicy.from_doc({**doc, "surprise": 1})
+    bad_obj = {**doc, "objectives": [{**doc["objectives"][0], "oops": 2}]}
+    with pytest.raises(slo.SLOPolicyError):
+        slo.SLOPolicy.from_doc(bad_obj)
+    # The committed example policy loads and round-trips too.
+    committed = slo.SLOPolicy.load(
+        os.path.join(REPO, "examples", "slo", "serving.json")
+    )
+    assert slo.SLOPolicy.from_doc(committed.to_doc()) == committed
+
+
+def test_window_ring_slides_and_resets():
+    ring = slo._WindowRing(12.0, n_slots=12)  # 1 s buckets
+    ring.add(0.5, good=2)
+    ring.add(5.5, bad=3)
+    assert ring.totals(5.9) == (2, 3)
+    # 12 s later the first bucket's epoch has fallen out of the window.
+    assert ring.totals(12.5) == (0, 3)
+    assert ring.totals(30.0) == (0, 0)
+    # Epoch reuse: a new event in a recycled slot resets it lazily.
+    ring.add(24.5, good=1)  # same slot index as t=0.5
+    assert ring.totals(24.9) == (1, 0)
+
+
+def test_recommend_replicas_ladder():
+    assert slo.recommend_replicas(0.0, None) == (1, "steady")
+    assert slo.recommend_replicas(0.0, 2.0, replicas=2) == (4, "burn_hard")
+    assert slo.recommend_replicas(0.9, 0.0, replicas=3) == (6, "queue_hard")
+    assert slo.recommend_replicas(0.0, 1.0) == (2, "burn_soft")
+    assert slo.recommend_replicas(0.5, 0.0) == (2, "queue_soft")
+    assert slo.recommend_replicas(0.0, 0.0, replicas=2) == (1, "decay")
+    assert slo.recommend_replicas(0.3, 0.6, replicas=2) == (2, "steady")
+    # The cap binds the doubling branch.
+    assert slo.recommend_replicas(1.0, 9.0, replicas=6, max_replicas=8) == (
+        8,
+        "burn_hard",
+    )
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, rec):
+        self.records.append(rec)
+
+
+def _req(status="ok", wall=0.01, cohort="plain.r2.c4.xla.m1", tenant="t0"):
+    phases = {
+        "queue_s": wall * 0.2,
+        "coalesce_s": wall * 0.1,
+        "compile_s": 0.0,
+        "dispatch_s": wall * 0.5,
+        "retire_lag_s": wall * 0.2,
+    }
+    return {
+        "event": "request",
+        "v": 1,
+        "status": status,
+        "kind": "run-rounds",
+        "cohort": cohort,
+        "tenant": tenant,
+        "wall_s": wall,
+        **phases,
+    }
+
+
+def test_burn_alert_fire_and_clear_deterministic():
+    # Synthetic stream + injected clock: the alert must FIRE only once
+    # both windows burn past threshold, and CLEAR only once the fast
+    # window recovers — exact transition records, no flapping.
+    t = [0.0]
+    pol = slo.SLOPolicy(
+        objectives=(
+            slo.SLOObjective(
+                name="wall",
+                latency_s=0.05,
+                target=0.5,  # burn = 2 * bad_frac
+                window_s=120.0,
+                fast_window_s=10.0,
+                slow_window_s=40.0,
+                burn_threshold=1.5,
+            ),
+        ),
+        report_every_s=0.001,
+    )
+    eng = slo.SLOEngine(pol, registry=MetricsRegistry(), clock=lambda: t[0])
+    sink = _ListSink()
+
+    def alerts():
+        return [r for r in sink.records if r["event"] == "slo_alert"]
+
+    # Healthy traffic: slow window fills with good events.
+    for i in range(40):
+        t[0] = i * 1.0
+        eng.fold(_req(wall=0.01))
+    eng.maybe_report(force=True, sink=sink)
+    assert alerts() == []
+    # Short burst of SLO misses: the fast window saturates immediately
+    # but the slow window still remembers the healthy traffic — NO fire
+    # yet (fast alone is noise; this is the multi-window point).
+    for i in range(10):
+        t[0] = 40.0 + i
+        eng.fold(_req(wall=0.5))
+    eng.maybe_report(force=True, sink=sink)
+    assert alerts() == []
+    # Sustained burn: the slow window turns over too -> exactly one
+    # fire transition.
+    for i in range(26):
+        t[0] = 50.0 + i
+        eng.fold(_req(wall=0.5))
+    eng.maybe_report(force=True, sink=sink)
+    fired = alerts()
+    assert [a["state"] for a in fired] == ["fire"]
+    assert fired[0]["objective"] == "wall"
+    assert fired[0]["burn_fast"] >= 1.5 and fired[0]["burn_slow"] >= 1.5
+    assert slo._burn(0, 10, 0.5) == 2.0  # the arithmetic the gate used
+    # Still burning: no duplicate fire records (transitions only).
+    t[0] = 76.0
+    eng.fold(_req(wall=0.5))
+    eng.maybe_report(force=True, sink=sink)
+    assert [a["state"] for a in alerts()] == ["fire"]
+    # Recovery: good traffic refills the fast window -> clear.
+    for i in range(10):
+        t[0] = 77.0 + i
+        eng.fold(_req(wall=0.01))
+    eng.maybe_report(force=True, sink=sink)
+    assert [a["state"] for a in alerts()] == ["fire", "clear"]
+    # The gate gauge tracked the transitions (worst burn, 0 when the
+    # window empties).
+    reports = [r for r in sink.records if r["event"] == "slo_report"]
+    assert all(slo._flight.valid_run_id(r["run_id"]) for r in reports)
+    assert reports[-1]["objectives"][0]["alerting"] is False
+
+
+def test_engine_folds_rejects_and_autoscale_signal():
+    t = [100.0]
+    reg = MetricsRegistry()
+    eng = slo.SLOEngine(
+        slo.SLOPolicy(
+            objectives=(
+                slo.SLOObjective(
+                    name="wall", latency_s=0.05, target=0.5,
+                    window_s=120.0, fast_window_s=10.0, slow_window_s=20.0,
+                    burn_threshold=1.5,
+                ),
+            ),
+            report_every_s=0.001,
+        ),
+        registry=reg,
+        clock=lambda: t[0],
+    )
+    eng.fold(
+        {
+            "event": "admission",
+            "v": 1,
+            "decision": "reject",
+            "reason": "queue_full",
+            "kind": "run-rounds",
+            "cohort": "plain.r2.c4.xla.m1",
+            "tenant": "t9",
+        }
+    )
+    eng.queue_frac = 0.9
+    sink = _ListSink()
+    eng.maybe_report(force=True, sink=sink)
+    (report,) = [r for r in sink.records if r["event"] == "slo_report"]
+    (g,) = report["groups"]
+    assert g["tenant"] == "t9" and g["counts"]["rejected"] == 1
+    assert g["reject_reasons"] == {"queue_full": 1}
+    # Rejected work burns budget: one bad event, burn = 2.0.
+    assert report["objectives"][0]["burn"] == 2.0
+    (sig,) = [r for r in sink.records if r["event"] == "autoscale_signal"]
+    assert sig["queue_frac"] == 0.9
+    assert sig["recommended"] == 2 and sig["reason"] == "burn_hard"
+    assert reg.get("health_slo_burn").value == 2.0
+
+
+def test_slo_cli_jax_free_subprocess():
+    # The BA301 obs-tier contract, proven at runtime: validating the
+    # committed policy through the CLI must never import jax (the CI
+    # round-trip stage depends on it).
+    code = (
+        "import sys; from ba_tpu.obs import slo; "
+        "rc = slo.main(['validate', 'examples/slo/serving.json']); "
+        "assert 'jax' not in sys.modules, 'slo CLI pulled jax'; "
+        "sys.exit(rc)"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "OK" in out.stdout
+
+
+def test_shed_tier_burn_signal():
+    cfg = ServeConfig()
+    # None (no engine installed / no data) never raises the tier.
+    assert shed_tier(0.0, None, None, cfg, burn=None) == 0
+    assert shed_tier(0.0, None, None, cfg, burn=cfg.burn_soft - 0.01) == 0
+    assert shed_tier(0.0, None, None, cfg, burn=cfg.burn_soft) == 1
+    assert shed_tier(0.0, None, None, cfg, burn=cfg.burn_hard) == 2
+    # Queue-full still beats everything.
+    assert shed_tier(1.0, None, None, cfg, burn=cfg.burn_hard) == 3
+    with pytest.raises(ValueError):
+        ServeConfig(burn_soft=9.0, burn_hard=1.0)
+
+
+def test_cold_retry_after_and_cohort_label_and_tenant_validation():
+    assert COLD_RETRY_AFTER_S == 0.1  # documented cold-start default
+    req = AgreementRequest(kind="run-rounds", n=4, seed=1, rounds=2)
+    assert cohort_label(cohort_key(req)) == "plain.r2.c4.xla.m1"
+    signed = AgreementRequest(
+        kind="run-rounds", n=4, seed=1, rounds=2, signed=True
+    )
+    assert cohort_label(cohort_key(signed)).endswith(".signed")
+    scen = AgreementRequest(kind="scenario", n=4, seed=2, spec=None)
+    # tenant is NOT part of the cohort key: accounting, not isolation.
+    a = AgreementRequest(kind="run-rounds", n=4, rounds=2, tenant="a")
+    b = AgreementRequest(kind="run-rounds", n=4, rounds=2, tenant="b")
+    assert cohort_key(a) == cohort_key(b)
+    del scen
+    from ba_tpu.runtime.serve import validate_request
+
+    with pytest.raises(ValueError):
+        validate_request(
+            AgreementRequest(kind="run-rounds", rounds=2, tenant="")
+        )
+    with pytest.raises(ValueError):
+        validate_request(
+            AgreementRequest(kind="run-rounds", rounds=2, tenant=7)
+        )
+
+
+# -- engine-backed serving layer ---------------------------------------------
+
+
+def _drain_requests(path):
+    recs = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("event") == "request":
+                recs.append(rec)
+    return recs
+
+
+def test_attribution_sum_under_retire_stall(tmp_path):
+    # The acceptance invariant: sum(phases) ≈ wall_s on EVERY ok
+    # record — pinned where it is hardest, under a chaos retire stall
+    # that inflates the retire-fetch leg by ~0.3 s.  The stall must
+    # land in retire_lag_s (the fetch is part of delivered latency),
+    # not smear into dispatch_s.
+    plan = chaos.from_dict(
+        {
+            "name": "slow-retire",
+            "faults": [
+                {
+                    "round": 0,
+                    "kind": "stall",
+                    "phase": "retire",
+                    "seconds": 0.3,
+                }
+            ],
+        }
+    )
+    sink_path = tmp_path / "slo_stall.jsonl"
+    metrics.configure(str(sink_path))
+    try:
+        svc = AgreementService(
+            ServeConfig(
+                max_batch=2,
+                max_queue=8,
+                coalesce_window_s=0.001,
+                rounds_per_dispatch=2,
+                slo=True,
+            ),
+            fault_plan=plan,
+            registry=MetricsRegistry(),
+        )
+        svc.start()
+        out = svc.submit(
+            AgreementRequest(
+                kind="run-rounds", n=4, seed=90, rounds=2, tenant="stall"
+            )
+        ).result(timeout=300)
+        assert out["counts"]
+        stats = svc.stats()
+        svc.stop()
+    finally:
+        metrics.configure(None)
+    assert stats["slo"] and stats["slo_reports"] >= 1
+    recs = [r for r in _drain_requests(sink_path) if r["status"] == "ok"]
+    assert recs, "no ok request records emitted"
+    for rec in recs:
+        phases = [rec[k] for k in slo.PHASES]
+        assert all(isinstance(p, (int, float)) for p in phases)
+        assert abs(sum(phases) - rec["wall_s"]) <= slo.ATTRIB_TOL_S
+        assert rec["tenant"] == "stall"
+    # The 0.3 s stall is attributed to the retire leg.
+    assert max(r["retire_lag_s"] for r in recs) >= 0.25
+
+
+def test_per_tenant_accounting_single_coalesced_batch(tmp_path):
+    # Two tenants coalesced into ONE batch (same cohort) must land in
+    # two distinct SLO groups with one ok each — per-tenant accounting
+    # is row-level, not batch-level.
+    sink_path = tmp_path / "slo_tenants.jsonl"
+    metrics.configure(str(sink_path))
+    try:
+        svc = AgreementService(
+            ServeConfig(
+                max_batch=2,
+                max_queue=8,
+                coalesce_window_s=0.2,
+                rounds_per_dispatch=2,
+                slo=slo.SLOPolicy(
+                    objectives=(
+                        slo.SLOObjective(name="wall", latency_s=30.0),
+                    ),
+                    report_every_s=0.001,
+                ),
+            ),
+            registry=MetricsRegistry(),
+        )
+        svc.open()
+        ta = svc.submit(
+            AgreementRequest(
+                kind="run-rounds", n=4, seed=91, rounds=2, tenant="alpha"
+            )
+        )
+        tb = svc.submit(
+            AgreementRequest(
+                kind="run-rounds", n=4, seed=92, rounds=2, tenant="beta"
+            )
+        )
+        svc.start()
+        ta.result(timeout=300)
+        tb.result(timeout=300)
+        svc.stop()
+    finally:
+        metrics.configure(None)
+    recs = _drain_requests(sink_path)
+    ok = [r for r in recs if r["status"] == "ok"]
+    assert len(ok) == 2
+    # ONE coalesced batch: same cohort run_id and batch counter,
+    # different slots, different tenants.
+    assert ok[0]["run_id"] == ok[1]["run_id"]
+    assert ok[0]["batch"] == ok[1]["batch"]
+    assert {r["slot"] for r in ok} == {0, 1}
+    assert {r["tenant"] for r in ok} == {"alpha", "beta"}
+    # And the engine's final forced report (stop()) split the groups.
+    reports = []
+    with open(sink_path, encoding="utf-8") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("event") == "slo_report":
+                reports.append(rec)
+    assert reports
+    tallies = {}
+    for rep in reports:
+        for g in rep["groups"]:
+            tallies[g["tenant"]] = (
+                tallies.get(g["tenant"], 0) + g["counts"]["ok"]
+            )
+    # counts are cumulative per group; the LAST report has the totals.
+    last = {g["tenant"]: g["counts"]["ok"] for g in reports[-1]["groups"]}
+    assert last == {"alpha": 1, "beta": 1}
+    for g in reports[-1]["groups"]:
+        assert g["attribution_checked"] == 1 and g["attribution_bad"] == 0
+        assert g["cohort"] == "plain.r2.c4.xla.m1"
+
+
+def test_no_blocking_with_slo_engine_installed(monkeypatch):
+    # Zero added syncs: with a live installed SLO engine riding the
+    # health sampler's cadence (health_every=1 — every window), the
+    # engine still never calls block_until_ready and the depth-k
+    # dispatch/retire schedule is unchanged.
+    import jax
+    import jax.random as jr
+
+    from ba_tpu.parallel import make_sweep_state, pipeline_sweep
+
+    eng = slo.SLOEngine(
+        slo.default_policy(), registry=MetricsRegistry()
+    )
+    slo.install(eng)
+    try:
+
+        def _forbidden(*a, **k):
+            raise AssertionError(
+                "block_until_ready called with SLO engine installed"
+            )
+
+        monkeypatch.setattr(jax, "block_until_ready", _forbidden)
+        B, cap, R, depth = 8, 8, 7, 3
+        state = make_sweep_state(jr.key(5), B, cap)
+        events = []
+        out = pipeline_sweep(
+            jr.key(23),
+            state,
+            R,
+            depth=depth,
+            rounds_per_dispatch=1,
+            health_every=1,
+            on_event=lambda kind, i: events.append((kind, i)),
+        )
+        dispatches = [i for kind, i in events if kind == "dispatch"]
+        retires = [i for kind, i in events if kind == "retire"]
+        assert dispatches == list(range(R))
+        assert retires == list(range(R))
+        first_retire = events.index(("retire", 0))
+        assert events[:first_retire] == [
+            ("dispatch", i) for i in range(depth + 1)
+        ]
+        assert out["stats"]["max_in_flight"] == depth + 1
+    finally:
+        slo.install(None)
+    assert slo.installed() is None
+
+
+def test_repl_stats_live_slo_line():
+    # REPL satellite: one lock-free SLO line when an engine with a
+    # report exists; nothing (and no error) when none is installed.
+    from ba_tpu.runtime.backends import PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    cluster = Cluster(4, PyBackend(), seed=0)
+    lines = []
+    handle_command(cluster, "stats --live", lines.append)
+    assert not any("slo_worst" in ln for ln in lines)
+    # A real fold -> the sampler's own maybe_report (stats --live
+    # samples, which invokes the installed engine) computes last_worst.
+    eng = slo.SLOEngine(slo.default_policy(), registry=MetricsRegistry())
+    rec = _req(wall=2.0, tenant="alpha")  # misses the 0.5 s objective
+    rec.update(
+        queue_s=1.9, coalesce_s=0.025, compile_s=0.0,
+        dispatch_s=0.05, retire_lag_s=0.025,
+    )
+    eng.fold(rec)
+    slo.install(eng)
+    try:
+        lines.clear()
+        handle_command(cluster, "stats --live", lines.append)
+        (slo_line,) = [ln for ln in lines if ln.startswith("slo_worst")]
+        assert "tenant=alpha" in slo_line and "phase=queue_s" in slo_line
+        # One all-bad event against target 0.99: burn = 1/0.01 = 100.
+        assert "burn=100.0" in slo_line
+    finally:
+        slo.install(None)
