@@ -1,0 +1,235 @@
+"""The declared contract registry: record schemas, metric-name rules,
+and the documented ``BA_TPU_*`` environment surface (ISSUE 18).
+
+ONE table per contract, consumed from BOTH sides so the static and
+dynamic checkers can never drift:
+
+- :data:`RECORD_FAMILIES` — every versioned ``{"event": ..., "v": N}``
+  JSONL family the repo emits, with the keys each emit site must spell
+  literally and the run-scope/CI flags.  The BA601 rule
+  (``rules/contracts.py``) checks every statically-extracted emit site
+  against it; ``scripts/check_metrics_schema.py`` imports it as the
+  source of its end-to-end ``want`` set and generic required-key
+  validation.
+- :func:`metric_name_violation` — the ``serve_`` prefix and
+  ``_per_shard`` suffix naming rules.  Today these are runtime
+  assertions in ``obs/registry.MetricsRegistry._get`` (kept, as
+  defense-in-depth); BA602 enforces the SAME predicate at every
+  counter/gauge/histogram construction site, and the dynamic schema
+  checker re-applies it to snapshot records.
+- :data:`ENV_DOCUMENTED` / :data:`ENV_WILDCARDS` — the README
+  "Environment knobs" table as data.  BA603 diffs every ``BA_TPU_*``
+  read in the analyzed tree (including reads through module-level
+  name constants, alias-resolved cross-module) against it, both
+  directions; ``tests/test_analysis.py`` pins this module against the
+  README table itself, so a row added to one without the other fails.
+
+Zero dependencies, stdlib only, importable without jax — this module
+is part of the analyzer and shares its constraints.
+"""
+
+from __future__ import annotations
+
+# Current JSONL record schema version (mirrors
+# ``ba_tpu.utils.metrics.SCHEMA_VERSION``; tests pin the two equal).
+SCHEMA_VERSION = 1
+
+
+def _family(required=(), run_scoped=False, ci=True):
+    return {
+        "required": tuple(required),
+        "run_scoped": run_scoped,
+        "ci": ci,
+    }
+
+
+# Every record family the tree emits.  ``required`` lists the keys an
+# emit site must spell as LITERAL dict keys (``run_id`` appears only
+# for families whose emitters stamp it explicitly — families relying
+# on the sink's run-scope stamping are covered by ``run_scoped`` plus
+# the dynamic checker).  ``run_scoped`` mirrors
+# ``obs/flight.RUN_SCOPED_EVENTS`` (tests pin the two frozensets
+# equal).  ``ci`` marks families the end-to-end schema-check session
+# must observe (``scripts/check_metrics_schema.py``'s want set).
+RECORD_FAMILIES = {
+    "agreement_round": _family(
+        ("round", "n", "leader_id", "order", "decision")
+    ),
+    "pipeline_dispatch": _family(
+        ("dispatch", "round_base", "n", "order"), ci=False
+    ),
+    "agreement_rounds_pipelined": _family(
+        ("rounds", "dispatches", "depth", "decision_counts"), ci=False
+    ),
+    "scenario_campaign": _family(("name", "rounds", "dispatches"), ci=False),
+    "search_campaign": _family(
+        ("objective", "generations", "campaigns", "found"), ci=False
+    ),
+    "metrics_snapshot": _family(("metrics",)),
+    "compiled_artifact": _family(("fn", "axes", "flops", "bytes_accessed")),
+    "recompile": _family(("fn", "axes", "changed", "cross_process")),
+    "scenario_checkpoint": _family(
+        ("scenario", "round", "rounds", "path", "bytes"), run_scoped=True
+    ),
+    "recovery": _family(
+        ("action", "attempt", "fault", "error", "from_round", "lost_rounds"),
+        run_scoped=True,
+    ),
+    "fault_injected": _family(
+        ("kind", "phase", "round", "plan"), run_scoped=True
+    ),
+    "flight_span": _family(
+        ("dispatch", "phase", "lo", "hi", "latency_s", "lag_s"),
+        run_scoped=True,
+    ),
+    "health_snapshot": _family((), run_scoped=True),
+    "flight_summary": _family(
+        ("run_id", "rounds", "windows", "timeline"), run_scoped=True
+    ),
+    "request": _family(
+        ("id", "kind", "status", "cohort", "tenant", "wall_s")
+    ),
+    "admission": _family(("decision", "tier", "queue_depth")),
+    "shed": _family(("tier", "prev_tier", "queue_depth")),
+    "warmup": _family(("phase", "run_id")),
+    "sign_ahead": _family(("lo", "hi", "batch", "wall_s")),
+    "sign_pool": _family(
+        ("run_id", "workers", "requested", "degraded", "rounds"),
+        run_scoped=True,
+    ),
+    "search_generation": _family(
+        ("generation", "campaigns", "new_found", "found_total",
+         "best_score", "objective"),
+        run_scoped=True,
+    ),
+    "search_found": _family(
+        ("generation", "uid", "name", "score", "objective"), run_scoped=True
+    ),
+    "search_minimized": _family(
+        ("generation", "uid", "name", "bit_exact"), run_scoped=True
+    ),
+    "search_checkpoint": _family(
+        ("generation", "path", "found"), run_scoped=True
+    ),
+    "slo_report": _family(
+        ("run_id", "groups", "objectives", "worst_burn"), run_scoped=True
+    ),
+    "slo_alert": _family(
+        ("run_id", "objective", "state", "burn_fast", "burn_slow"),
+        run_scoped=True,
+    ),
+    "autoscale_signal": _family(
+        ("run_id", "recommended", "replicas", "burn", "queue_frac"),
+        run_scoped=True,
+    ),
+}
+
+# Families that by construction always carry ``run_id`` (must equal
+# ``ba_tpu.obs.flight.RUN_SCOPED_EVENTS`` — pinned by a test AND
+# asserted at import by scripts/check_metrics_schema.py).
+RUN_SCOPED_EVENTS = frozenset(
+    name for name, spec in RECORD_FAMILIES.items() if spec["run_scoped"]
+)
+
+# Families the end-to-end CI schema session must observe.
+CI_REQUIRED_EVENTS = frozenset(
+    name for name, spec in RECORD_FAMILIES.items() if spec["ci"]
+)
+
+
+def metric_name_violation(name: str):
+    """The instrument-naming contract (DESIGN §8), as one predicate.
+
+    Returns a human-readable reason string, or ``None`` when the name
+    conforms.  Mirrored from the runtime assertions in
+    ``obs/registry.MetricsRegistry._get`` (which stay, as
+    defense-in-depth); BA602 applies this statically at construction
+    sites, the dynamic schema checker re-applies it to snapshots.
+    """
+    if "per_shard" in name and not name.endswith("_per_shard"):
+        return (
+            f"per-shard metric {name!r} must end with '_per_shard' "
+            f"(the suffix is the shard-denominator marker dashboards "
+            f"key on)"
+        )
+    if "serve" in name.split("_") and not name.startswith("serve_"):
+        return (
+            f"service metric {name!r} must start with 'serve_' "
+            f"(the prefix rule groups the serving family in "
+            f"dashboards and the schema checker)"
+        )
+    return None
+
+
+# The documented environment surface: every ``BA_TPU_*`` variable the
+# README "Environment knobs" table names in full.  BA603 flags a
+# ``BA_TPU_*`` read absent from this set (used-but-undocumented) and —
+# when the analyzed set spans the whole repo — a row here that nothing
+# reads (documented-but-unused).  ``ENV_WILDCARDS`` are documented
+# name PREFIXES (the ``BA_TPU_BENCH_*`` row).
+ENV_DOCUMENTED = frozenset(
+    {
+        "BA_TPU_PALLAS",
+        "BA_TPU_NATIVE",
+        "BA_TPU_VERIFY_CHUNK",
+        "BA_TPU_METRICS",
+        "BA_TPU_TRACE",
+        "BA_TPU_HLO",
+        "BA_TPU_XPROF",
+        "BA_TPU_RNG",
+        "BA_TPU_FUSED_SWEEP",
+        "BA_TPU_FUSED_TILE",
+        "BA_TPU_FUSED_ROUNDS",
+        "BA_TPU_FUSED_UNROLL",
+        "BA_TPU_SIGN_DEVICE",
+        "BA_TPU_EIG_FUSED",
+        "BA_TPU_PIPELINE_DEPTH",
+        "BA_TPU_SIGN_POOL",
+        "BA_TPU_SIGN_POOL_TIMEOUT_S",
+        "BA_TPU_SIGN_CACHE",
+        "BA_TPU_SIGN_CACHE_BYTES",
+        "BA_TPU_SIGN_COALESCE",
+        "BA_TPU_ENGINE",
+        "BA_TPU_PIPELINE_ROUNDS",
+        "BA_TPU_COMPILE_CACHE",
+        "BA_TPU_COMPILE_LEDGER",
+        "BA_TPU_RUN_ID",
+        "BA_TPU_SUPERVISE_TIMEOUT_S",
+        "BA_TPU_MAX_RETRIES",
+        "BA_TPU_SERVE_BATCH",
+        "BA_TPU_SERVE_QUEUE",
+        "BA_TPU_SERVE_WINDOW_S",
+        "BA_TPU_SERVE_DEADLINE_S",
+        "BA_TPU_SERVE_RETRIES",
+        "BA_TPU_SLO",
+        "BA_TPU_WARM",
+        "BA_TPU_AOT_CACHE",
+        "BA_TPU_TESTS_ON_TPU",
+        "BA_TPU_EXAMPLE_PLATFORM",
+        "BA_TPU_VERIFY_NATIVE",
+        "BA_TPU_VERIFY_RLC",
+        # Multi-host launch coordinates (examples/multihost_cluster.py).
+        "BA_TPU_COORD",
+        "BA_TPU_NPROCS",
+        "BA_TPU_PROCID",
+        # Fused-kernel strategy-chain A/B dial (scenario/strategies.py).
+        "BA_TPU_STRATEGY_CHAIN",
+        # Bench calibration knobs (bench.py).
+        "BA_TPU_HBM_PEAK_GBPS",
+        "BA_TPU_FMUL_PROBE_VARIANTS",
+        # Span-budget A/B harness (scripts/span_budget_ab.py).
+        "BA_TPU_SPAN_AB_ROUNDS",
+        "BA_TPU_SPAN_AB_REPS",
+        "BA_TPU_SPAN_AB_PLATFORM",
+    }
+)
+
+ENV_WILDCARDS = ("BA_TPU_BENCH_",)
+
+
+def env_documented(name: str) -> bool:
+    """True when ``name`` is covered by the README env table (an exact
+    row or a documented wildcard prefix)."""
+    return name in ENV_DOCUMENTED or any(
+        name.startswith(w) for w in ENV_WILDCARDS
+    )

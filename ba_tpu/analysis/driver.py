@@ -1,6 +1,7 @@
 """ba-lint driver: file discovery, the two-phase run, output, exit code.
 
-``python -m ba_tpu.analysis [paths] [--format human|json] [--rules ...]``
+``python -m ba_tpu.analysis [paths] [--format human|json]
+[--rules ...] [--sarif OUT.sarif]``
 
 Phase one parses every ``.py`` under the given paths into
 :class:`~ba_tpu.analysis.project.ModuleInfo`; phase two builds the
@@ -156,6 +157,87 @@ def _to_json(active, suppressed, files, rules) -> dict:
     }
 
 
+def _to_sarif(active, suppressed, rules) -> dict:
+    """SARIF 2.1.0 (the static-analysis interchange format CI code
+    scanners ingest): one run, one ``result`` per finding — suppressed
+    findings are carried too, marked ``suppressions: [{"kind":
+    "inSource"}]``, so a waiver shows up in review instead of
+    vanishing.  ``level`` maps error→error, warning→warning.  The
+    rules array covers every SELECTED rule plus any extra code present
+    in the results (BA900 parse errors have no Rule object)."""
+    descriptors = {
+        r.code: {
+            "id": r.code,
+            "name": r.name,
+            "defaultConfiguration": {
+                "level": "error" if r.severity == ERROR else "warning"
+            },
+        }
+        for r in rules
+    }
+    for f in list(active) + list(suppressed):
+        descriptors.setdefault(
+            f.code,
+            {
+                "id": f.code,
+                "name": "parse-error"
+                if f.code == PARSE_ERROR_CODE
+                else f.code,
+                "defaultConfiguration": {"level": "error"},
+            },
+        )
+
+    def result(f: Finding, in_source_suppressed: bool) -> dict:
+        out = {
+            "ruleId": f.code,
+            "level": "error" if f.severity == ERROR else "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/")
+                        },
+                        "region": {
+                            "startLine": f.line,
+                            # SARIF columns are 1-based; Finding.col
+                            # is the 0-based ast col_offset.
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if in_source_suppressed:
+            out["suppressions"] = [{"kind": "inSource"}]
+        return out
+
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "ba-lint",
+                        "informationUri": (
+                            "https://github.com/ba-tpu/ba-tpu"
+                        ),
+                        "rules": [
+                            descriptors[c] for c in sorted(descriptors)
+                        ],
+                    }
+                },
+                "results": [result(f, False) for f in active]
+                + [result(f, True) for f in suppressed],
+            }
+        ],
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ba_tpu.analysis",
@@ -191,6 +273,13 @@ def main(argv=None) -> int:
              "violating fixtures out of a tests/ lint run",
     )
     parser.add_argument(
+        "--sarif",
+        metavar="OUT.sarif",
+        help="ALSO write findings as SARIF 2.1.0 to this path "
+             "(composes with either --format; suppressed findings "
+             "are included, marked suppressions=inSource)",
+    )
+    parser.add_argument(
         "--list-rules",
         action="store_true",
         help="print the rule table and exit",
@@ -220,6 +309,12 @@ def main(argv=None) -> int:
         parser.error(str(exc))
 
     run_rules = [r for r in rules if selected is None or r.code in selected]
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(
+                _to_sarif(active, suppressed, run_rules), fh, indent=2
+            )
+            fh.write("\n")
     if args.format == "json":
         print(json.dumps(_to_json(active, suppressed, files, run_rules)))
     else:

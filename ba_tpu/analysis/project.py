@@ -164,8 +164,42 @@ class Project:
         self.modules = {m.modname: m for m in modules}
         self.donating: dict = {}
         self._reach_memo: dict = {}
+        self._env_constants: dict | None = None
         for m in modules:
             self._collect_donations(m)
+
+    # -- module-level string constants ------------------------------------
+
+    def env_constants(self) -> dict:
+        """``{modname.CONST: value}`` for every module-level simple
+        string-constant assignment in the analyzed set (ISSUE 18).
+
+        The indirection table BA603 resolves env-variable names
+        through: ``WARM_ENV = "BA_TPU_WARM"`` in ``runtime/warmup.py``
+        registers as ``ba_tpu.runtime.warmup.WARM_ENV``, so both
+        ``os.environ.get(WARM_ENV)`` in the defining module and the
+        cross-module ``os.environ.get(obs.aotcache.CACHE_ENV)``
+        (alias-resolved by the caller's ImportMap) read back the
+        literal.  Only top-level ``NAME = "literal"`` forms count —
+        conditional or computed names are not static facts.
+        """
+        if self._env_constants is None:
+            table: dict = {}
+            for m in self.modules.values():
+                for node in m.tree.body:
+                    if not (
+                        isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)
+                    ):
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            table[f"{m.modname}.{tgt.id}"] = (
+                                node.value.value
+                            )
+            self._env_constants = table
+        return self._env_constants
 
     # -- donation registry ------------------------------------------------
 

@@ -5,6 +5,8 @@ them, so adding a rule is one module plus one line here."""
 
 def load_all() -> None:
     from ba_tpu.analysis.rules import (  # noqa: F401
+        concurrency,
+        contracts_rules,
         dead_imports,
         donation,
         hot_path,

@@ -1,0 +1,292 @@
+"""BA6xx contracts: record schemas, metric naming, env registry
+(ISSUE 18).
+
+All three rules consume the declared registries in
+``ba_tpu.analysis.contracts`` — the SAME tables
+``scripts/check_metrics_schema.py`` validates real JSONL streams
+against, so the static and dynamic checkers cannot drift.
+
+- **BA601 record-schema**: every statically-recognizable emit site — a
+  dict literal carrying a constant ``"event"`` key that either spells
+  ``"v"`` literally or is passed directly to an ``.emit(...)`` call —
+  is checked against :data:`contracts.RECORD_FAMILIES`: unknown
+  families flag (a typo'd event name silently creates an orphan stream
+  no dashboard reads), and sites without a ``**spread`` must spell
+  every required key literally.
+- **BA602 metric-naming**: the ``serve_`` prefix and ``_per_shard``
+  suffix rules, applied at every ``counter``/``gauge``/``histogram``
+  construction site with a literal name — the static mirror of the
+  runtime assertions in ``obs/registry.MetricsRegistry._get`` (which
+  stay, as defense-in-depth; this rule fails the commit before the
+  assert can fail a run).
+- **BA603 env-registry**: every ``BA_TPU_*`` environment read
+  (``os.environ.get``/``os.getenv``/subscript/``in os.environ``,
+  including reads through module-level name constants like
+  ``WARM_ENV = "BA_TPU_WARM"``, alias-resolved cross-module) is diffed
+  against the README env table (:data:`contracts.ENV_DOCUMENTED`):
+  used-but-undocumented flags at the read site; documented-but-unused
+  flags at the ``ba_tpu`` package root — but ONLY when the analyzed
+  set spans the whole repo (``ba_tpu/ examples/ bench.py tests/
+  scripts/``), so partial runs never false-positive on rows whose
+  reader lives outside the set.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ba_tpu.analysis import contracts
+from ba_tpu.analysis.base import Rule, register
+
+ENV_PREFIX = "BA_TPU_"
+
+# Reads (flaggable when undocumented, count as usage).
+_ENV_READ_FUNCS = {
+    "os.environ.get",
+    "os.getenv",
+    "os.environ.setdefault",
+}
+# Writes/clears (count as usage only — tests legitimately set and pop
+# synthetic names; documentation governs what code READS).
+_ENV_WRITE_FUNCS = {"os.environ.pop"}
+_MONKEYPATCH_FUNCS = {"setenv", "delenv"}
+
+
+def _dict_literal_keys(node: ast.Dict):
+    keys = set()
+    spread = False
+    for k in node.keys:
+        if k is None:
+            spread = True
+        elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+    return keys, spread
+
+
+def _event_value(node: ast.Dict):
+    for k, v in zip(node.keys, node.values):
+        if (
+            isinstance(k, ast.Constant)
+            and k.value == "event"
+            and isinstance(v, ast.Constant)
+            and isinstance(v.value, str)
+        ):
+            return v.value
+    return None
+
+
+@register
+class RecordSchema(Rule):
+    code = "BA601"
+    name = "record-schema"
+    severity = "error"
+
+    def check_module(self, mod, project):
+        emit_args = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                is_emit = (
+                    isinstance(fn, ast.Attribute) and fn.attr == "emit"
+                ) or (isinstance(fn, ast.Name) and fn.id == "emit")
+                if is_emit:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Dict):
+                            emit_args.add(id(arg))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            keys, spread = _dict_literal_keys(node)
+            if "event" not in keys:
+                continue
+            if "v" not in keys and id(node) not in emit_args:
+                # A dict that names an event but neither versions
+                # itself nor flows into an emit() is a payload/filter,
+                # not an emit site.
+                continue
+            event = _event_value(node)
+            if event is None:
+                continue  # dynamic event name; not statically checkable
+            spec = contracts.RECORD_FAMILIES.get(event)
+            if spec is None:
+                yield self.finding(
+                    mod,
+                    node,
+                    f"unknown record family {event!r} — not in "
+                    f"analysis/contracts.RECORD_FAMILIES; a typo'd "
+                    f"event name creates an orphan JSONL stream no "
+                    f"consumer reads (register the family or fix the "
+                    f"name)",
+                )
+                continue
+            if spread:
+                continue  # keys may arrive through the **spread
+            missing = [k for k in spec["required"] if k not in keys]
+            if missing:
+                yield self.finding(
+                    mod,
+                    node,
+                    f"record family {event!r} emit site missing "
+                    f"required key(s) {', '.join(sorted(missing))} — "
+                    f"contracts.RECORD_FAMILIES declares them; "
+                    f"consumers (scripts/check_metrics_schema.py, "
+                    f"dashboards) key on every one",
+                )
+
+
+@register
+class MetricNaming(Rule):
+    code = "BA602"
+    name = "metric-naming"
+    severity = "error"
+
+    _CTORS = {"counter", "gauge", "histogram"}
+
+    def check_module(self, mod, project):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute) and fn.attr in self._CTORS
+            ):
+                continue
+            if not node.args:
+                continue
+            name_arg = node.args[0]
+            if not (
+                isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)
+            ):
+                continue
+            reason = contracts.metric_name_violation(name_arg.value)
+            if reason:
+                yield self.finding(mod, name_arg, reason)
+
+
+def _env_name(expr, mod, project):
+    """Resolve an env-name expression to its literal value: a string
+    constant, a module-level name constant (``WARM_ENV``), or an
+    alias-resolved cross-module attribute (``aotcache.CACHE_ENV``)."""
+    if isinstance(expr, ast.Constant):
+        return expr.value if isinstance(expr.value, str) else None
+    table = project.env_constants()
+    dotted = mod.imports.resolve(expr)
+    if dotted and dotted in table:
+        return table[dotted]
+    if isinstance(expr, ast.Name):
+        return table.get(f"{mod.modname}.{expr.id}")
+    return None
+
+
+def _env_accesses(mod, project):
+    """Yield ``(name, node, is_read)`` for every resolvable ``BA_TPU_*``
+    environment access in the module."""
+    for node in ast.walk(mod.tree):
+        name_expr = None
+        is_read = True
+        if isinstance(node, ast.Call):
+            fn = mod.imports.resolve(node.func)
+            if fn in _ENV_READ_FUNCS and node.args:
+                name_expr = node.args[0]
+            elif fn in _ENV_WRITE_FUNCS and node.args:
+                name_expr, is_read = node.args[0], False
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MONKEYPATCH_FUNCS
+                and node.args
+            ):
+                name_expr, is_read = node.args[0], False
+        elif isinstance(node, ast.Subscript):
+            if mod.imports.resolve(node.value) == "os.environ":
+                name_expr = node.slice
+                is_read = isinstance(node.ctx, ast.Load)
+        elif isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+            for i, op in enumerate(node.ops):
+                if isinstance(op, (ast.In, ast.NotIn)) and (
+                    mod.imports.resolve(operands[i + 1]) == "os.environ"
+                ):
+                    name = _env_name(operands[i], mod, project)
+                    if name and name.startswith(ENV_PREFIX):
+                        yield name, node, True
+            continue
+        if name_expr is None:
+            continue
+        name = _env_name(name_expr, mod, project)
+        if name and name.startswith(ENV_PREFIX):
+            yield name, node, is_read
+
+
+def _project_env_usage(project):
+    used = project.__dict__.get("_ba603_usage")
+    if used is None:
+        used = set()
+        for m in project.modules.values():
+            for name, _node, _is_read in _env_accesses(m, project):
+                used.add(name)
+        project.__dict__["_ba603_usage"] = used
+    return used
+
+
+# The analyzed set must span all of these before documented-but-unused
+# may fire — a partial run (the acceptance command omits examples/ and
+# bench.py) cannot see every reader, so absence is not evidence there.
+_FULL_SET_PREFIXES = ("ba_tpu/", "tests/", "scripts/", "examples/")
+_FULL_SET_FILES = ("bench.py",)
+
+
+def _spans_whole_repo(project):
+    paths = [m.display_path for m in project.modules.values()]
+    for prefix in _FULL_SET_PREFIXES:
+        if not any(p.startswith(prefix) for p in paths):
+            return False
+    for f in _FULL_SET_FILES:
+        if not any(p == f or p.endswith("/" + f) for p in paths):
+            return False
+    return True
+
+
+@register
+class EnvRegistry(Rule):
+    code = "BA603"
+    name = "env-registry"
+    severity = "error"
+
+    def check_module(self, mod, project):
+        for name, node, is_read in _env_accesses(mod, project):
+            if is_read and not contracts.env_documented(name):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"environment variable {name!r} is read here but "
+                    f"has no README 'Environment knobs' row — add the "
+                    f"row AND the analysis/contracts.ENV_DOCUMENTED "
+                    f"entry (tests pin the two equal)",
+                )
+        # Reverse direction, anchored once at the package root and only
+        # when the analyzed set can actually see every reader.
+        if mod.modname != "ba_tpu":
+            return
+        if not _spans_whole_repo(project):
+            return
+        used = _project_env_usage(project)
+        for name in sorted(contracts.ENV_DOCUMENTED):
+            if name not in used:
+                yield self.finding(
+                    mod,
+                    mod.tree,
+                    f"documented environment variable {name!r} is "
+                    f"never read anywhere in the analyzed tree — "
+                    f"drop the stale README row (and its "
+                    f"contracts.ENV_DOCUMENTED entry) or wire the "
+                    f"knob back up",
+                )
+        for prefix in contracts.ENV_WILDCARDS:
+            if not any(u.startswith(prefix) for u in used):
+                yield self.finding(
+                    mod,
+                    mod.tree,
+                    f"documented wildcard row {prefix + '*'!r} "
+                    f"matches no read anywhere in the analyzed tree",
+                )

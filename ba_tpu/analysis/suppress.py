@@ -18,6 +18,19 @@ this one, must never register as a live directive):
 Suppressed findings still count in the JSON summary (``suppressed``
 bucket) so a tree accumulating waivers is visible, but they never fail
 the run.
+
+ISSUE 18 adds declarative ANNOTATIONS on the same comment channel
+(parsed from real COMMENT tokens too, so docstrings stay inert):
+
+- ``# ba-lint: thread-entry`` — line-scoped, on a ``def`` line: marks a
+  function the concurrency rules must treat as a thread entry point
+  even though no ``threading.Thread(target=...)``/``Timer`` call names
+  it in the analyzed set (indirect dispatch through a registry,
+  callback table, or an external framework);
+- ``# ba-lint: lockfree`` — own-line, file-scoped: declares the module
+  under the BA502 lock-free read discipline (only single-opcode
+  GIL-atomic reads of shared state; no read-modify-write, no iteration
+  over shared containers, no lock acquisition).
 """
 
 from __future__ import annotations
@@ -28,6 +41,10 @@ import tokenize
 
 _LINE_RE = re.compile(r"#\s*ba-lint:\s*disable=([A-Za-z0-9,\s]+)")
 _FILE_RE = re.compile(r"#\s*ba-lint:\s*disable-file=([A-Za-z0-9,\s]+)")
+# Declarative annotations (ISSUE 18).  `thread-entry` is line-scoped
+# (on the def line); `lockfree` is file-scoped (own-line only, like
+# disable-file).
+_ANNO_RE = re.compile(r"#\s*ba-lint:\s*(thread-entry|lockfree)\b")
 
 
 def _codes(group: str) -> set[str]:
@@ -40,6 +57,10 @@ class SuppressionIndex:
     def __init__(self, source: str):
         self.by_line: dict[int, set[str]] = {}
         self.file_wide: set[str] = set()
+        # Annotations: line -> tokens (thread-entry), plus file-wide
+        # declarations (lockfree).
+        self.annotations: dict[int, set[str]] = {}
+        self.file_annotations: set[str] = set()
         try:
             tokens = tokenize.generate_tokens(io.StringIO(source).readline)
             comments = [
@@ -63,6 +84,19 @@ class SuppressionIndex:
             m = _LINE_RE.search(text)
             if m:
                 self.by_line[lineno] = _codes(m.group(1))
+                continue
+            m = _ANNO_RE.search(text)
+            if m:
+                token = m.group(1)
+                if token == "lockfree":
+                    # Own-line only, mirroring disable-file: a TRAILING
+                    # lockfree would put a whole module under the BA502
+                    # discipline where the author plainly meant to
+                    # annotate one line.
+                    if line[:col].strip() == "":
+                        self.file_annotations.add(token)
+                else:
+                    self.annotations.setdefault(lineno, set()).add(token)
 
     def is_suppressed(self, code: str, line: int) -> bool:
         for active in (self.file_wide, self.by_line.get(line, ())):

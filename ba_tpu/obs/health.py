@@ -33,8 +33,14 @@ family.  This module adds the sampler:
 ``repl.py``'s ``stats --live`` renders a sample from the process-wide
 default sampler (rates are since the PREVIOUS ``stats --live`` call).
 Host-tier by lint contract: ba-lint BA301 proves ``obs/health.py``
-never imports through ``ba_tpu.core``/``ba_tpu.ops``.
+never imports through ``ba_tpu.core``/``ba_tpu.ops``; the lock-free
+claim above is machine-checked too — the declaration below puts the
+whole module under BA502 (single-opcode GIL-atomic reads only: no
+read-modify-write on shared state, no iteration over shared
+containers, no lock acquisition).
 """
+
+# ba-lint: lockfree
 
 from __future__ import annotations
 
@@ -264,7 +270,10 @@ class HealthSampler:
             self._last_lag_counts = lag["counts"]
         if lat is not None:
             self._last_lat_counts = lat["counts"]
-        self.samples += 1
+        # Single-writer bookkeeping: only the sampler itself ever
+        # increments, so the RMW cannot interleave with another writer
+        # — waived by name rather than restructured.
+        self.samples += 1  # ba-lint: disable=BA502
 
         if emit:
             record = {

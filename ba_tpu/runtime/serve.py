@@ -585,6 +585,12 @@ class AgreementService:
             obs.default_registry()
         )
         self._cond = threading.Condition()
+        # Tier/wedge state is written from BOTH the dispatcher thread
+        # (_refresh_tier decay, post-dispatch wedge clear) and the
+        # watchdog Timer thread (_declare_wedged) — a dedicated lock,
+        # NOT self._cond, so the watchdog never contends with queue
+        # signalling (BA501).
+        self._tier_lock = threading.Lock()
         self._queue: collections.deque = collections.deque()
         self._open = False
         self._drain = True
@@ -1053,13 +1059,14 @@ class AgreementService:
         """Apply + record one shed-tier transition (the dispatcher's
         refresh path AND the watchdog's wedge path — one spelling of
         the window/gauge/record bookkeeping)."""
-        prev, self._tier = self._tier, tier
-        # Halve the window per tier under pressure BEFORE any
-        # rejection tier bites (tiers 2/3 keep the halved window for
-        # whatever still admits).
-        self._window_s = self._cfg.coalesce_window_s * (
-            0.5 ** min(tier, 2)
-        )
+        with self._tier_lock:
+            prev, self._tier = self._tier, tier
+            # Halve the window per tier under pressure BEFORE any
+            # rejection tier bites (tiers 2/3 keep the halved window
+            # for whatever still admits).
+            self._window_s = self._cfg.coalesce_window_s * (
+                0.5 ** min(tier, 2)
+            )
         self._reg.gauge("serve_shed_tier").set(tier)
         self._reg.gauge("serve_window_s").set(self._window_s)
         lag = (snap or {}).get("retire_lag_p99_s")
@@ -1091,7 +1098,8 @@ class AgreementService:
         # and apply BACKPRESSURE: tier 3 holds until the dispatch
         # returns, so new submissions reject explicitly instead of
         # queueing behind a wedge forever.
-        self._wedged = True
+        with self._tier_lock:
+            self._wedged = True
         self._stalls_c.inc()
         obs.instant(
             "serve_dispatch_stalled", slots=slots, rounds=lo_rounds,
@@ -1178,7 +1186,8 @@ class AgreementService:
             # the wedge is over once control is back here, and the
             # next _refresh_tier decays the forced tier 3 normally.
             watchdog.cancel()
-            self._wedged = False
+            with self._tier_lock:
+                self._wedged = False
         t_retired = time.perf_counter()
         for t in live:
             t.retired_t = t_retired

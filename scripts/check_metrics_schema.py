@@ -382,6 +382,22 @@ def main() -> int:
         signed_flips = []  # ISSUE 14: recompile records' signed-axis pairs
         from ba_tpu.obs import flight as _flight
 
+        # ONE schema table in the repo (ISSUE 18): the static registry
+        # ba-lint's BA601/BA602 rules check emit sites against is the
+        # same one this dynamic checker validates real streams against
+        # — drift between the two is impossible by construction, and
+        # the run-scope mirror is asserted outright.
+        from ba_tpu.analysis import contracts
+
+        if contracts.RUN_SCOPED_EVENTS != _flight.RUN_SCOPED_EVENTS:
+            print(
+                "schema check: analysis/contracts.RUN_SCOPED_EVENTS "
+                "drifted from obs/flight.RUN_SCOPED_EVENTS: "
+                f"{sorted(contracts.RUN_SCOPED_EVENTS ^ _flight.RUN_SCOPED_EVENTS)}",
+                file=sys.stderr,
+            )
+            return 1
+
         def _num_or_null(v):
             return v is None or isinstance(v, (int, float))
 
@@ -402,6 +418,31 @@ def main() -> int:
                 )
                 bad += 1
             events.add(rec.get("event"))
+            # Registry-driven generic validation: the family must be
+            # DECLARED (an unknown event is an orphan stream ba-lint
+            # would also flag at the emit site), and every key the
+            # registry requires must be present on the wire.
+            spec = contracts.RECORD_FAMILIES.get(rec.get("event"))
+            if spec is None:
+                print(
+                    f"schema check: line {i} unknown record family "
+                    f"{rec.get('event')!r} (not in analysis/contracts."
+                    f"RECORD_FAMILIES): {line[:120]}",
+                    file=sys.stderr,
+                )
+                bad += 1
+            else:
+                spec_missing = [
+                    k for k in spec["required"] if k not in rec
+                ]
+                if spec_missing:
+                    print(
+                        f"schema check: line {i} {rec.get('event')} "
+                        f"record missing required key(s) "
+                        f"{spec_missing}: {line[:160]}",
+                        file=sys.stderr,
+                    )
+                    bad += 1
             # Run correlation (ISSUE 9): every record family that is by
             # construction emitted from inside a campaign's run scope
             # must carry a well-formed run_id — and ANY record carrying
@@ -961,18 +1002,17 @@ def main() -> int:
                 # device count and per-device carry/plane byte shares
                 # after every sweep — the weak-scaling denominators.
                 metrics_blk = rec.get("metrics", {})
-                # Service-metric prefix rule (ISSUE 10, DESIGN §8 —
-                # the `_per_shard` suffix-rule pattern, mirrored): any
-                # metric whose "_"-tokenized name mentions serve must
-                # spell the `serve_` PREFIX, and the serve session
-                # above must have left its gauge family behind.
+                # Metric-naming rules (ISSUE 10 serve_ prefix, ISSUE 8
+                # _per_shard suffix) — delegated to the SAME registry
+                # predicate ba-lint's BA602 applies at construction
+                # sites, so the dynamic and static checkers cannot
+                # disagree on what a well-formed name looks like.
                 for name in metrics_blk:
-                    if "serve" in name.split("_") and not name.startswith(
-                        "serve_"
-                    ):
+                    reason = contracts.metric_name_violation(name)
+                    if reason is not None:
                         print(
                             f"schema check: line {i} metric {name!r} "
-                            f"violates the serve_ prefix rule",
+                            f"naming violation: {reason}",
                             file=sys.stderr,
                         )
                         bad += 1
@@ -1047,31 +1087,11 @@ def main() -> int:
                             file=sys.stderr,
                         )
                         bad += 1
-        want = {
-            "agreement_round",
-            "metrics_snapshot",
-            "compiled_artifact",
-            "recompile",
-            "scenario_checkpoint",
-            "recovery",
-            "fault_injected",
-            "flight_span",
-            "health_snapshot",
-            "flight_summary",
-            "request",
-            "admission",
-            "shed",
-            "warmup",
-            "sign_ahead",
-            "sign_pool",
-            "search_generation",
-            "search_found",
-            "search_minimized",
-            "search_checkpoint",
-            "slo_report",
-            "slo_alert",
-            "autoscale_signal",
-        }
+        # The must-appear set is DERIVED from the registry (every
+        # family whose spec has ci=True), not hand-listed here — add a
+        # family to analysis/contracts.RECORD_FAMILIES and this check
+        # starts demanding it on the wire automatically.
+        want = set(contracts.CI_REQUIRED_EVENTS)
         if not want <= events:
             print(
                 f"schema check: expected events {want - events} missing "

@@ -27,6 +27,15 @@
 #   BA201 use-after-donate           <- new: no grep could express it
 #   BA202 rng-key-reuse              <- new: no grep could express it
 #   BA401 dead-import                <- new, warning-level ratchet
+#   BA501-BA504 concurrency          <- new (ISSUE 18): unsynchronized
+#                                       shared mutation, lock-free-read
+#                                       discipline, lock-order cycles,
+#                                       leaked timers/threads
+#   BA601-BA603 contracts            <- new (ISSUE 18): emit sites vs
+#                                       analysis/contracts.py record
+#                                       registry, metric naming at
+#                                       construction sites, BA_TPU_*
+#                                       env reads vs the README table
 #
 # ba-lint never imports jax, so this stage costs seconds and runs on
 # any host.  Findings output is a schema-versioned JSON object,
@@ -41,9 +50,11 @@ echo "== ba-lint static analysis: ba_tpu/ examples/ bench.py tests/ scripts/ =="
 # violating lint fixtures are pruned via --exclude (both already ran
 # clean — tests/test_analysis.py pins it — CI now gates on them).
 balint_json=$(mktemp)
-trap 'rm -rf "$balint_json" "${mutdir:-}"' EXIT
+balint_sarif=$(mktemp)
+trap 'rm -rf "$balint_json" "$balint_sarif" "${mutdir:-}"' EXIT
 python -m ba_tpu.analysis ba_tpu/ examples/ bench.py tests/ scripts/ \
     --exclude tests/fixtures/ba_lint --format json \
+    --sarif "$balint_sarif" \
     > "$balint_json"
 balint_rc=$?
 # Schema check (mirrors scripts/check_metrics_schema.py's contract for
@@ -94,6 +105,33 @@ EOF
 schema_rc=$?
 if [ "$balint_rc" -ne 0 ] || [ "$schema_rc" -ne 0 ]; then
     echo "ba-lint failed" >&2
+    exit 1
+fi
+# SARIF side-channel (ISSUE 18): the same run wrote a SARIF 2.1.0 log
+# for code-scanning upload.  Validate its shape here — still jax-free,
+# still sub-second (tests/test_analysis.py pins the full structure).
+python - "$balint_sarif" <<'EOF'
+import json, sys
+
+with open(sys.argv[1]) as fh:
+    doc = json.load(fh)
+assert doc["version"] == "2.1.0", doc.get("version")
+assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+(run,) = doc["runs"]
+driver = run["tool"]["driver"]
+assert driver["name"] == "ba-lint"
+ids = {r["id"] for r in driver["rules"]}
+assert {"BA101", "BA301", "BA501", "BA601"} <= ids, sorted(ids)
+for res in run["results"]:
+    assert res["ruleId"] in ids
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"]
+    assert loc["region"]["startLine"] >= 1
+print(f"ba-lint SARIF OK ({len(run['results'])} result(s), "
+      f"{len(ids)} rule(s))")
+EOF
+if [ $? -ne 0 ]; then
+    echo "ba-lint SARIF validation failed" >&2
     exit 1
 fi
 echo "ba-lint OK"
@@ -209,6 +247,35 @@ mutate_and_expect BA101 search/loop.py \
 # CLI / CI corpus stage depend on it) — prove that direction too.
 mutate_and_expect BA301 search/generate.py \
     'from ba_tpu.core import om as _mut_core' || exit 1
+# ISSUE 18: one seed per NEW rule family.  BA501 — a thread entry and a
+# public method both write the same attribute with no common lock (the
+# exact shape of the serve-tier race this PR fixed with _tier_lock).
+mutate_and_expect BA501 runtime/serve.py \
+    'import threading as _mut_th
+class _Mut501:
+    def __init__(self):
+        self._t = _mut_th.Thread(target=self._loop, daemon=True)
+        self._t.start()
+    def _loop(self):
+        self.n = 1
+    def poke(self):
+        self.n = 2' || exit 1
+# BA601 — a versioned record of an UNDECLARED family: the emit-site
+# discriminator ("event" + "v" literal keys) must catch it even as a
+# bare payload, before it ever reaches a sink.
+mutate_and_expect BA601 obs/flight.py \
+    '_MUT601 = {"event": "mystery_event", "v": 1}' || exit 1
+# BA602 — the ISSUE-required misnamed gauge: "serve" mentioned mid-name
+# without the serve_ prefix must seed CI red at the CONSTRUCTION site
+# (the runtime assert only fires if the line executes).
+mutate_and_expect BA602 obs/slo.py \
+    'def _mut602(reg):
+    return reg.gauge("depth_serve_live")' || exit 1
+# BA603 — an aliased read of an env knob with no README row (alias
+# proves the resolver, not a grep, is doing the matching).
+mutate_and_expect BA603 runtime/serve.py \
+    'import os as _mut_os
+_MUT603 = _mut_os.environ.get("BA_TPU_TOTALLY_UNDOCUMENTED", "")' || exit 1
 
 echo "== scenario spec round-trip =="
 # ISSUE 5: the committed campaign specs must load, validate, round-trip

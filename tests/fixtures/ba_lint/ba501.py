@@ -1,0 +1,84 @@
+"""BA501 unsynchronized-shared-mutation fixture (parsed, never run).
+
+Covers: Thread-target entry discovery through an import ALIAS
+(``import threading as th``), the ``# ba-lint: thread-entry``
+annotation for indirect dispatch, guarded-vs-unguarded mixes, the
+clean common-lock negative, and the suppression demo.
+"""
+
+import threading
+import threading as th
+
+
+class Racy:
+    """Dispatcher-loop pattern: `_loop` runs on its own thread, the
+    public API mutates the same attributes from caller threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counter = 0
+        self.mode = "idle"
+
+    def start(self):
+        worker = th.Thread(target=self._loop, daemon=True)
+        worker.start()
+
+    def _loop(self):
+        while True:
+            self.counter = self.counter + 1  # expect: BA501
+            with self._lock:
+                self.mode = "busy"
+
+    def bump(self):
+        self.counter = 0
+        self.mode = "idle"  # expect: BA501
+
+
+class Dispatched:
+    """No Thread() call names `on_tick` — an external registry fires
+    it — so the annotation supplies the entry fact."""
+
+    def __init__(self):
+        self.jobs = 0
+
+    def on_tick(self):  # ba-lint: thread-entry
+        self.jobs = self.jobs + 1  # expect: BA501
+
+    def reset(self):
+        self.jobs = 0
+
+
+class Disciplined:
+    """Negative: every cross-context write holds the same lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self.total = self.total + 1
+
+    def reset(self):
+        with self._lock:
+            self.total = 0
+
+
+class Waived:
+    """Suppression demo: a deliberate GIL-atomic single-store pattern
+    carries the named waiver on the anchored line."""
+
+    def __init__(self):
+        self.beat = 0.0
+
+    def arm(self):
+        th.Thread(target=self._tick, daemon=True).start()
+
+    def _tick(self):
+        self.beat = 1.0  # ba-lint: disable=BA501
+
+    def poke(self):
+        self.beat = 2.0

@@ -1,0 +1,37 @@
+"""BA502 lock-free-read-discipline fixture (parsed, never run).
+
+The own-line declaration below puts the whole module under the
+discipline: attribute RMW, shared-container iteration, and ANY lock
+acquisition flag; single-opcode reads, local snapshots, and literal
+iteration stay legal.
+"""
+
+# ba-lint: lockfree
+
+import threading
+
+_LOCK = threading.Lock()
+SHARED = {"a": 1}
+
+
+class Sampler:
+    def __init__(self):
+        self.count = 0
+        self.table = {}
+
+    def sample(self):
+        self.count += 1  # expect: BA502
+        with _LOCK:  # expect: BA502
+            pass
+        _LOCK.acquire()  # expect: BA502
+        for k in self.table:  # expect: BA502
+            _ = k
+        for _k, _v in SHARED.items():  # expect: BA502
+            pass
+        snapshot = dict(SHARED)  # a single-opcode-ish copy is the fix
+        for k in snapshot:  # negative: local
+            _ = k
+        for i in (1, 2, 3):  # negative: literal
+            _ = i
+        value = self.count  # negative: GIL-atomic attribute load
+        return value

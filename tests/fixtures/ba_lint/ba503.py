@@ -1,0 +1,85 @@
+"""BA503 lock-order-cycle fixture (parsed, never run).
+
+Covers: the two-lock AB/BA cycle (both acquisition sites flag), the
+one-hop cycle through a method call made under a lock, non-reentrant
+re-acquire (self-deadlock), and the RLock re-entry negative.
+"""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:  # expect: BA503
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:  # expect: BA503
+                pass
+
+
+class Hop:
+    """The second edge of the cycle is indirect: `top` calls `_low`
+    while holding `_x`, and `_low` acquires `_y` at its top level."""
+
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def top(self):
+        with self._x:
+            self._low()  # expect: BA503
+
+    def _low(self):
+        with self._y:
+            pass
+
+    def rev(self):
+        with self._y:
+            with self._x:  # expect: BA503
+                pass
+
+
+class Reacquire:
+    def __init__(self):
+        self._m = threading.Lock()
+
+    def outer(self):
+        with self._m:
+            with self._m:  # expect: BA503
+                pass
+
+
+class Reentrant:
+    """Negative: RLock re-entry is what RLock is FOR."""
+
+    def __init__(self):
+        self._m = threading.RLock()
+
+    def outer(self):
+        with self._m:
+            with self._m:
+                pass
+
+
+class Ordered:
+    """Negative: both paths take the locks in the same order."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._a, self._b:
+            pass

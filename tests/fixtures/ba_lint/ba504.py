@@ -1,0 +1,90 @@
+"""BA504 leaked-timer/daemon-lifecycle fixture (parsed, never run).
+
+Covers: local Timer armed without a finally-cancel, the unbindable
+``Timer(...).start()`` chain, the compliant try/finally pattern,
+self-stored Timers with and without a class-side cancel, and non-daemon
+threads with and without a join.
+"""
+
+import threading
+
+
+def orphan_timer():
+    t = threading.Timer(1.0, print)  # expect: BA504
+    t.start()
+
+
+def chained_start():
+    threading.Timer(0.5, print).start()  # expect: BA504
+
+
+def clean_timer():
+    t = threading.Timer(1.0, print)
+    t.start()
+    try:
+        return 1
+    finally:
+        t.cancel()
+
+
+def unarmed_timer():
+    t = threading.Timer(1.0, print)  # negative: never started
+    return t
+
+
+class KeepsTimer:
+    def arm(self):
+        self._t = threading.Timer(1.0, print)  # expect: BA504
+        self._t.start()
+
+
+class CancelsTimer:
+    def arm(self):
+        self._t = threading.Timer(1.0, print)
+        self._t.start()
+
+    def close(self):
+        self._t.cancel()
+
+
+def unjoined_thread():
+    t = threading.Thread(target=print)  # expect: BA504
+    t.start()
+
+
+def joined_thread():
+    t = threading.Thread(target=print)
+    t.start()
+    t.join()
+
+
+def daemon_thread():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+
+
+def daemon_after_construction():
+    t = threading.Thread(target=print)
+    t.daemon = True
+    t.start()
+
+
+class KeepsThread:
+    def start(self):
+        self._thr = threading.Thread(target=self._idle)  # expect: BA504
+        self._thr.start()
+
+    def _idle(self):
+        pass
+
+
+class JoinsThread:
+    def start(self):
+        self._thr = threading.Thread(target=self._idle)
+        self._thr.start()
+
+    def _idle(self):
+        pass
+
+    def stop(self):
+        self._thr.join()

@@ -1,0 +1,51 @@
+"""BA601 record-schema fixture (parsed, never run).
+
+Emit sites are dict literals with a constant ``"event"`` key that
+either spell ``"v"`` literally or flow directly into ``.emit(...)``.
+Unknown families and missing required keys flag; ``**spread`` sites and
+plain payload/filter dicts do not.
+"""
+
+SCHEMA_VERSION = 1
+
+
+class _Sink:
+    def emit(self, rec):
+        return rec
+
+
+def unknown_family(sink):
+    sink.emit({"event": "mystery_signal", "v": 1})  # expect: BA601
+
+
+def missing_required_keys(sink):
+    sink.emit(
+        {  # expect: BA601
+            "event": "admission",
+            "v": SCHEMA_VERSION,
+            "decision": "admit",
+        }
+    )
+
+
+def complete_site(sink):
+    sink.emit(
+        {
+            "event": "admission",
+            "v": SCHEMA_VERSION,
+            "decision": "admit",
+            "tier": 0,
+            "queue_depth": 3,
+        }
+    )
+
+
+def spread_site(sink, extra):
+    # Negative: required keys may arrive through the **spread — only
+    # the dynamic checker can judge this site.
+    sink.emit({"event": "shed", "v": SCHEMA_VERSION, **extra})
+
+
+# Negative: names an event but neither versions itself nor reaches an
+# emit() — a filter/payload dict, not an emit site.
+ADMISSION_FILTER = {"event": "admission"}

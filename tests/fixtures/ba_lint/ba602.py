@@ -1,0 +1,28 @@
+"""BA602 metric-naming fixture (parsed, never run).
+
+The ``serve_`` prefix and ``_per_shard`` suffix rules, applied at
+counter/gauge/histogram construction sites with literal names — the
+static mirror of the runtime asserts in ``obs/registry``.
+"""
+
+
+class _Reg:
+    def counter(self, name):
+        return name
+
+    def gauge(self, name):
+        return name
+
+    def histogram(self, name):
+        return name
+
+
+def build(reg):
+    reg.counter("requests_serve_total")  # expect: BA602
+    reg.gauge("per_shard_bytes")  # expect: BA602
+    reg.histogram("wait_serve_s")  # expect: BA602
+    reg.histogram("serve_wait_s")  # negative: canonical prefix
+    reg.gauge("plane_bytes_per_shard")  # negative: canonical suffix
+    reg.counter("observed_metric")  # negative: 'serve' only as substring
+    name = "dyn_serve_gauge"
+    reg.gauge(name)  # negative: dynamic name, runtime assert covers it

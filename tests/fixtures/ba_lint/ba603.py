@@ -1,0 +1,44 @@
+"""BA603 env-registry fixture (parsed, never run).
+
+Every ``BA_TPU_*`` READ must have a README "Environment knobs" row
+(the ``analysis/contracts.ENV_DOCUMENTED`` mirror).  Reads through
+module-level name constants resolve; writes/clears never flag (tests
+legitimately set synthetic names); wildcard-documented prefixes pass.
+"""
+
+import os
+
+FIXTURE_ENV = "BA_TPU_FIXTURE_ONLY_KNOB"
+
+
+def undocumented_read():
+    return os.environ.get("BA_TPU_NOT_A_DOCUMENTED_KNOB", "")  # expect: BA603
+
+
+def constant_indirection():
+    return os.environ.get(FIXTURE_ENV, "")  # expect: BA603
+
+
+def subscript_read():
+    return os.environ["BA_TPU_ALSO_UNDOCUMENTED"]  # expect: BA603
+
+
+def membership_read():
+    return "BA_TPU_THIRD_UNDOCUMENTED" in os.environ  # expect: BA603
+
+
+def getenv_read():
+    return os.getenv("BA_TPU_FOURTH_UNDOCUMENTED")  # expect: BA603
+
+
+def documented_read():
+    return os.environ.get("BA_TPU_WARM", "")  # negative: README row exists
+
+
+def wildcard_read():
+    return os.getenv("BA_TPU_BENCH_ANYTHING")  # negative: wildcard row
+
+
+def write_only():
+    os.environ["BA_TPU_SCRATCH_SET_ONLY"] = "1"  # negative: a write
+    os.environ.pop("BA_TPU_SCRATCH_SET_ONLY", None)  # negative: a clear

@@ -8,5 +8,5 @@ from ba_tpu.core.pure import quorum_threshold
 
 
 def positive_emit_through_alias(decision):
-    m.emit({"event": "round", "decision": decision})  # expect: BA301
+    m.emit({"event": "round", "decision": decision})  # expect: BA301 BA601
     return quorum_threshold(decision)

@@ -272,6 +272,8 @@ def test_list_rules_covers_the_documented_set():
     listed = {line.split()[0] for line in proc.stdout.splitlines() if line}
     assert listed == {
         "BA101", "BA102", "BA201", "BA202", "BA301", "BA401",
+        "BA501", "BA502", "BA503", "BA504",
+        "BA601", "BA602", "BA603",
     }
     # Severity contract: BA401 is the one warning-level rule.
     severities = {r.code: r.severity for r in all_rules()}
@@ -415,6 +417,35 @@ def test_donates_annotation_typo_is_a_finding(tmp_path):
     ("def _m(x):\n    return x.block_until_ready()\n", "BA101"),
     ("import jax.random as _j\n\ndef _m(k):\n    return _j.split(k)\n",
      "BA102"),
+    (
+        "import threading\n\n\n"
+        "class _M:\n"
+        "    def start(self):\n"
+        "        threading.Thread(\n"
+        "            target=self._loop, daemon=True\n"
+        "        ).start()\n\n"
+        "    def _loop(self):\n"
+        "        self.n = 1\n\n"
+        "    def poke(self):\n"
+        "        self.n = 2\n",
+        "BA501",
+    ),
+    (
+        "def _m(sink):\n"
+        "    sink.emit({'event': 'mystery_event', 'v': 1})\n",
+        "BA601",
+    ),
+    (
+        "def _m(reg):\n"
+        "    return reg.gauge('depth_serve_live')\n",
+        "BA602",
+    ),
+    (
+        "import os\n\n\n"
+        "def _m():\n"
+        "    return os.environ.get('BA_TPU_TOTALLY_UNDOCUMENTED', '')\n",
+        "BA603",
+    ),
 ])
 def test_mutation_flips_red(tmp_path, seed, code):
     # The in-process twin of scripts/ci.sh's mutation check.
@@ -425,3 +456,106 @@ def test_mutation_flips_red(tmp_path, seed, code):
     (pkg / "pipeline.py").write_text(seed)
     active, _, _ = run_paths([str(tmp_path)])
     assert code in {f.code for f in active}
+
+
+def test_sarif_output_structure(tmp_path):
+    # --sarif composes with either --format and carries suppressed
+    # findings marked inSource; structure is the SARIF 2.1.0 minimum
+    # code-scanning ingestion needs.
+    out = tmp_path / "lint.sarif"
+    proc = _run_cli(
+        ["tests/fixtures/ba_lint/ba501.py",
+         "tests/fixtures/ba_lint/ba601.py",
+         "--sarif", str(out), "--format", "json"]
+    )
+    assert proc.returncode == 1  # fixtures are deliberately violating
+    json.loads(proc.stdout)  # --format json still prints on stdout
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "ba-lint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert {"BA501", "BA601"} <= rule_ids
+    results = run["results"]
+    assert results, "fixture findings must appear as SARIF results"
+    for r in results:
+        assert r["ruleId"] in rule_ids
+        assert r["level"] in ("error", "warning")
+        assert r["message"]["text"]
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1  # SARIF is 1-based
+    # ba501.py's Waived class demo is in-source suppressed.
+    assert any(
+        r.get("suppressions") == [{"kind": "inSource"}] for r in results
+    )
+    assert any("suppressions" not in r for r in results)
+
+
+def test_readme_env_table_matches_contracts_registry():
+    # The BA603 registry IS the README "Environment knobs" table: every
+    # name in the section must be covered by contracts.ENV_DOCUMENTED/
+    # ENV_WILDCARDS and vice versa — a row added to one without the
+    # other fails here before the lint rule can drift.
+    from ba_tpu.analysis import contracts
+
+    readme = (REPO / "README.md").read_text()
+    start = readme.index("## Environment knobs")
+    section = readme[start:]
+    end = section.find("\n## ", 1)
+    if end != -1:
+        section = section[:end]
+    tokens = set(re.findall(r"BA_TPU_[A-Z0-9_]+", section))
+    # A trailing underscore is the wildcard-row spelling
+    # (`BA_TPU_BENCH_*` tokenizes to `BA_TPU_BENCH_`).
+    wildcards = {t for t in tokens if t.endswith("_")}
+    names = tokens - wildcards
+    assert wildcards == set(contracts.ENV_WILDCARDS)
+    undocumented = {n for n in names if not contracts.env_documented(n)}
+    assert not undocumented, (
+        f"README names missing from contracts.ENV_DOCUMENTED: "
+        f"{sorted(undocumented)}"
+    )
+    missing_rows = {
+        n for n in contracts.ENV_DOCUMENTED if n not in section
+    }
+    assert not missing_rows, (
+        f"contracts.ENV_DOCUMENTED entries with no README row: "
+        f"{sorted(missing_rows)}"
+    )
+
+
+def test_contracts_registry_pins_runtime_tables():
+    # One schema table in the repo: the static registry must equal the
+    # runtime source-of-truth sets it mirrors.  obs/flight and
+    # utils/metrics are host-tier (BA301-pinned), so importing them
+    # here stays jax-free.
+    from ba_tpu.analysis import contracts
+    from ba_tpu.obs import flight
+    from ba_tpu.utils import metrics
+
+    assert contracts.RUN_SCOPED_EVENTS == flight.RUN_SCOPED_EVENTS
+    assert contracts.SCHEMA_VERSION == metrics.SCHEMA_VERSION
+    # Registry invariants: run-scoped/ci flags only on known families,
+    # and the metric predicate accepts the canonical spellings the
+    # runtime registry asserts on.
+    assert contracts.CI_REQUIRED_EVENTS <= set(contracts.RECORD_FAMILIES)
+    assert contracts.metric_name_violation("serve_queue_depth") is None
+    assert contracts.metric_name_violation("plane_bytes_per_shard") is None
+    assert contracts.metric_name_violation("queue_serve_depth")
+    assert contracts.metric_name_violation("per_shard_bytes")
+
+
+def test_ba603_unused_check_gated_on_full_repo_span(tmp_path):
+    # documented-but-unused only fires when the analyzed set spans the
+    # whole repo (ba_tpu/ tests/ scripts/ examples/ bench.py) — a
+    # partial run cannot see every reader, so absence there is not
+    # evidence of a stale row.
+    pkg = tmp_path / "ba_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("X = 1\n")
+    active, _, _ = run_paths([str(tmp_path)], rule_codes={"BA603"})
+    assert active == [], [f.render() for f in active]

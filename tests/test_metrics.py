@@ -7,6 +7,12 @@ counts, quorum threshold, fault count, and wall time — and zero lines
 (plus unchanged REPL output) when the sink is disabled.
 """
 
+# The sink unit tests emit synthetic one-letter families ('x', 'a',
+# 'late', ...) to exercise sink MECHANICS (enablement, env config,
+# version stamping) — they are not real record contracts, so the
+# schema-registry rule is waived file-wide here.
+# ba-lint: disable-file=BA601
+
 import json
 
 from ba_tpu.runtime.backends import PyBackend

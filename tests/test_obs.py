@@ -237,7 +237,9 @@ def test_sink_and_tracer_thread_safety(tmp_path):
         gate.wait()
         for i in range(per):
             with tracer.span("w", t=t, i=i):
-                sink.emit({"event": "thread_test", "t": t, "i": i})
+                sink.emit(  # synthetic sink-mechanics family:
+                    {"event": "thread_test", "t": t, "i": i}  # ba-lint: disable=BA601
+                )
 
     ts = [threading.Thread(target=work, args=(t,)) for t in range(threads)]
     for t in ts:
