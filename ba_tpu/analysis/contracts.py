@@ -122,6 +122,33 @@ RECORD_FAMILIES = {
         ("run_id", "recommended", "replicas", "burn", "queue_frac"),
         run_scoped=True,
     ),
+    # ISSUE 19 fleet-tracing families.  None are ``ci`` (CI_REQUIRED
+    # drives the MAIN single-file schema session, where these never
+    # appear: clock_anchor/pool_task exist only in sink-DIRECTORY
+    # shards, request_trace/fleet_summary are ASSEMBLED offline by
+    # ``obs/fleet.py``) — the dedicated sink-dir stage in
+    # ``scripts/check_metrics_schema.py`` validates them instead.
+    # None are ``run_scoped``: clock_anchor is written before any run
+    # exists, pool_task is emitted by a worker process with no run
+    # scope, and the assembled families carry ``run_id`` as data
+    # copied from the request record, not a sink stamp.
+    "clock_anchor": _family(("pid", "shard", "perf_t", "ts"), ci=False),
+    "trace_span": _family(
+        ("name", "trace_id", "span_id", "parent_id", "t_perf", "dur_s"),
+        ci=False,
+    ),
+    "pool_task": _family(("kind", "rows", "wall_s", "t_perf"), ci=False),
+    "request_trace": _family(
+        ("trace_id", "request_id", "root_span", "spans", "span_count",
+         "processes", "unparented", "critical_path", "attribution_s",
+         "wall_s", "within_tol"),
+        ci=False,
+    ),
+    "fleet_summary": _family(
+        ("replicas", "cohorts", "requests", "pool_tasks", "traces",
+         "worst_burn", "slo_alerts", "autoscale_last"),
+        ci=False,
+    ),
 }
 
 # Families that by construction always carry ``run_id`` (must equal
@@ -174,6 +201,7 @@ ENV_DOCUMENTED = frozenset(
         "BA_TPU_VERIFY_CHUNK",
         "BA_TPU_METRICS",
         "BA_TPU_TRACE",
+        "BA_TPU_TRACE_CONTEXT",
         "BA_TPU_HLO",
         "BA_TPU_XPROF",
         "BA_TPU_RNG",

@@ -56,6 +56,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ba_tpu.utils import metrics as _metrics
+
 _LEN = struct.Struct("<Q")
 
 # Generous by design: the timeout exists to keep a HUNG worker from
@@ -136,9 +138,17 @@ def _worker_main() -> None:  # pragma: no cover - runs in the workers
         kind = task[0]
         if kind == "exit":
             return
+        t0 = time.perf_counter()
+        rows = 0
+        traceparent = None
         try:
             if kind == "sign":
-                _, seed, batch, n_values, base, rounds = task
+                seed, batch, n_values, base, rounds = task[1:6]
+                # Optional trailing traceparent (ISSUE 19): the staging
+                # window's causal position rode the pickle pipe; absent
+                # on tasks from older parents (length-gated, never
+                # positional breakage).
+                traceparent = task[6] if len(task) > 6 else None
                 pks, sk_rep, pk_rep = keys_for(seed, batch, n_values)
                 sigs = np.empty(
                     (len(rounds), batch, n_values, 64), np.uint8
@@ -150,15 +160,40 @@ def _worker_main() -> None:  # pragma: no cover - runs in the workers
                     sigs[i] = _signed.sign_table_msgs_arrays(
                         sk_rep, pk_rep, msgs
                     )
+                rows = len(rounds)
                 reply = ("ok", sigs)
             elif kind == "verify":
-                _, pks, msgs, sigs = task
+                pks, msgs, sigs = task[1:4]
+                traceparent = task[4] if len(task) > 4 else None
+                rows = int(msgs.shape[0])
                 reply = ("ok", _signed.verify_host_exact(pks, msgs, sigs))
             else:
                 reply = ("err", f"unknown task kind {kind!r}")
         except Exception as exc:  # noqa: BLE001 - worker must answer
             reply = ("err", f"{type(exc).__name__}: {exc}")
+        wall_s = time.perf_counter() - t0
         _send(stdout, reply)
+        if reply[0] == "ok" and _metrics.default_sink().enabled:
+            # One pool_task span per completed task, into this worker's
+            # OWN shard (the parent only forwards a sink-dir target) —
+            # emitted AFTER the reply so telemetry never sits on the
+            # parent's read path.  The span parents under the staging
+            # window's position; the codec lives in utils/metrics so no
+            # obs import widens the worker's jax-free closure.
+            rec = {
+                "event": "pool_task",
+                "v": _metrics.SCHEMA_VERSION,
+                "kind": kind,
+                "rows": rows,
+                "wall_s": round(wall_s, 6),
+                "t_perf": round(t0, 6),
+            }
+            parsed = _metrics.parse_traceparent(traceparent)
+            if parsed is not None:
+                rec["trace_id"] = parsed[0]
+                rec["span_id"] = _metrics.new_span_id()
+                rec["parent_id"] = parsed[1]
+            _metrics.emit(rec)
 
 
 class _Worker:
@@ -215,8 +250,19 @@ class SignPool:
         # Workers are computation, not observation: strip the telemetry
         # sinks so a worker never double-emits into the parent's stream,
         # and pin the package path so an uninstalled checkout resolves.
+        # EXCEPT (ISSUE 19) a sink-DIRECTORY target: there each process
+        # appends to its OWN <pid>.<token>.jsonl shard, so the worker
+        # keeps (or inherits — the parent may have configured the sink
+        # programmatically, not via env) the dir target, opens its own
+        # shard (clock anchor first), and its pool_task spans join the
+        # fleet merge instead of vanishing.
         for k in ("BA_TPU_METRICS", "BA_TPU_TRACE"):
             env.pop(k, None)
+        live_target = _metrics.default_sink().target
+        if _metrics.is_dir_target(live_target):
+            env["BA_TPU_METRICS"] = live_target
+        elif _metrics.is_dir_target(os.environ.get("BA_TPU_METRICS")):
+            env["BA_TPU_METRICS"] = os.environ["BA_TPU_METRICS"]
         import ba_tpu
 
         pkg_root = os.path.dirname(os.path.dirname(ba_tpu.__file__))
@@ -353,11 +399,13 @@ class SignPool:
         base: int,
         rounds: list[int],
         fallback,
+        traceparent: str | None = None,
     ) -> np.ndarray:
         """Shard ``rounds`` across the workers -> sigs uint8
         [len(rounds), batch, n_values, 64], reassembled by round index.
         ``fallback(rounds_slice)`` is the in-process body (degradation
-        rung 2)."""
+        rung 2).  ``traceparent`` (ISSUE 19) rides each task so the
+        workers' pool_task spans parent under the staging window."""
         live = self._live()
         if not rounds:
             return np.empty((0, batch, n_values, 64), np.uint8)
@@ -367,7 +415,8 @@ class SignPool:
         assignments = [
             (
                 live[i],
-                ("sign", seed, batch, n_values, base, rounds[lo:hi]),
+                ("sign", seed, batch, n_values, base, rounds[lo:hi],
+                 traceparent),
                 rounds[lo:hi],
             )
             for i, (lo, hi) in enumerate(spans)
@@ -378,11 +427,13 @@ class SignPool:
         return np.concatenate([np.asarray(p, np.uint8) for p in parts])
 
     def verify_rows(
-        self, pks: np.ndarray, msgs: np.ndarray, sigs: np.ndarray
+        self, pks: np.ndarray, msgs: np.ndarray, sigs: np.ndarray,
+        traceparent: str | None = None,
     ) -> np.ndarray:
         """Shard a flattened [N, ...] verify across the workers ->
         bool [N, n] verdicts, reassembled by row index.  Degraded
-        shards re-verify in-process via the same host body."""
+        shards re-verify in-process via the same host body.
+        ``traceparent`` rides each task exactly as in sign_rounds."""
         from ba_tpu.crypto.signed import verify_host_exact
 
         pks = np.ascontiguousarray(pks, np.uint8)
@@ -395,7 +446,8 @@ class SignPool:
         assignments = [
             (
                 live[i],
-                ("verify", pks[lo:hi], msgs[lo:hi], sigs[lo:hi]),
+                ("verify", pks[lo:hi], msgs[lo:hi], sigs[lo:hi],
+                 traceparent),
                 (lo, hi),
             )
             for i, (lo, hi) in enumerate(spans)

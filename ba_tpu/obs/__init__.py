@@ -31,6 +31,13 @@ Three parts, layered bottom-up (docs/DESIGN.md §8):
   record stream; ``slo_report`` / ``slo_alert`` / ``autoscale_signal``
   records ride the health sampler's cadence (``BA_TPU_SLO`` installs a
   policy on the serving front-end).
+- **fleet aggregation** (``obs.fleet``, ISSUE 19): cross-process causal
+  tracing — (trace_id, span_id, parent_id) contexts flow through serve
+  batches, sign-pool pipes and supervisor resumes; each process writes
+  its own sink shard (``BA_TPU_METRICS=dir/``) with a ``clock_anchor``;
+  ``obs.fleet`` merges shards, aligns clocks, and assembles per-request
+  ``request_trace`` span trees plus the ``fleet_summary`` rollup
+  (``scripts/obs_report.py --fleet``; REPL ``stats --fleet``).
 
 Everything MODULE-LEVEL here is HOST-side and jax-free (``obs.xla``
 imports jax only inside its opt-in functions): spans and emissions must
@@ -67,10 +74,10 @@ def __getattr__(name):
     # without runpy's found-in-sys.modules warning (the package would
     # otherwise import the submodule before runpy executes it as
     # __main__).  Everything else stays eager.
-    if name == "slo":
+    if name in ("slo", "fleet"):
         import importlib
 
-        return importlib.import_module("ba_tpu.obs.slo")
+        return importlib.import_module(f"ba_tpu.obs.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
@@ -83,6 +90,7 @@ __all__ = [
     "default_registry",
     "default_tracer",
     "first_call",
+    "fleet",
     "flight",
     "health",
     "instant",

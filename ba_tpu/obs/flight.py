@@ -416,8 +416,16 @@ def emit_flight_summary(sink=None, run_id: str | None = None):
     file.
     """
     sink = sink or _metrics.default_sink()
-    target = getattr(sink, "target", None)
-    if not target or target == "-" or not os.path.exists(target):
+    # Sink-dir mode (ISSUE 19): the process's stream is its own SHARD,
+    # not the directory — file_path() resolves it (None until the lazy
+    # open, in which case nothing was ever written to assemble).
+    if hasattr(sink, "file_path"):
+        target = sink.file_path()
+    else:
+        target = getattr(sink, "target", None)
+        if target == "-":
+            target = None
+    if not target or not os.path.exists(target):
         return None
     summary = assemble_flight(target, run_id=run_id)
     if summary is not None:

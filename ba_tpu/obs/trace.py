@@ -23,6 +23,20 @@ is a path the default tracer exports to at process exit.  ``bench.py
 This module must stay importable without jax and must never touch device
 values: spans wrap HOST phases only (a span inside a jitted/scan body
 would time tracing, not execution — ``scripts/ci.sh`` lints for that).
+
+Fleet trace context (ISSUE 19): this module also owns the
+``(trace_id, span_id, parent_id)`` causal context that flows across
+threads, coalesced serve batches, the sign-pool pickle pipes, and
+supervisor resume boundaries.  The storage primitive and the W3C
+traceparent codec live in ``utils/metrics.py`` (the sink stamps every
+record emitted inside a scope; pool workers decode without importing
+the obs package); THIS module owns creation and scoping: contexts are
+per-thread and never inherited implicitly — every hop is an explicit
+``child_context``/``scope`` pair, which is what makes the assembled
+span tree trustworthy.  External callers inject a parent via the
+``AgreementRequest.traceparent`` field or ``BA_TPU_TRACE_CONTEXT``;
+``current_traceparent()`` extracts the active position for outbound
+propagation (checkpoint headers, pool task tuples).
 """
 
 from __future__ import annotations
@@ -195,3 +209,141 @@ def span(name: str, **attrs):
 
 def instant(name: str, **attrs) -> None:
     default_tracer().instant(name, **attrs)
+
+
+def flush_export() -> str | None:
+    """Export the default tracer's buffer to the ``BA_TPU_TRACE`` path
+    NOW, instead of waiting for atexit.
+
+    The supervisor's fatal paths (recovery budget exhausted, poisonous
+    window, unrecoverable resume) call this before re-raising: the
+    atexit hook alone loses the trace exactly when it matters most —
+    an embedding that calls ``os._exit``, a fatal that unwinds into a
+    harness which kills the process, or a crashed campaign someone
+    wants to diagnose FROM the trace.  Best-effort and idempotent (a
+    later atexit export simply overwrites with a superset).  Returns
+    the path written, or None when ``BA_TPU_TRACE`` is not a path.
+    """
+    env = os.environ.get("BA_TPU_TRACE", "")
+    if env in ("", "0", "1"):
+        return None
+    _export_at_exit(default_tracer(), env)
+    return env
+
+
+# -- fleet trace context (ISSUE 19) -------------------------------------------
+#
+# A context is the plain tuple ``(trace_id, span_id, parent_id)`` — the
+# exact shape utils/metrics stores thread-locally and stamps onto every
+# record emitted in scope.  trace_id: 32 hex chars, constant across the
+# whole causal tree; span_id: 16 hex chars, this position; parent_id:
+# the position one hop up (None at the root).
+
+TRACE_CONTEXT_ENV = "BA_TPU_TRACE_CONTEXT"
+
+
+def current() -> tuple | None:
+    """The calling thread's active ``(trace_id, span_id, parent_id)``,
+    or None when untraced."""
+    return _metrics.active_trace_context()
+
+
+def current_traceparent() -> str | None:
+    """The active context as a W3C traceparent string (for outbound
+    propagation: checkpoint headers, pool task tuples, external
+    responses), or None when untraced."""
+    ctx = _metrics.active_trace_context()
+    if ctx is None:
+        return None
+    return _metrics.format_traceparent(ctx[0], ctx[1])
+
+
+def new_context(parent=None) -> tuple:
+    """A fresh context: a child of ``parent`` when given, a new root
+    otherwise.  ``parent`` may be a context tuple or a traceparent
+    string (a malformed string degrades to a new root — external input
+    must never raise into the request path)."""
+    if isinstance(parent, str):
+        parsed = _metrics.parse_traceparent(parent)
+        if parsed is None:
+            parent = None
+        else:
+            return (parsed[0], _metrics.new_span_id(), parsed[1])
+    if parent is None:
+        return (_metrics.new_trace_id(), _metrics.new_span_id(), None)
+    return (parent[0], _metrics.new_span_id(), parent[1])
+
+
+def child_context(parent=None) -> tuple:
+    """A child of ``parent`` (default: the thread's active context; a
+    new root when untraced)."""
+    return new_context(parent if parent is not None else current())
+
+
+@contextlib.contextmanager
+def scope(ctx: tuple | None):
+    """Install ``ctx`` as the thread's active context for the body
+    (None: a no-op pass-through), restoring the previous context on
+    exit — exception-safe, so a failed dispatch cannot leak its window
+    context onto the dispatcher thread."""
+    if ctx is None:
+        yield None
+        return
+    prev = _metrics.set_trace_context(ctx)
+    try:
+        yield ctx
+    finally:
+        _metrics.set_trace_context(prev)
+
+
+@contextlib.contextmanager
+def inject_scope(traceparent: str | None = None, mark: str | None = None):
+    """The engine-entry ambient scope: keep an already-active context
+    (explicit propagation wins), else adopt ``traceparent`` (a resumed
+    campaign's checkpoint header), else adopt ``BA_TPU_TRACE_CONTEXT``
+    (external injection), else stay untraced.  Adoption activates a
+    CHILD of the injected position — the injected span belongs to the
+    caller; our records must parent under it, never impersonate it.
+
+    ``mark`` names the adopted position: ON ADOPTION ONLY (never on the
+    pass-through of an already-active context — that position is the
+    propagator's to record), a zero-duration ``trace_span`` record
+    materializes the minted root IMMEDIATELY, so a campaign killed
+    mid-flight still leaves the span its windows parent under
+    in-stream — without it, every child span would merge unparented."""
+    if current() is not None:
+        yield current()
+        return
+    parent = traceparent or os.environ.get(TRACE_CONTEXT_ENV) or None
+    if parent is None or _metrics.parse_traceparent(parent) is None:
+        yield None
+        return
+    with scope(new_context(parent)) as ctx:
+        if mark is not None:
+            emit_trace_span(mark, ctx, time.perf_counter(), 0.0)
+        yield ctx
+
+
+def emit_trace_span(name: str, ctx: tuple, t0_perf: float, dur_s: float,
+                    **attrs) -> None:
+    """Append one explicit span NODE to the JSONL stream.
+
+    Most spans ride existing records (the sink stamps trace/span/parent
+    ids onto whatever a scope emits — ``flight_span``, ``request``,
+    ``sign_pool`` records ARE tree nodes); this is for the few causal
+    positions with no existing record to ride, e.g. the dispatcher's
+    coalesced-batch fan-in node.  ``t0_perf`` is ``time.perf_counter()``
+    at span start — the clock the shard's ``clock_anchor`` aligns."""
+    _metrics.emit(
+        {
+            "event": "trace_span",
+            "v": _metrics.SCHEMA_VERSION,
+            "name": name,
+            "trace_id": ctx[0],
+            "span_id": ctx[1],
+            "parent_id": ctx[2],
+            "t_perf": round(t0_perf, 6),
+            "dur_s": round(dur_s, 6),
+            **attrs,
+        }
+    )

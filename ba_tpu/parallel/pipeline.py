@@ -975,7 +975,7 @@ def _carry_arrays(host_state, host_sched, host_counters, host_strategy):
 # hazard both checks exist to prevent).
 RESERVED_CARRY_META_KEYS = frozenset(
     {"format", "v", "round", "scenario", "signed", "counter_names",
-     "sha256", "rounds_total", "shard_layout", "run_id"}
+     "sha256", "rounds_total", "shard_layout", "run_id", "traceparent"}
 )
 
 
@@ -1013,6 +1013,11 @@ def _carry_meta(
         # Run correlation (ISSUE 9): which campaign run wrote this
         # carry; a resume adopts it so the ledger stays one run.
         "run_id": run_id,
+        # Causal continuity (ISSUE 19): the writer's trace position at
+        # write time rides the header, so a resumed campaign's spans
+        # parent under the pre-crash span (the supervisor reads it back
+        # into an inject_scope at both resume sites).  None untraced.
+        "traceparent": obs.trace.current_traceparent(),
         **extra,
     }
 
@@ -1580,12 +1585,20 @@ def _pipeline_instruments(reg):
     }
 
 
-def _emit_flight_span(d, lo, hi, latency_s, lag_s, run_id=None):
+def _emit_flight_span(d, lo, hi, latency_s, lag_s, run_id=None, ctx=None,
+                      t_perf=None):
     """One ``flight_span`` record per retired round window — the ONE
     spelling of the record shape (campaign loop and coalesced loop
     both emit through here).  ``run_id`` stamps the id EXPLICITLY
     (serving batches, which never activate the process-global scope);
-    None leaves stamping to the sink's scope-based setdefault."""
+    None leaves stamping to the sink's scope-based setdefault.
+
+    ``ctx`` (ISSUE 19) is the dispatch's own trace position — stamped
+    explicitly for the same reason run_id is: the retire fetch runs on
+    the driving thread, whose AMBIENT context is the whole batch/
+    campaign, not this window.  ``t_perf`` (perf_counter seconds at
+    submit) lets obs/fleet place the window on the cross-process axis
+    via the shard's clock anchor."""
     if not _metrics.default_sink().enabled:
         return
     rec = {
@@ -1600,6 +1613,12 @@ def _emit_flight_span(d, lo, hi, latency_s, lag_s, run_id=None):
     }
     if run_id is not None:
         rec["run_id"] = run_id
+    if t_perf is not None:
+        rec["t_perf"] = round(t_perf, 6)
+    if ctx is not None:
+        rec["trace_id"], rec["span_id"] = ctx[0], ctx[1]
+        if ctx[2] is not None:
+            rec["parent_id"] = ctx[2]
     _metrics.emit(rec)
 
 
@@ -1810,14 +1829,21 @@ def coalesced_sweep(  # ba-lint: donates(state)
         rid = env
     else:
         rid = obs.flight.derive_run_id(*_identity_material())
-    out = _coalesced_loop(
-        state, sched, strategy, counters, ev_planes, chunks,
-        m=m, max_liars=max_liars, depth=depth, unroll=unroll,
-        is_scenario=is_scenario, exec_seam=exec_seam,
-        on_retire=on_retire, run_id=rid, executables=executables,
-        engine_resolved=engine_resolved, engine_fallback=engine_fallback,
-        signed=signed, collapsed=collapsed, ok_planes=ok_planes,
-    )
+    # Causal entry (ISSUE 19): the serve dispatcher's batch scope is
+    # already active on this thread and wins; a direct caller may
+    # inject via BA_TPU_TRACE_CONTEXT; untraced stays untraced.  On
+    # adoption the minted root materializes as a "campaign" record so
+    # the window spans below never merge unparented.
+    with obs.trace.inject_scope(mark="campaign"):
+        out = _coalesced_loop(
+            state, sched, strategy, counters, ev_planes, chunks,
+            m=m, max_liars=max_liars, depth=depth, unroll=unroll,
+            is_scenario=is_scenario, exec_seam=exec_seam,
+            on_retire=on_retire, run_id=rid, executables=executables,
+            engine_resolved=engine_resolved,
+            engine_fallback=engine_fallback,
+            signed=signed, collapsed=collapsed, ok_planes=ok_planes,
+        )
     out["counter_names"] = list(names)
     out["stats"]["run_id"] = rid
     out["stats"]["engine"] = engine_resolved
@@ -1865,7 +1891,7 @@ def _coalesced_loop(
 
     def retire():
         nonlocal retire_fetch_s
-        d, ys, t_sub, lo, hi = inflight.popleft()
+        d, ys, t_sub, lo, hi, d_ctx = inflight.popleft()
         with obs.timed_span("retire", lag_h, dispatch=d) as lag_box:
             with obs.xla.annotate("coalesced_retire", dispatch=d):
                 fetch = functools.partial(jax.device_get, ys)
@@ -1880,7 +1906,8 @@ def _coalesced_loop(
         ret_c.inc()
         rounds_c.inc(hi - lo)
         _emit_flight_span(
-            d, lo, hi, latency_s, lag_box.elapsed_s or 0.0, run_id=run_id
+            d, lo, hi, latency_s, lag_box.elapsed_s or 0.0, run_id=run_id,
+            ctx=d_ctx, t_perf=t_sub / 1e9,
         )
         if on_retire is not None:
             on_retire(d, lo, hi, host_ys)
@@ -1984,7 +2011,15 @@ def _coalesced_loop(
         state, sched, strategy, majorities = out[0], out[1], out[2], out[3]
         ys = out[4:]
         counters = ys[1][-1]  # cumulative rows' last row continues
-        inflight.append((d, ys, t_sub, lo, hi))
+        # Each in-flight window is its own span (ISSUE 19), a child of
+        # the ambient context (the serve batch's fan-in node) minted at
+        # submit and stamped at retire — id derivation only, no sync.
+        d_ctx = (
+            obs.trace.child_context()
+            if obs.trace.current() is not None
+            else None
+        )
+        inflight.append((d, ys, t_sub, lo, hi, d_ctx))
         max_in_flight = max(max_in_flight, len(inflight))
         occ_h.record(len(inflight))
         while len(inflight) > depth:
@@ -2108,7 +2143,15 @@ def pipeline_sweep(  # ba-lint: donates(state)
         inherited=resume.run_id if resume is not None else None,
         material_fn=_identity_material,
     )
-    with obs.flight.run_scope(rid) as scope:
+    # Causal entry (ISSUE 19): adopt an externally injected traceparent
+    # (BA_TPU_TRACE_CONTEXT) when no context is already active — an
+    # already-active scope (the supervisor's resume scope, a serve
+    # batch) always wins.  Untraced stays untraced: zero per-dispatch
+    # context work in that case.  On adoption the minted root
+    # materializes immediately ("campaign" record) so a SIGKILL
+    # mid-flight still leaves the root its window spans parent under.
+    with obs.trace.inject_scope(mark="campaign"), \
+            obs.flight.run_scope(rid) as scope:
         out = _pipeline_sweep_impl(
             key, state, rounds, scenario=scenario, resume=resume,
             **engine_kwargs,
@@ -2865,7 +2908,7 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
 
     def retire():
         # t_sub rides the in-flight tuple (perf_counter_ns at submit).
-        d, ys, t_sub, pending, lo, hi = inflight.popleft()
+        d, ys, t_sub, pending, lo, hi, d_ctx = inflight.popleft()
         with obs.timed_span("retire", lag_h, dispatch=d) as lag_box:
             # The ONLY blocking operation in the engine: fetch dispatch
             # d's outputs, which waits on a dispatch `depth` behind the
@@ -2918,7 +2961,10 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
         # grid and the assembler dedups them.  A host emit on the fetch
         # that just returned, never a new sync; run_id stamps via the
         # active scope.
-        _emit_flight_span(d, lo, hi, latency_s, lag_box.elapsed_s or 0.0)
+        _emit_flight_span(
+            d, lo, hi, latency_s, lag_box.elapsed_s or 0.0,
+            ctx=d_ctx, t_perf=t_sub / 1e9,
+        )
         if on_rows is not None:
             # Before the checkpoint write on purpose: a supervisor
             # persisting campaign history next to each checkpoint needs
@@ -3240,7 +3286,14 @@ def _pipeline_sweep_impl(  # ba-lint: donates(state)
             next_ckpt = round_base + checkpoint_every
         if on_event is not None:
             on_event("dispatch", d)
-        inflight.append((d, ys, t_sub, pending, lo, hi))
+        # Per-window trace position (ISSUE 19): child of the campaign's
+        # ambient context, minted at submit, stamped at retire.
+        d_ctx = (
+            obs.trace.child_context()
+            if obs.trace.current() is not None
+            else None
+        )
+        inflight.append((d, ys, t_sub, pending, lo, hi, d_ctx))
         max_in_flight = max(max_in_flight, len(inflight))
         occ_h.record(len(inflight))
         if scenario is not None and d + 1 < len(chunks):

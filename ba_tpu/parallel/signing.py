@@ -209,6 +209,21 @@ class SignAheadLane:
             if not 0 <= lo < hi:
                 raise ValueError(f"bad sign-ahead window [{lo}, {hi})")
         t0 = time.perf_counter()
+        # Staging span (ISSUE 19): one causal position for the whole
+        # coalesced pass, a child of the ambient context (the engine's
+        # campaign/batch scope).  Its traceparent rides the pool task
+        # tuples so worker pool_task spans parent under it; the
+        # sign_ahead / sign_pool records below carry it explicitly.
+        stage_ctx = (
+            obs.trace.child_context()
+            if obs.trace.current() is not None
+            else None
+        )
+        stage_tp = (
+            None
+            if stage_ctx is None
+            else _metrics.format_traceparent(stage_ctx[0], stage_ctx[1])
+        )
         B, V = self.batch, self.n_values
         rounds = [r for lo, hi in windows for r in range(lo, hi)]
         msgs_by_r = {
@@ -244,7 +259,8 @@ class SignAheadLane:
             if pool_live:
                 p0 = time.perf_counter()
                 signed_block = self.pool.sign_rounds(
-                    self.seed, B, V, 0, miss_rounds, self._sign_inprocess
+                    self.seed, B, V, 0, miss_rounds, self._sign_inprocess,
+                    traceparent=stage_tp,
                 )
                 pool_s0 += time.perf_counter() - p0
             else:
@@ -263,7 +279,9 @@ class SignAheadLane:
                 pks_w = np.tile(self.pks, (len(need), 1))
                 if pool_live:
                     p0 = time.perf_counter()
-                    ok_cat = self.pool.verify_rows(pks_w, msgs_cat, sigs_cat)
+                    ok_cat = self.pool.verify_rows(
+                        pks_w, msgs_cat, sigs_cat, traceparent=stage_tp
+                    )
                     pool_s0 += time.perf_counter() - p0
                 else:
                     # ONE native C++ batch call at the coalesced size.
@@ -328,6 +346,15 @@ class SignAheadLane:
             reg.counter("sign_cache_hits_total").inc(hits)
             reg.counter("sign_cache_misses_total").inc(misses)
         sink_live = _metrics.default_sink().enabled
+        # Explicit stamping (like _emit_flight_span's ctx): the staging
+        # span is the node these records describe — the ambient scope on
+        # this thread is its PARENT, so setdefault stamping would hang
+        # the pool workers' spans one level too high.
+        stamp = {}
+        if stage_ctx is not None:
+            stamp = {"trace_id": stage_ctx[0], "span_id": stage_ctx[1]}
+            if stage_ctx[2] is not None:
+                stamp["parent_id"] = stage_ctx[2]
         for lo, hi in windows:
             nr = hi - lo
             self.windows += 1
@@ -341,6 +368,8 @@ class SignAheadLane:
                         "hi": hi,
                         "batch": B,
                         "values": V,
+                        "t_perf": round(t0, 6),
+                        **stamp,
                         # The group's wall, attributed by round share
                         # (the group is ONE coalesced pass; per-window
                         # walls no longer exist as measurements).
@@ -356,6 +385,8 @@ class SignAheadLane:
                 {
                     "event": "sign_pool",
                     "v": _metrics.SCHEMA_VERSION,
+                    "t_perf": round(t0, 6),
+                    **stamp,
                     "run_id": _metrics.active_run_id() or self._run_id,
                     "workers": self.pool.workers,
                     "requested": self.pool.requested,

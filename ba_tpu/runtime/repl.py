@@ -66,6 +66,11 @@ stay byte-identical):
   (``obs/health.py``): rounds/s, depth occupancy, retire-lag p50/p99,
   watchdog margin, per-shard imbalance — rates measured since the
   previous ``stats --live`` call, lock-free reads only.
+  ``stats --fleet`` (ISSUE 19) prints one fleet rollup line instead,
+  merged on demand from the sharded sink directory
+  (``BA_TPU_METRICS=dir/`` mode) — replicas, cohorts, requests, pool
+  tasks, traces, p99 wall, worst burn.  Lock-free like ``--live``:
+  every process appends to its own shard, the reader never contends.
 
 Divergences (all guarded crashes in the reference, documented in SURVEY.md
 section 3.3): unknown ids and an empty cluster are ignored instead of
@@ -546,6 +551,29 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
                     )
             except Exception as e:
                 out(f"slo_worst error: {e}")
+            return True
+        # `stats --fleet` (ISSUE 19): one fleet rollup line from the
+        # sharded sink directory — merge-on-demand from the shards on
+        # disk (each process appends to its OWN shard, so reading here
+        # takes no lock anywhere; the writers never contend with us).
+        # No dir-mode sink prints one explanatory line; errors are one
+        # line, like the SLO view above.
+        if "--fleet" in cmd[1:]:
+            try:
+                from ba_tpu.utils import metrics as _metrics
+
+                target = _metrics.default_sink().target
+                if not _metrics.is_dir_target(target):
+                    out("fleet (no sharded sink — set BA_TPU_METRICS "
+                        "to a directory)")
+                    return True
+                from ba_tpu.obs import fleet as _fleet
+
+                out(_fleet.summary_line(
+                    _fleet.fleet_summary(_fleet.merge_shards(target))
+                ))
+            except Exception as e:
+                out(f"fleet error: {e}")
             return True
         for ln in obs.default_registry().prometheus_text().splitlines():
             out(ln)

@@ -422,6 +422,11 @@ class AgreementRequest:
     # spend, it never isolates); the SLO engine accounts per
     # (cohort, tenant) from the request records.
     tenant: str | None = None
+    # ISSUE 19: optional W3C traceparent injected by an external caller
+    # — the request's span tree parents under the caller's span.  Not a
+    # cohort key member (causality never changes coalescing); malformed
+    # values degrade to a fresh root trace, never an error.
+    traceparent: str | None = None
 
 
 def validate_request(req: AgreementRequest) -> AgreementRequest:
@@ -455,6 +460,13 @@ def validate_request(req: AgreementRequest) -> AgreementRequest:
     ):
         raise ValueError(
             f"tenant={req.tenant!r} must be None or a non-empty string"
+        )
+    if req.traceparent is not None and not isinstance(req.traceparent, str):
+        # Shape-check only: a WELL-TYPED but malformed traceparent is
+        # external input and degrades to untraced (obs.trace contract),
+        # but a non-string is a caller bug worth failing eagerly.
+        raise ValueError(
+            f"traceparent={req.traceparent!r} must be None or a string"
         )
     if req.kind == "scenario":
         if req.spec is None:
@@ -532,6 +544,17 @@ class Ticket:
         self.popped_t = None
         self.dispatched_t = None
         self.retired_t = None
+        # Causal root (ISSUE 19): every admitted request owns one span —
+        # the root of its cross-process tree.  Parent priority: the
+        # request's own traceparent field, else BA_TPU_TRACE_CONTEXT,
+        # else a fresh root trace.  Created at admission (caller's
+        # thread) so the id exists before any dispatcher work can
+        # reference it in a fan-in edge.
+        self._trace = obs.trace.new_context(
+            request.traceparent
+            or os.environ.get(obs.trace.TRACE_CONTEXT_ENV)
+            or None
+        )
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -1152,6 +1175,15 @@ class AgreementService:
             t.dispatched_t = t0
             self._wait_h.record(t0 - t.enqueued_t)
         rounds = request_rounds(live[0].request)
+        # The coalesced-batch fan-in node (ISSUE 19): many request roots
+        # converge on ONE shared engine dispatch, so the batch span is a
+        # child of the FIRST member's trace and carries every member's
+        # root span id as a ``fan_in`` edge — obs/fleet grafts the shared
+        # subtree under each other member's root from those edges.  The
+        # scope makes every record the engine emits during this dispatch
+        # (flight spans, sign staging, pool tasks) parent under it.
+        batch_ctx = obs.trace.child_context(live[0]._trace)
+        fan_in = [t._trace[1] for t in live]
         watchdog = threading.Timer(
             self._dispatch_timeout_s, self._declare_wedged,
             args=(len(live), rounds),
@@ -1160,13 +1192,19 @@ class AgreementService:
         watchdog.start()
         try:
             try:
-                results, run_id, phases = self._execute(live)
+                with obs.trace.scope(batch_ctx):
+                    results, run_id, phases = self._execute(live)
             except Exception as e:  # per-cohort fault isolation
                 att = fault_attribution(e)
                 self._failed_c.inc(len(live))
                 obs.instant(
                     "serve_cohort_failed", fault=att["fault"],
                     slots=len(live),
+                )
+                obs.trace.emit_trace_span(
+                    "serve_batch", batch_ctx, t0,
+                    time.perf_counter() - t0, fan_in=fan_in,
+                    slots=len(live), status="failed",
                 )
                 for t in live:
                     t._fail(
@@ -1192,6 +1230,10 @@ class AgreementService:
         for t in live:
             t.retired_t = t_retired
         wall = t_retired - t0
+        obs.trace.emit_trace_span(
+            "serve_batch", batch_ctx, t0, wall, fan_in=fan_in,
+            slots=len(live), status="ok",
+        )
         self._batch_s = (
             wall
             if self._batch_s is None
@@ -1405,6 +1447,13 @@ class AgreementService:
             rec["slot"] = slot
         if run_id is not None:
             rec["run_id"] = run_id
+        # ISSUE 19: the request record IS the tree root — stamp its own
+        # span explicitly (the dispatcher thread's ambient context, if
+        # any, belongs to a batch, not to this ticket).
+        tctx = ticket._trace
+        rec["trace_id"], rec["span_id"] = tctx[0], tctx[1]
+        if tctx[2] is not None:
+            rec["parent_id"] = tctx[2]
         _metrics.emit(rec)
         if self._slo is not None:
             self._slo.fold(rec)

@@ -621,6 +621,11 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
         )
 
     resume_arg = None
+    # Causal continuity (ISSUE 19): the checkpoint header's traceparent
+    # — the writer's trace position at write time — re-parents every
+    # resumed attempt's spans under the pre-crash span, so the merged
+    # fleet tree stays fully parented across process deaths.
+    resume_tp = None
     if resume == "auto":
         if checkpoint_path is not None:
             # below=rounds: a COMPLETED campaign's final checkpoint is
@@ -642,6 +647,7 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                 )
                 if foreign is not None:
                     stored = foreign[1].get("campaign_sha256")
+                    obs.trace.flush_export()
                     raise SupervisorError(
                         f"checkpoint family at {checkpoint_path!r} "
                         f"belongs to a DIFFERENT campaign (stored "
@@ -654,6 +660,7 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                     )
             if found is not None:
                 resume_arg = found[0]
+                resume_tp = found[1].get("traceparent")
                 r0 = found[1]["round"]
                 if "{round}" in checkpoint_path:
                     blocks.update(_read_rows_chain(checkpoint_path, names))
@@ -699,11 +706,12 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                 )
     elif resume is not None:
         resume_arg = resume
-        r0 = (
-            _snapshot.validate_carry_checkpoint(resume)["round"]
-            if isinstance(resume, str)
-            else resume.round
-        )
+        if isinstance(resume, str):
+            meta = _snapshot.validate_carry_checkpoint(resume)
+            r0 = meta["round"]
+            resume_tp = meta.get("traceparent")
+        else:
+            r0 = resume.round
         history_start = r0
         sidecar_upto = r0
 
@@ -813,7 +821,16 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                 if k != "initial_strategy"
             }
         try:
-            with obs.span(
+            # inject_scope: a resumed attempt adopts the checkpoint
+            # header's traceparent (its spans parent under the
+            # pre-crash position); a fresh attempt falls back to
+            # BA_TPU_TRACE_CONTEXT, else runs untraced.  mark: the
+            # adopted attempt root materializes as a record up front,
+            # so even an attempt that dies mid-flight leaves the span
+            # its windows parent under in-stream.
+            with obs.trace.inject_scope(
+                resume_tp, mark="supervised_attempt"
+            ), obs.span(
                 "supervised_attempt", attempt=attempt,
                 start=0 if resume_arg is None else -1,
             ):
@@ -866,6 +883,7 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                 # through it would burn the poison budget re-running
                 # the campaign from scratch and then misreport a
                 # one-line config error as a PoisonousWindow.
+                obs.trace.flush_export()
                 raise
             attribution = fault_attribution(e)
             kind = attribution["fault"]
@@ -893,6 +911,11 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                 else:
                     cur_rpd = max(1, cur_rpd // 2)
             elif n_recoveries >= cfg.max_recoveries:
+                # Fatal path (ISSUE 19 satellite): export the Chrome
+                # trace NOW — the atexit hook alone loses the buffer
+                # when an embedding hard-exits, and a crashed
+                # campaign's trace is exactly the one worth keeping.
+                obs.trace.flush_export()
                 raise SupervisorError(
                     f"recovery budget exhausted after {n_recoveries} "
                     f"resume(s); last fault: {type(e).__name__}: {e}"
@@ -907,6 +930,7 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
             # cannot seed a resume), or restart from round 0 when none
             # survives.
             resume_arg = None
+            resume_tp = None
             from_round = 0
             if checkpoint_path is not None:
                 found = _snapshot.newest_valid_checkpoint(
@@ -914,6 +938,7 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                 )
                 if found is not None:
                     resume_arg = found[0]
+                    resume_tp = found[1].get("traceparent")
                     from_round = found[1]["round"]
             if resume_arg is None:
                 # From-scratch restart: the fresh run re-covers
@@ -928,6 +953,7 @@ def _supervised_sweep_impl(  # ba-lint: donates(state)
                 # a from-scratch restart has nothing to start FROM, and
                 # letting the engine crash on state=None would bury the
                 # real fault under a TypeError.
+                obs.trace.flush_export()
                 raise SupervisorError(
                     f"cannot recover: no valid checkpoint at "
                     f"{checkpoint_path!r} and no initial state to "
@@ -1057,6 +1083,10 @@ def _quarantine_window(
             "error": reproducer["error"],
         }
     )
+    # Fatal path (ISSUE 19 satellite): a poisoned campaign is exactly
+    # the one someone diagnoses FROM the trace — export before raising,
+    # not at a process exit that may never run the atexit hooks.
+    obs.trace.flush_export()
     raise PoisonousWindow(
         f"campaign window starting at round {fail_round} failed "
         f"{failures} time(s) — quarantined; minimal reproducer: "

@@ -40,6 +40,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -75,6 +76,97 @@ def active_run_id() -> str | None:
     return _run_id
 
 
+# -- trace context (ISSUE 19) -------------------------------------------------
+#
+# One causal position = one (trace_id, span_id, parent_id) tuple.  The
+# fleet tracer (``obs/trace.py``) owns creation and scoping; the
+# primitive lives HERE — the bottom of the obs stack — so the sink can
+# stamp every record emitted while a context is active, mirroring the
+# run_id placement above.  Unlike ``_run_id`` (a module global with one
+# scope owner), contexts are PER-THREAD state: the serve dispatcher, its
+# client threads, and the sign-pool staging path each sit at a different
+# position in the causal tree at the same instant, so a global would
+# cross-stamp them.  Threads do NOT inherit a parent thread's context —
+# propagation is always explicit (that is the contract that makes the
+# assembled span tree trustworthy).
+
+_trace_local = threading.local()
+
+
+def set_trace_context(ctx: tuple | None) -> tuple | None:
+    """Install ``(trace_id, span_id, parent_id)`` as the calling
+    thread's active trace context (None clears).  Returns the PREVIOUS
+    value so scopes can nest/restore — use ``obs.trace.scope`` rather
+    than calling this directly."""
+    prev = getattr(_trace_local, "ctx", None)
+    _trace_local.ctx = ctx
+    return prev
+
+
+def active_trace_context() -> tuple | None:
+    return getattr(_trace_local, "ctx", None)
+
+
+# W3C traceparent codec (version 00, sampled flag always 01).  Lives
+# here — not in obs/trace — because the sign-pool WORKER processes must
+# decode the context that rode the pickle pipe while importing exactly
+# the host-tier modules they already import (crypto/pool pulls this
+# module; pulling the obs package into a worker would widen its jax-free
+# import closure for no reason).
+_TRACEPARENT_RE = re.compile(r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value) -> tuple | None:
+    """``(trace_id, span_id)`` from a W3C traceparent string, or None
+    for anything malformed (a bad external header must degrade to
+    "untraced", never raise into the request path)."""
+    if not isinstance(value, str):
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None or m.group(1) == "0" * 32 or m.group(2) == "0" * 16:
+        return None
+    return (m.group(1), m.group(2))
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+# -- sharded directory mode (ISSUE 19) ----------------------------------------
+
+
+def is_dir_target(target) -> bool:
+    """True when ``target`` selects the sharded sink-directory mode: a
+    trailing separator always does (the caller's declared intent, even
+    before the directory exists); an existing directory does too."""
+    if not target or target == "-":
+        return False
+    return target.endswith(("/", os.sep)) or os.path.isdir(target)
+
+
+# One shard token per process, chosen at first shard open: the active
+# run id when one is pinned, else a random process token.  Module-level
+# so a process that reconfigures its sink keeps appending to ONE shard
+# (the shard file is the process's stream identity; merging is always
+# by the run_id/trace_id FIELDS, never by filename).
+_shard_token: str | None = None
+
+
+def _process_shard_name() -> str:
+    global _shard_token
+    if _shard_token is None:
+        _shard_token = _run_id or f"proc-{os.urandom(4).hex()}"
+    return f"{os.getpid()}.{_shard_token}.jsonl"
+
+
 class MetricsSink:
     """Append-mode JSON-lines emitter; a falsy target disables it."""
 
@@ -85,10 +177,23 @@ class MetricsSink:
         self._fh = None
         self._lock = threading.Lock()
         self._atexit_registered = False
+        # Resolved shard path when the target is a directory (ISSUE 19);
+        # None until the lazy open, and for plain file/stderr targets.
+        self.shard_path: str | None = None
 
     @property
     def enabled(self) -> bool:
         return bool(self.target)
+
+    def file_path(self) -> str | None:
+        """The actual JSONL file this sink appends to: the shard inside
+        a directory target (once opened), the file itself otherwise;
+        None for stderr/disabled sinks and unopened directory targets."""
+        if not self.target or self.target == "-":
+            return None
+        if is_dir_target(self.target):
+            return self.shard_path
+        return self.target
 
     def emit(self, record: dict) -> None:
         if not self.target:
@@ -101,6 +206,17 @@ class MetricsSink:
             # the FlightLog assembler can join span/checkpoint/recovery/
             # recompile records of ONE campaign out of a shared stream.
             record.setdefault("run_id", _run_id)
+        ctx = getattr(_trace_local, "ctx", None)
+        if ctx is not None:
+            # Causal correlation (ISSUE 19): records emitted inside an
+            # active trace scope carry the thread's causal position, so
+            # obs/fleet can assemble one cross-process span tree.  The
+            # context RIDES the emit — no record is ever added just to
+            # carry it (the zero-added-sync contract).
+            record.setdefault("trace_id", ctx[0])
+            record.setdefault("span_id", ctx[1])
+            if ctx[2] is not None:
+                record.setdefault("parent_id", ctx[2])
         line = json.dumps(record)
         # Telemetry must never kill the agreement path: ANY OSError —
         # failed open, ENOSPC mid-write, EPIPE on a closed stderr —
@@ -114,11 +230,39 @@ class MetricsSink:
                 if self.target == "-":
                     self._fh = sys.stderr  # borrowed: close() skips it
                 else:
+                    anchor = None
                     try:
-                        parent = os.path.dirname(self.target)
-                        if parent:
-                            os.makedirs(parent, exist_ok=True)
-                        self._fh = open(self.target, "a")
+                        path = self.target
+                        if is_dir_target(path):
+                            # Sharded directory mode (ISSUE 19): one
+                            # shard per process, named by the grammar
+                            # <pid>.<token>.jsonl, opened with a clock
+                            # anchor as its first line of this session —
+                            # the perf_counter<->unix pair obs/fleet
+                            # uses to align per-process monotonic
+                            # clocks at merge time.
+                            os.makedirs(path, exist_ok=True)
+                            shard = _process_shard_name()
+                            self.shard_path = os.path.join(path, shard)
+                            path = self.shard_path
+                            anchor = {
+                                "event": "clock_anchor",
+                                "v": SCHEMA_VERSION,
+                                "pid": os.getpid(),
+                                "shard": shard,
+                                "perf_t": time.perf_counter(),
+                                # 6 dp: alignment precision is the point
+                                # of this record (ordinary records round
+                                # ts to 3 dp for size).
+                                "ts": round(time.time(), 6),
+                            }
+                        else:
+                            parent = os.path.dirname(path)
+                            if parent:
+                                os.makedirs(parent, exist_ok=True)
+                        self._fh = open(path, "a")
+                        if anchor is not None:
+                            self._fh.write(json.dumps(anchor) + "\n")
                     except OSError as e:
                         self._disable(e)
                         return

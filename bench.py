@@ -2421,6 +2421,207 @@ def bench_serving_slo(jax, jnp, jr):
     }
 
 
+def bench_fleet_trace(jax, jnp, jr):
+    """Fleet-tracing config (ISSUE 19 acceptance): does one served
+    request on a POOLED SIGNED cohort reconstruct to a single
+    cross-process span tree?
+
+    A warm service in sink-DIRECTORY mode (``BA_TPU_METRICS=dir/``,
+    one shard per process) serves a mixed-tenant signed fleet with the
+    sign pool live, then every acceptance boolean is recomputed from
+    the CAPTURED SHARDS — the same files ``python -m ba_tpu.obs.fleet``
+    merges — and asserted, not just recorded:
+
+    - ``all_spans_parented`` — every request's assembled span tree has
+      ZERO unparented spans (the batch fan-in grafts, the pool workers'
+      ``pool_task`` spans parent under the piped traceparent, the
+      request root is the tree root).
+    - ``critical_path_within_tol`` — each request's five attributed
+      phases telescope to its wall within ``ATTRIB_TOL_S`` (the PR 17
+      invariant, surviving reassembly from shards).
+    - ``merge_deterministic`` — two independent merges of the same
+      shard set are byte-identical (same canonical digest).
+    - ``cross_process`` — every request tree spans >= 2 processes (the
+      dispatcher's shard plus at least one pool worker's).
+    - ``no_request_path_compiles`` — zero compiles after the warm
+      barrier, with the whole tracing plane live (the zero-added-sync
+      contract priced: context rides existing emits).
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from ba_tpu import obs
+    from ba_tpu.crypto import pool as pool_mod
+    from ba_tpu.obs import fleet as fleet_mod
+    from ba_tpu.obs.registry import MetricsRegistry
+    from ba_tpu.runtime.serve import (
+        AgreementRequest,
+        AgreementService,
+        ServeConfig,
+    )
+    from ba_tpu.utils import metrics as metrics_mod
+
+    clients = int(os.environ.get("BA_TPU_BENCH_FLEET_CLIENTS", 3))
+    per_client = int(os.environ.get("BA_TPU_BENCH_FLEET_REQS", 2))
+    rounds = int(os.environ.get("BA_TPU_BENCH_FLEET_ROUNDS", 12))
+    max_batch = 4
+
+    def request(c, j):
+        i = c * per_client + j
+        return AgreementRequest(
+            kind="run-rounds",
+            order=("attack", "retreat")[i % 2],
+            n=4,
+            faulty=((2,), (), (1, 3))[i % 3],
+            seed=7000 + i,
+            rounds=rounds,
+            m=1,
+            signed=True,
+            tenant=f"tenant-{c}",
+        )
+
+    sink_dir = tempfile.mkdtemp(prefix="ba_fleet_trace_") + os.sep
+    prev_target = metrics_mod.default_sink().target
+    prev_env = {
+        k: os.environ.get(k)
+        for k in ("BA_TPU_METRICS", "BA_TPU_SIGN_POOL",
+                  "BA_TPU_SIGN_CACHE")
+    }
+    os.environ["BA_TPU_METRICS"] = sink_dir
+    os.environ["BA_TPU_SIGN_POOL"] = os.environ.get(
+        "BA_TPU_SIGN_POOL"
+    ) or "2"
+    # Cache OFF for this leg: a primed signature-table cache would
+    # satisfy every signed request in-process and the cross-process
+    # tree this config exists to pin would have no pool spans to cross.
+    os.environ["BA_TPU_SIGN_CACHE"] = "0"
+    # Respawn the pool AFTER the sink points at the directory: workers
+    # snapshot the live sink target at spawn, and a worker spawned
+    # against the previous config's sink would shard elsewhere.
+    pool_mod.shutdown_defaults()
+    obs.reset_first_calls()
+    metrics_mod.configure(sink_dir)
+    try:
+        with tempfile.TemporaryDirectory() as aot_dir:
+            svc = AgreementService(
+                ServeConfig(
+                    max_batch=max_batch, max_queue=4 * max_batch,
+                    coalesce_window_s=0.02, rounds_per_dispatch=4,
+                    warm=True, warm_rounds=rounds, aot_cache=aot_dir,
+                    warm_scenarios=False,
+                ),
+                registry=MetricsRegistry(),
+            )
+            t0 = time.perf_counter()
+            svc.open()
+            assert svc.warm_barrier(timeout=600), "warm barrier timed out"
+            t_warmup = time.perf_counter() - t0
+            svc.start()
+
+            errors = []
+
+            def client(c):
+                for j in range(per_client):
+                    try:
+                        svc.submit(
+                            request(c, j), deadline_s=None
+                        ).result(timeout=600)
+                    except Exception as e:
+                        errors.append(f"{type(e).__name__}: {e}")
+                        return
+
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=900)
+            t_serve = time.perf_counter() - t0
+            assert not errors, errors
+            stats = svc.stats()
+            svc.stop()  # reaps the pool: worker shards are complete
+    finally:
+        metrics_mod.configure(prev_target)
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        pool_mod.shutdown_defaults()
+
+    # Recompute every acceptance boolean from the captured shards.
+    merged = fleet_mod.merge_shards(sink_dir)
+    merge_deterministic = fleet_mod.merge_digest(
+        merged
+    ) == fleet_mod.merge_digest(fleet_mod.merge_shards(sink_dir))
+    assert merge_deterministic, "shard merge is not deterministic"
+    rids = fleet_mod.request_ids(merged)
+    assert len(rids) == clients * per_client, (
+        f"expected {clients * per_client} served requests in the "
+        f"stream, found {len(rids)}"
+    )
+    traces = [
+        fleet_mod.assemble_request_trace(merged, request_id=rid)
+        for rid in rids
+    ]
+    all_spans_parented = all(t["unparented"] == [] for t in traces)
+    assert all_spans_parented, (
+        f"unparented spans: "
+        f"{[(t['request_id'], t['unparented']) for t in traces]}"
+    )
+    critical_path_within_tol = all(t["within_tol"] for t in traces)
+    assert critical_path_within_tol, "critical-path attribution broke"
+    cross_process = all(len(t["processes"]) >= 2 for t in traces)
+    assert cross_process, (
+        "a signed request's tree never left the dispatcher process "
+        "(no pool-worker span joined it)"
+    )
+    pool_tasks = sum(1 for r in merged if r.get("event") == "pool_task")
+    assert pool_tasks > 0, "no pool_task spans in the worker shards"
+    summary = fleet_mod.fleet_summary(merged)
+    assert stats["compiles_on_request_path"] == 0, (
+        f"request path compiled "
+        f"({stats['compiles_on_request_path']}x after the barrier) "
+        f"with the tracing plane live"
+    )
+    shutil.rmtree(sink_dir)  # asserts passed — a failing run keeps it
+
+    n_requests = clients * per_client
+    return {
+        "rounds_per_sec": round(n_requests * rounds / t_serve, 1),
+        "clients": clients,
+        "requests": n_requests,
+        "tenants": clients,
+        "rounds": rounds,
+        "max_batch": max_batch,
+        "warmup_wall_s": round(t_warmup, 4),
+        "serve_elapsed_s": round(t_serve, 4),
+        "shards": len(summary["replicas"]),
+        "pool_tasks": pool_tasks,
+        "spans_per_trace": [t["span_count"] for t in traces],
+        "merge_digest": fleet_mod.merge_digest(merged),
+        "all_spans_parented": all_spans_parented,
+        "critical_path_within_tol": critical_path_within_tol,
+        "merge_deterministic": merge_deterministic,
+        "cross_process_trees_ok": cross_process,
+        "no_request_path_compiles": (
+            stats["compiles_on_request_path"] == 0
+        ),
+        "bound": "every boolean is recomputed from the captured "
+                 "shards (the same files `python -m ba_tpu.obs.fleet` "
+                 "merges) and asserted — a regression fails the "
+                 "bench, it never just flips a committed boolean",
+        "note": "sink-directory mode, one shard per process "
+                "(dispatcher + sign-pool workers); request trees "
+                "assemble across the process boundary via the "
+                "traceparent piped with each pool task",
+    }
+
+
 _MULTICHIP_CHILD = r'''
 import dataclasses, hashlib, json, sys, time
 
@@ -3729,6 +3930,7 @@ CONFIGS = {
     "serving": bench_serving,
     "serving_warm": bench_serving_warm,
     "serving_slo": bench_serving_slo,
+    "fleet_trace": bench_fleet_trace,
     "multichip": bench_multichip,
     "sweep10k_signed": bench_sweep10k_signed,
     "sm1_n64_signed": bench_sm1_n64_signed,
@@ -3748,16 +3950,18 @@ CONFIGS = {
 # dozens of shrink trials, signed_throughput runs the signed sweep
 # five times over (pool spawns + a cache-populating pass per leg), and
 # serving_slo sleeps through real burn windows (quiet gap + recovery)
-# around a deadline-storm burst —
+# around a deadline-storm burst, and fleet_trace pays a warm AOT pass
+# plus a sign-pool respawn in sink-directory mode —
 # all opt in explicitly: `--configs scenario_long` / `resilience` /
 # `multichip` / `serving` / `serving_warm` / `serving_slo` /
-# `megastep_ab` / `adversary_search` / `signed_throughput`.
+# `fleet_trace` / `megastep_ab` / `adversary_search` /
+# `signed_throughput`.
 DEFAULT_CONFIGS = [
     n for n in CONFIGS
     if n not in (
         "scenario_long", "resilience", "multichip", "serving",
-        "serving_warm", "serving_slo", "megastep_ab", "signed_ab",
-        "adversary_search", "signed_throughput",
+        "serving_warm", "serving_slo", "fleet_trace", "megastep_ab",
+        "signed_ab", "adversary_search", "signed_throughput",
     )
 ]
 

@@ -57,7 +57,9 @@ _ACCEPT_KEY = re.compile(
     r"(within|bounded|bit_exact|_ok$|^ok$|recovery_within"
     r"|no_request_path_compiles"  # ISSUE 11: the warm-serving boolean
     r"|speedup_ge"  # ISSUE 16: signed_throughput's speedup_ge_3x gate
-    r"|fired_and_cleared)"  # ISSUE 17: serving_slo burn-alert lifecycle
+    r"|fired_and_cleared"  # ISSUE 17: serving_slo burn-alert lifecycle
+    r"|all_spans_parented"  # ISSUE 19: fleet_trace tree completeness
+    r"|merge_deterministic)"  # ISSUE 19: fleet_trace shard-merge pin
 )
 
 

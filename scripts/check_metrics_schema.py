@@ -1122,7 +1122,7 @@ def main() -> int:
         if bad:
             return 1
         print(f"metrics JSONL schema OK ({len(lines)} records, v=1)")
-        return 0
+        return check_sink_dir()
     finally:
         os.unlink(path)
         for ck in (".carry.npz", ".mesh_carry.npz", ".search.json"):
@@ -1132,6 +1132,233 @@ def main() -> int:
 
         for stray in glob.glob(path + ".sup_*"):
             os.unlink(stray)
+
+
+def check_sink_dir() -> int:
+    """Fleet-tracing stage (ISSUE 19): drive a POOLED SIGNED serve
+    session in sink-DIRECTORY mode — ``BA_TPU_METRICS`` set to a
+    directory as an ENV var so the sign-pool workers inherit it, open
+    their own ``<pid>.<token>.jsonl`` shards and land their
+    ``pool_task`` spans in the fleet merge — then validate the three
+    assembled families end-to-end: every shard leads with a typed
+    ``clock_anchor``, every served request assembles into a
+    ``request_trace`` whose non-root spans ALL resolve a parent and
+    whose critical-path hop sum telescopes to the wall (the PR 17
+    attribution invariant, re-checked across processes), and the
+    stream folds into one typed ``fleet_summary``.  Required keys come
+    from the SAME registry (``analysis/contracts.RECORD_FAMILIES``)
+    ba-lint's BA601 checks the emit sites against."""
+    import shutil
+    import threading
+
+    from ba_tpu.analysis import contracts
+    from ba_tpu.crypto import pool as _sign_pool
+    from ba_tpu.obs import fleet
+    from ba_tpu.utils import metrics
+
+    sink_dir = tempfile.mkdtemp(suffix=".fleet") + os.sep
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("BA_TPU_METRICS", "BA_TPU_SIGN_POOL", "BA_TPU_SIGN_CACHE")
+    }
+    # The env var (not just programmatic configure) is load-bearing:
+    # pool workers inherit their shard target through it.
+    os.environ["BA_TPU_METRICS"] = sink_dir
+    os.environ["BA_TPU_SIGN_POOL"] = "1"
+    os.environ["BA_TPU_SIGN_CACHE"] = "16"
+    _sign_pool.shutdown_defaults()
+    try:
+        metrics.configure(sink_dir)
+        from ba_tpu.runtime.serve import (
+            AgreementRequest, AgreementService, ServeConfig,
+        )
+
+        svc = AgreementService(
+            ServeConfig(max_batch=4, max_queue=8, coalesce_window_s=0.02)
+        )
+        svc.start()
+        errs = []
+
+        def _go(i):
+            try:
+                svc.submit(
+                    AgreementRequest(
+                        kind="run-rounds", n=4, seed=40 + i, rounds=3,
+                        m=1, signed=True,
+                        tenant="tenant-a" if i % 2 == 0 else "tenant-b",
+                    )
+                ).result(timeout=300)
+            except Exception as e:  # surfaced below, not swallowed
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=_go, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        svc.stop()
+        metrics.configure(None)
+        if errs:
+            print(f"sink-dir check: request failed: {errs[0]}",
+                  file=sys.stderr)
+            return 1
+
+        bad = 0
+        shards = fleet.list_shards(sink_dir)
+        if len(shards) < 2:
+            print(
+                f"sink-dir check: expected main + worker shards, got "
+                f"{[name for name, _ in shards]} — the pool "
+                f"worker never opened its own shard",
+                file=sys.stderr,
+            )
+            bad += 1
+        # Every shard leads with its clock anchor (the alignment
+        # contract merge_shards depends on).
+        anchor_spec = contracts.RECORD_FAMILIES["clock_anchor"]
+        for _name, sp in shards:
+            recs = fleet.read_shard(sp)
+            head = recs[0] if recs else {}
+            if not (
+                head.get("event") == "clock_anchor"
+                and head.get("v") == metrics.SCHEMA_VERSION
+                and all(k in head for k in anchor_spec["required"])
+                and isinstance(head.get("pid"), int)
+                and isinstance(head.get("shard"), str)
+                and fleet.SHARD_RE.match(head["shard"])
+                and isinstance(head.get("perf_t"), (int, float))
+                and isinstance(head.get("ts"), (int, float))
+            ):
+                print(
+                    f"sink-dir check: shard {_name} does "
+                    f"not lead with a well-formed clock_anchor: {head}",
+                    file=sys.stderr,
+                )
+                bad += 1
+        merged = fleet.merge_shards(sink_dir)
+        if fleet.merge_digest(merged) != fleet.merge_digest(
+            fleet.merge_shards(sink_dir)
+        ):
+            print("sink-dir check: merge is not deterministic",
+                  file=sys.stderr)
+            bad += 1
+        # The cross-process leg: worker pool_task spans, typed.
+        pool_spec = contracts.RECORD_FAMILIES["pool_task"]
+        pool_tasks = [r for r in merged if r.get("event") == "pool_task"]
+        if not pool_tasks:
+            print("sink-dir check: no pool_task record in any shard",
+                  file=sys.stderr)
+            bad += 1
+        main_pid = os.getpid()
+        for r in pool_tasks:
+            if not (
+                all(k in r for k in pool_spec["required"])
+                and r.get("kind") in ("sign", "verify")
+                and isinstance(r.get("rows"), int)
+                and r.get("rows") >= 1
+                and isinstance(r.get("wall_s"), (int, float))
+                and isinstance(r.get("t_perf"), (int, float))
+                # Worker provenance: the shard it landed in is not the
+                # main process's.
+                and int(fleet.SHARD_RE.match(r["shard"]).group(1))
+                != main_pid
+            ):
+                print(
+                    f"sink-dir check: malformed pool_task: {r}",
+                    file=sys.stderr,
+                )
+                bad += 1
+        # Every served request assembles into a fully-parented
+        # cross-process trace within the attribution tolerance.
+        trace_spec = contracts.RECORD_FAMILIES["request_trace"]
+        rids = fleet.request_ids(merged)
+        if len(rids) != 3:
+            print(
+                f"sink-dir check: expected 3 served requests, got {rids}",
+                file=sys.stderr,
+            )
+            bad += 1
+        hex_id = lambda s, n: (  # noqa: E731
+            isinstance(s, str) and len(s) == n
+            and all(c in "0123456789abcdef" for c in s)
+        )
+        for rid in rids:
+            tr = fleet.assemble_request_trace(merged, request_id=rid)
+            ok_shape = (
+                tr is not None
+                and tr.get("event") == "request_trace"
+                and tr.get("v") == metrics.SCHEMA_VERSION
+                and all(k in tr for k in trace_spec["required"])
+                and hex_id(tr.get("trace_id"), 32)
+                and tr.get("request_id") == rid
+                and hex_id(tr.get("root_span"), 16)
+                and isinstance(tr.get("spans"), list)
+                and tr.get("span_count") == len(tr["spans"])
+                and isinstance(tr.get("processes"), list)
+                and len(tr["processes"]) >= 2
+                and tr.get("unparented") == []
+                and isinstance(tr.get("critical_path"), list)
+                and all(
+                    isinstance(h.get("hop"), str)
+                    and isinstance(h.get("s"), (int, float))
+                    for h in tr.get("critical_path", [])
+                )
+                and isinstance(tr.get("attribution_s"), (int, float))
+                and isinstance(tr.get("wall_s"), (int, float))
+                and tr.get("within_tol") is True
+            )
+            if not ok_shape:
+                print(
+                    f"sink-dir check: malformed request_trace for "
+                    f"request {rid}: {tr}",
+                    file=sys.stderr,
+                )
+                bad += 1
+        # The stream folds into one typed fleet_summary.
+        summary_spec = contracts.RECORD_FAMILIES["fleet_summary"]
+        summary = fleet.fleet_summary(merged)
+        if not (
+            summary.get("event") == "fleet_summary"
+            and summary.get("v") == metrics.SCHEMA_VERSION
+            and all(k in summary for k in summary_spec["required"])
+            and isinstance(summary.get("replicas"), list)
+            and len(summary["replicas"]) >= 2
+            and all(
+                isinstance(rep.get("shard"), str)
+                and isinstance(rep.get("pid"), int)
+                and isinstance(rep.get("records"), int)
+                for rep in summary["replicas"]
+            )
+            and isinstance(summary.get("cohorts"), list)
+            and summary.get("requests") == len(rids)
+            and isinstance(summary.get("pool_tasks"), int)
+            and summary["pool_tasks"] >= 1
+            and summary.get("traces") == len(rids)
+        ):
+            print(
+                f"sink-dir check: malformed fleet_summary: {summary}",
+                file=sys.stderr,
+            )
+            bad += 1
+        if bad:
+            return 1
+        print(
+            f"fleet sink-dir schema OK ({len(shards)} shards, "
+            f"{len(merged)} records, {len(rids)} request traces, "
+            f"{len(pool_tasks)} pool tasks)"
+        )
+        return 0
+    finally:
+        metrics.configure(None)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _sign_pool.shutdown_defaults()
+        shutil.rmtree(sink_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":

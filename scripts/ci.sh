@@ -236,6 +236,14 @@ mutate_and_expect BA301 obs/aotcache.py \
 # live, not just inherited on paper.
 mutate_and_expect BA301 obs/slo.py \
     'from ba_tpu.core import om as _mut_core' || exit 1
+# ISSUE 19: the fleet aggregator (obs/fleet.py) is an obs module — the
+# STRICTER obs rule covers it via the ba_tpu.obs.* scope (it merges
+# OFFLINE shard streams and must stay importable jax-free for the CI
+# assembly stage below).  Prove the closure is live.  No BA501 seed:
+# this PR added NO threads — trace context rides the existing emit
+# paths by design (the zero-added-sync contract).
+mutate_and_expect BA301 obs/fleet.py \
+    'from ba_tpu.core import om as _mut_core' || exit 1
 # ISSUE 15: the adversary search loop (search/loop.py) joined the BA101
 # hot-path scope — its generation loop drives the coalesced engine's
 # dispatch stream, and a host sync there would serialize population
@@ -308,6 +316,20 @@ echo "== SLO policy round-trip (jax-free) =="
 # at the same sub-second cost.
 if ! python -m ba_tpu.obs.slo validate examples/slo/*.json; then
     echo "SLO policy validation failed" >&2
+    exit 1
+fi
+
+echo "== fleet trace assembly (jax-free) =="
+# ISSUE 19: the committed fixture shards (a real pooled signed serve
+# session captured in sink-directory mode — two processes, three
+# requests) must merge deterministically and assemble into fully-
+# parented request traces whose critical-path hop sums telescope to
+# the wall.  `python -m ba_tpu.obs.fleet` is jax-free by construction
+# (pinned by tests/test_fleet.py), so this stage costs milliseconds —
+# it exits non-zero on a nondeterministic merge, an unparented span,
+# an out-of-tolerance attribution or zero assembled traces.
+if ! python -m ba_tpu.obs.fleet tests/fixtures/fleet; then
+    echo "fleet trace assembly failed" >&2
     exit 1
 fi
 

@@ -358,6 +358,150 @@ def report_slo(path: str, run_id: str | None = None) -> int:
     return 0
 
 
+def report_fleet(path: str) -> int:
+    """Render a sink DIRECTORY (ISSUE 19 ``BA_TPU_METRICS=dir/`` mode)
+    as a fleet summary: the shard census with clock anchors, the
+    merged per-request table (wall vs phase-attribution sum, trace
+    span/process fan-out), the pool-task offload tally and a cohort
+    rollup.  Self-aggregates like ``report_slo`` — stdlib only, no
+    ba_tpu import (this script must run anywhere the shards were
+    copied to); ``python -m ba_tpu.obs.fleet DIR`` does the full
+    span-tree assembly with fan-in grafting."""
+    import re
+
+    shard_re = re.compile(r"^(\d+)\.(.+)\.jsonl$")
+    try:
+        names = sorted(n for n in os.listdir(path) if shard_re.match(n))
+    except OSError as e:
+        print(f"(cannot list {path}: {e})", file=sys.stderr)
+        return 1
+    if not names:
+        print(f"(no <pid>.<token>.jsonl shards in {path} — was the "
+              f"session run with BA_TPU_METRICS set to a directory?)",
+              file=sys.stderr)
+        return 1
+    merged: list = []
+    census: list = []
+    for name in names:
+        offset = None
+        recs = []
+        with open(os.path.join(path, name)) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail: skip, never fatal
+                if rec.get("event") == "clock_anchor":
+                    offset = rec.get("ts", 0.0) - rec.get("perf_t", 0.0)
+                recs.append(rec)
+        for i, rec in enumerate(recs):
+            t_perf = rec.get("t_perf")
+            if t_perf is not None and offset is not None:
+                t = t_perf + offset
+            else:
+                t = rec.get("ts") or 0.0
+            merged.append((round(t, 6), name, i, rec))
+        census.append((name, int(shard_re.match(name).group(1)),
+                       len(recs), offset))
+    merged.sort(key=lambda e: e[:3])
+    records = [rec for _, _, _, rec in merged]
+
+    print(f"== fleet shards ({path}) ==")
+    print(f"  {'shard':<36} {'pid':>8} {'records':>8} {'anchored':>9}")
+    for name, pid, n, offset in census:
+        print(f"  {name:<36} {pid:>8} {n:>8} "
+              f"{'yes' if offset is not None else 'NO':>9}")
+
+    spans = {}
+    parents = 0
+    unresolved = 0
+    external = 0
+    for rec in records:
+        sid = rec.get("span_id")
+        if sid:
+            spans.setdefault(sid, rec.get("trace_id"))
+    for rec in records:
+        pid_ = rec.get("parent_id")
+        if rec.get("span_id") and pid_ is not None:
+            parents += 1
+            if pid_ not in spans:
+                # A missing parent on an ADOPTION root (a request, or a
+                # zero-duration inject_scope mark) is the caller's
+                # injected traceparent — external by construction, not
+                # breakage.  Anything else lost its in-stream parent.
+                if rec.get("event") == "request" or (
+                    rec.get("event") == "trace_span"
+                    and rec.get("dur_s") == 0
+                ):
+                    external += 1
+                else:
+                    unresolved += 1
+
+    requests = [r for r in records if r.get("event") == "request"]
+    phase_names = (
+        "queue_s", "coalesce_s", "compile_s", "dispatch_s", "retire_lag_s"
+    )
+    if requests:
+        print("== requests ==")
+        print(f"  {'id':>4} {'cohort':<26} {'tenant':<10} {'status':<8} "
+              f"{'wall':>10} {'attrib':>10} {'tol':>4} {'spans':>6}")
+        by_trace: dict = {}
+        for sid, tid in spans.items():
+            if tid:
+                by_trace[tid] = by_trace.get(tid, 0) + 1
+        for r in requests:
+            phases = [r.get(k) for k in phase_names]
+            attrib = (sum(phases)
+                      if all(isinstance(p, (int, float)) for p in phases)
+                      else None)
+            wall = r.get("wall_s")
+            within = (attrib is not None
+                      and isinstance(wall, (int, float))
+                      and abs(attrib - wall) <= 2e-3)
+            print(
+                f"  {r.get('id', '?'):>4} {r.get('cohort', '?'):<26} "
+                f"{(r.get('tenant') or '-'):<10} {r.get('status', '?'):<8} "
+                f"{_fmt_s(wall) if wall is not None else '-':>10} "
+                f"{_fmt_s(attrib) if attrib is not None else '-':>10} "
+                f"{'ok' if within else 'BAD':>4} "
+                f"{by_trace.get(r.get('trace_id'), 0):>6}"
+            )
+    pool_tasks = [r for r in records if r.get("event") == "pool_task"]
+    if pool_tasks:
+        print("== pool offload ==")
+        kinds: dict = {}
+        for r in pool_tasks:
+            k = r.get("kind", "?")
+            rows, wall = kinds.get(k, (0, 0.0))
+            kinds[k] = (rows + (r.get("rows") or 0),
+                        wall + (r.get("wall_s") or 0.0))
+        for k, (rows, wall) in sorted(kinds.items()):
+            print(f"  {k:<10} {rows:>6} rows  {_fmt_s(wall):>10} total")
+    cohorts: dict = {}
+    for r in requests:
+        cohorts.setdefault(r.get("cohort", "?"), []).append(r)
+    if cohorts:
+        print("== cohorts ==")
+        print(f"  {'cohort':<26} {'requests':>8} {'ok':>5} {'p99 wall':>10}")
+        for name, rs in sorted(cohorts.items()):
+            walls = sorted(
+                r["wall_s"] for r in rs
+                if isinstance(r.get("wall_s"), (int, float))
+            )
+            p99 = walls[max(0, int(0.99 * len(walls)) - 1)] if walls else None
+            ok = sum(1 for r in rs if r.get("status") == "ok")
+            print(f"  {name:<26} {len(rs):>8} {ok:>5} "
+                  f"{_fmt_s(p99) if p99 is not None else '-':>10}")
+    print(f"== parenting ==")
+    print(f"  spans {len(spans)}  child-edges {parents}  "
+          f"external roots {external}  "
+          f"unresolved parents {unresolved}")
+    return 1 if unresolved else 0
+
+
 def report_metrics(path: str) -> None:
     events: dict = {}
     snapshot = None
@@ -430,7 +574,18 @@ def main() -> int:
                     help="render the SLO stream (ISSUE 17): phase "
                          "attribution table, error-budget timeline, "
                          "alert + autoscale trails")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render a sharded sink DIRECTORY (ISSUE 19 "
+                         "BA_TPU_METRICS=dir/ mode): shard census with "
+                         "clock anchors, merged per-request attribution "
+                         "table, pool offload + cohort rollup")
     args = ap.parse_args()
+    if args.fleet:
+        target = args.dir or args.metrics
+        if not target:
+            ap.error("--fleet takes the sink DIRECTORY (positional or "
+                     "--metrics)")
+        return report_fleet(target)
     trace, metrics = args.trace, args.metrics
     if args.dir:
         trace = trace or os.path.join(args.dir, "trace.json")
