@@ -149,6 +149,19 @@ RECORD_FAMILIES = {
          "worst_burn", "slo_alerts", "autoscale_last"),
         ci=False,
     ),
+    # ISSUE 20 fleet-tier families (ba_tpu/fleet/).  ``ci=False`` like
+    # the ISSUE 19 set: the MAIN schema session runs one service, no
+    # fleet — the dedicated 2-replica router stage in
+    # ``scripts/check_metrics_schema.py`` validates these end-to-end.
+    # Not ``run_scoped``: the emitters stamp ``run_id`` explicitly as
+    # DATA (the manager's fleet id / the campaign's id) wherever it is
+    # known, not via a sink run scope.
+    "router_route": _family(
+        ("request_id", "cohort", "replica", "hops", "rerouted"),
+        ci=False,
+    ),
+    "replica_state": _family(("replica", "state", "prev"), ci=False),
+    "migration": _family(("phase", "campaign", "from_replica"), ci=False),
 }
 
 # Families that by construction always carry ``run_id`` (must equal
@@ -249,6 +262,11 @@ ENV_DOCUMENTED = frozenset(
         "BA_TPU_SPAN_AB_ROUNDS",
         "BA_TPU_SPAN_AB_REPS",
         "BA_TPU_SPAN_AB_PLATFORM",
+        # Fleet tier (ba_tpu/fleet/replica.py — ISSUE 20).
+        "BA_TPU_FLEET_REPLICAS",
+        "BA_TPU_FLEET_HOPS",
+        "BA_TPU_FLEET_VNODES",
+        "BA_TPU_FLEET_ROOT",
     }
 )
 

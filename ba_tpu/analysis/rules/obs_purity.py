@@ -60,7 +60,17 @@ objective table, minimizer and corpus layers are numpy/stdlib by
 contract (the jax-free ``python -m ba_tpu.search`` CLI and the CI
 corpus stage depend on it), and the hunt loop reaches the coalesced
 engine only through function-body imports, exactly the serve
-dispatcher's sanctioned lazy seam.  The executable cache ``ba_tpu.obs.aotcache`` needs no listing
+dispatcher's sanctioned lazy seam.
+
+The FLEET TIER (ISSUE 20): every ``ba_tpu.fleet`` module joins the
+module-level host-tier scope — routing, replica state machines,
+handoff verification and orphan adoption are numpy/stdlib by contract
+(a router host needs no accelerator; checkpoint verification rides the
+jax-free ``utils/snapshot`` reader), and only a replica's campaign
+lane (``replica._campaign_lane``) reaches the supervised engine,
+through the same function-local seam as the serve dispatcher.
+
+The executable cache ``ba_tpu.obs.aotcache`` needs no listing
 — it sits inside the obs scope, whose STRICTER rule (even function-local
 core/ops imports are findings) already covers it; its specialization
 builders therefore live in ``parallel/pipeline.py`` and are passed in.
@@ -76,10 +86,12 @@ SCOPES = ("ba_tpu.core", "ba_tpu.ops")
 OBS = "ba_tpu.obs"
 SINK = "ba_tpu.utils.metrics"
 # Host-tier-at-module-level modules: the serving front-end (ISSUE 10),
-# the warmup pass (ISSUE 11), and the adversary search package
-# (ISSUE 15) — all must import jax-free (plan construction, admission,
-# and the search CLI's sample/corpus ops run on hosts without jax) and
-# reach the engine only through function-local imports.
+# the warmup pass (ISSUE 11), the adversary search package (ISSUE 15),
+# and the fleet tier (ISSUE 20) — all must import jax-free (plan
+# construction, admission, routing, handoff verification and the
+# search CLI's sample/corpus ops run on hosts without jax) and reach
+# the engine only through function-local imports (for the fleet: the
+# replica's campaign lane, ``replica._campaign_lane``).
 HOST_TIER_MODULES = (
     "ba_tpu.runtime.serve",
     "ba_tpu.runtime.warmup",
@@ -90,6 +102,14 @@ HOST_TIER_MODULES = (
     "ba_tpu.search.loop",
     "ba_tpu.search.minimize",
     "ba_tpu.search.corpus",
+    "ba_tpu.fleet",
+    "ba_tpu.fleet.router",
+    "ba_tpu.fleet.replica",
+    "ba_tpu.fleet.migrate",
+    # The jax-free checkpoint reader (its docstring contract since
+    # ISSUE 6; lint-enforced since the fleet tier started verifying
+    # handoffs through it): jax appears only inside load functions.
+    "ba_tpu.utils.snapshot",
 )
 
 
@@ -228,6 +248,22 @@ class ObsPurity(Rule):
                 )
                 continue
             nxt = project.resolve_target_module(target)
+            if (
+                module_level_only
+                and nxt
+                and nxt != mod.modname
+                and nxt in HOST_TIER_MODULES
+            ):
+                # A host-tier module importing ANOTHER host-tier module
+                # is the fleet tier's composition pattern (router →
+                # serve, replica → migrate/snapshot): the target's own
+                # module-level closure is enforced at its own entry,
+                # and its sanctioned FUNCTION-LOCAL engine seams must
+                # not poison importers through the unfiltered reaches
+                # walk below (which follows lazy edges by design — the
+                # right conservatism for unlisted intermediaries, the
+                # wrong one for modules this rule already covers).
+                continue
             if (
                 nxt
                 and nxt != mod.modname

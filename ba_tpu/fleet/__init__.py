@@ -1,0 +1,72 @@
+"""Elastic fleet tier (ISSUE 20): replicated serving, consistent-hash
+routing, serve-drain live migration.
+
+Three host-tier modules (BA301: importing any of them never touches
+jax — the engine is reached only inside a replica's campaign lane):
+
+- :mod:`ba_tpu.fleet.replica` — ``FleetConfig`` / ``Replica`` /
+  ``ReplicaManager``: N in-process ``AgreementService`` replicas with
+  per-replica registries, warm-gated ring entry, campaign lanes and
+  the crash-consistent campaign ledger.
+- :mod:`ba_tpu.fleet.router` — ``HashRing`` / ``FleetRouter`` /
+  ``RoutedTicket``: cohort-keyed consistent-hash routing, bounded
+  overload hops with origin ``retry_after_s`` propagation,
+  reroute-on-death, ``autoscale_signal`` consumption.
+- :mod:`ba_tpu.fleet.migrate` — ``drain`` / handoff headers /
+  ``adopt_orphans``: checkpoint-fingerprint-verified live migration
+  over the repo's one carry-checkpoint format.
+
+Quickstart::
+
+    from ba_tpu.fleet import FleetConfig, FleetRouter, ReplicaManager
+
+    mgr = ReplicaManager(FleetConfig(replicas=2, root="/tmp/fleet"))
+    mgr.start()                      # boot + warm barrier per replica
+    router = FleetRouter(mgr)
+    t = router.submit(AgreementRequest(kind="run-rounds", rounds=8))
+    out = t.result(timeout=60)       # survives replica death/drain
+    mgr.drain("replica-0")           # live-migrates its campaigns
+    mgr.stop()
+"""
+
+from ba_tpu.fleet.migrate import (
+    DrainStop,
+    HandoffRefused,
+    adopt_orphans,
+    drain,
+    read_handoff,
+    resume_handoff,
+    verify_handoff,
+    write_handoff,
+)
+from ba_tpu.fleet.replica import (
+    REPLICA_STATES,
+    CampaignHandle,
+    CampaignSpec,
+    FleetConfig,
+    Replica,
+    ReplicaManager,
+    read_ledger,
+)
+from ba_tpu.fleet.router import FleetRouter, HashRing, RoutedTicket
+
+__all__ = [
+    "REPLICA_STATES",
+    "CampaignHandle",
+    "CampaignSpec",
+    "DrainStop",
+    "FleetConfig",
+    "FleetRouter",
+    "HandoffRefused",
+    "HashRing",
+    "Replica",
+    "ReplicaManager",
+    "RoutedTicket",
+    "adopt_orphans",
+    "drain",
+    "read_handoff",
+    "read_ledger",
+    "resume_handoff",
+    "verify_handoff",
+    "write_handoff",
+]

@@ -58,6 +58,17 @@ stay byte-identical):
   tallies.  Library/bench clients submit via
   ``serve.AgreementService`` — the REPL command exists so one process
   can host the roster AND the service.
+- ``fleet start|stat|drain|stop`` (ISSUE 20) — control a local
+  replicated serving fleet (``ba_tpu.fleet``): ``start`` boots N
+  warm-gated ``AgreementService`` replicas behind a consistent-hash
+  router (``fleet start replicas=N root=DIR hops=N vnodes=N queue=N
+  window=S batch=N warm=0|1`` override the ``BA_TPU_FLEET_*`` /
+  ``BA_TPU_SERVE_*`` defaults), ``stat`` prints router tallies plus one
+  lock-free health line per replica, ``drain <replica>`` serve-drains
+  one replica and live-migrates its in-flight campaigns to a survivor,
+  ``stop`` drains the whole fleet.  Library/bench clients route via
+  ``fleet.FleetRouter`` — the REPL command exists so one process can
+  host the roster AND the fleet.
 - ``stats`` — dump the observability registry (``ba_tpu.obs``) as
   Prometheus-style text: round wall-time histogram, pipeline dispatch /
   retire latencies and depth occupancy, election and failover counters.
@@ -479,6 +490,102 @@ def _dispatch(cluster: Cluster, cmd: list, out) -> bool:
                 f"completed={st['completed']}, "
                 f"rejected={st['rejected']}, expired={st['expired']}, "
                 f"failed={st['failed']}")
+
+    elif command == "fleet":
+        # Framework extension (additive, ISSUE 20): control a local
+        # replicated serving fleet (``ba_tpu.fleet``).  Host-tier like
+        # `serve` — importing the fleet tier never touches jax
+        # (lint-pinned), so the command works on the PyBackend REPL.
+        args = [t for t in cmd[1:] if t]
+        if not args or args[0] not in ("start", "stat", "drain", "stop"):
+            out("fleet error: usage: fleet start [replicas=N] [root=DIR] "
+                "[hops=N] [vnodes=N] [queue=N] [window=S] [warm=0|1] | "
+                "fleet stat | fleet drain <replica> | fleet stop")
+            return True
+        from ba_tpu import fleet as fleet_mod
+        from ba_tpu.runtime import serve as serve_mod
+
+        mgr = getattr(cluster, "_fleet_manager", None)
+        if args[0] == "start":
+            if mgr is not None:
+                out("fleet error: already running (fleet stop first)")
+                return True
+            fleet_over, serve_over = {}, {}
+            names = {"replicas": (fleet_over, "replicas", int),
+                     "root": (fleet_over, "root", str),
+                     "hops": (fleet_over, "max_hops", int),
+                     "vnodes": (fleet_over, "vnodes", int),
+                     "queue": (serve_over, "max_queue", int),
+                     "window": (serve_over, "coalesce_window_s", float),
+                     "batch": (serve_over, "max_batch", int),
+                     "warm": (serve_over, "warm", int)}
+            for tok in args[1:]:
+                key, sep, val = tok.partition("=")
+                if not sep or key not in names:
+                    out(f"fleet error: unknown option {tok!r} (usage: "
+                        f"fleet start [replicas=N] [root=DIR] [hops=N] "
+                        f"[vnodes=N] [queue=N] [window=S] [batch=N] "
+                        f"[warm=0|1])")
+                    return True
+                target, field, cast = names[key]
+                try:
+                    target[field] = cast(val)
+                except ValueError:
+                    out(f"fleet error: {key}= wants a {cast.__name__}, "
+                        f"got {val!r}")
+                    return True
+            if "warm" in serve_over:
+                serve_over["warm"] = bool(serve_over["warm"])
+            try:
+                fcfg = fleet_mod.FleetConfig.from_env(**fleet_over)
+                scfg = serve_mod.ServeConfig.from_env(**serve_over)
+            except ValueError as e:
+                out(f"fleet error: {e}")
+                return True
+            mgr = fleet_mod.ReplicaManager(fcfg, serve_config=scfg)
+            try:
+                mgr.start()
+            except serve_mod.ServeError as e:
+                mgr.stop()
+                out(f"fleet error: {e}")
+                return True
+            cluster._fleet_manager = mgr
+            cluster._fleet_router = fleet_mod.FleetRouter(mgr)
+            out(f"fleet: started {len(mgr.ready())} replica(s) "
+                f"(hops={fcfg.max_hops}, vnodes={fcfg.vnodes}"
+                + (f", root={fcfg.root}" if fcfg.root else "")
+                + (", warm" if scfg.warm else "") + ")")
+        elif mgr is None:
+            out("fleet error: not running (fleet start first)")
+        elif args[0] == "stat":
+            router = cluster._fleet_router
+            st = router.stats()
+            out(f"fleet_routes {st['routes']}")
+            out(f"fleet_reroutes {st['reroutes']}")
+            out(f"fleet_ready {st['ready']}")
+            for h in st["replicas"]:
+                out(f"fleet_replica {h['replica']} state={h['state']} "
+                    f"queue={h['queue_depth']} tier={h['tier']} "
+                    f"admitted={h['admitted']} rejected={h['rejected']}")
+        elif args[0] == "drain":
+            if len(args) != 2:
+                out("fleet error: usage: fleet drain <replica>")
+                return True
+            try:
+                adopted = mgr.drain(args[1])
+            except (KeyError, serve_mod.ServeError) as e:
+                out(f"fleet error: {e}")
+                return True
+            out(f"fleet: drained {args[1]} — "
+                f"{len(adopted)} campaign(s) migrated, "
+                f"{len(mgr.ready())} replica(s) still serving")
+        else:  # stop
+            mgr.stop()
+            st = cluster._fleet_router.stats()
+            cluster._fleet_manager = None
+            cluster._fleet_router = None
+            out(f"fleet: stopped — routes={st['routes']}, "
+                f"reroutes={st['reroutes']}")
 
     elif command == "g-state":
         if len(cmd) == 3:

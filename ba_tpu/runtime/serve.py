@@ -812,6 +812,40 @@ class AgreementService:
             if obs.slo.installed() is self._slo:
                 obs.slo.install(None)
 
+    def handoff(self, timeout: float | None = None) -> list:
+        """The fleet drain hook (ISSUE 20): close admission, let the
+        in-flight cohort retire normally, then DETACH the queued-but-
+        never-dispatched tickets — failed with a re-homable
+        :class:`ServeError` so no caller ever hangs, but NOT counted as
+        failures and with NO terminal ``request`` record emitted: a
+        drain is a move, not an outcome, and the replica that finally
+        dispatches the request owns its one terminal record (the
+        router's :class:`~ba_tpu.fleet.router.RoutedTicket` catches
+        exactly this error and re-submits on a surviving replica).
+
+        Returns the detached tickets (fleet accounting).  Unlike
+        :meth:`stop` this leaves the process-shared resources — the
+        signing pool, the SLO hook — alone: other replicas in the
+        process are still serving on them."""
+        leftovers = []
+        with self._cond:
+            self._open = False
+            self._drain = False
+            while self._queue:
+                leftovers.append(self._queue.popleft())
+            self._gauge_queue_locked()
+            self._cond.notify_all()
+        if self._warmup is not None:
+            self._warmup.stop()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        for t in leftovers:
+            t._fail(ServeError(
+                f"request {t.id} re-homed: replica draining"
+            ))
+        obs.instant("serve_handoff", rehomed=len(leftovers))
+        return leftovers
+
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 

@@ -423,6 +423,13 @@ class WarmupRunner:
     def done(self) -> bool:
         return self._done.is_set()
 
+    def ok(self) -> bool:
+        """The ring-entry gate (ISSUE 20): the pass finished AND every
+        planned signature warmed — zero errors.  A fleet replica joins
+        the ring only when this reads True, so a half-warmed replica
+        can never leak request-path compiles into a warm fleet."""
+        return self._done.is_set() and self.errors == 0
+
     def progress(self) -> dict:
         return {
             "planned": len(self._plan),

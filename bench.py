@@ -2622,6 +2622,352 @@ def bench_fleet_trace(jax, jnp, jr):
     }
 
 
+def bench_serving_fleet(jax, jnp, jr):
+    """Elastic-fleet config (ISSUE 20 acceptance): does a replicated
+    fleet survive losing a member mid-run with zero hung clients and a
+    bit-exact migrated campaign?
+
+    Two legs over identical request fleets (one cohort, so every
+    request hashes to the same ring home — the worst case for a kill):
+
+    1. ``single`` — a 1-replica fleet behind the router: the baseline
+       a replicated deployment must not regress, plus the per-request
+       bit-exactness refs (B=1 alone runs).
+    2. ``fleet`` — 3 replicas (overlapped warm barriers off a shared
+       AOT cache), a live checkpointing campaign on the cohort's hash
+       HOME replica, the same client fleet through the router — and
+       the home replica is SIGKILLed mid-run.  Queued tickets fail,
+       ``RoutedTicket`` re-homes them on survivors inside the caller's
+       original timeout; the campaign is abandoned (no handoff header,
+       only the fsync'd ledger + periodic checkpoints survive) and
+       ``adopt_orphans`` resumes it fingerprint-verified on a survivor.
+
+    The acceptance booleans — all asserted, never just recorded:
+
+    - ``reroute_zero_hung_clients`` — every client got a result
+      (bit-exact vs its alone ref) through the kill; no error, no hang.
+    - ``migrated_bit_exact`` — the adopted campaign's decisions and
+      histograms equal an uninterrupted same-seed run's, with the full
+      reassembled history (``history_start == 0``).
+    - ``no_request_path_compiles_fleet`` — the per-replica
+      ``serve_compile_on_request_path_total`` counters sum to ZERO
+      across BOTH legs' rosters (ring entry is warm-gated).
+    - ``queue_bounded_all_replicas`` — a health sampler polling every
+      replica's lock-free gauges through the storm never saw a queue
+      above ``max_queue``.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from ba_tpu import obs
+    from ba_tpu.core.state import SimState
+    from ba_tpu.core.types import COMMAND_DTYPE, command_from_name
+    from ba_tpu.fleet import (
+        CampaignSpec,
+        FleetConfig,
+        FleetRouter,
+        ReplicaManager,
+    )
+    from ba_tpu.parallel import make_sweep_state
+    from ba_tpu.parallel.pipeline import coalesced_sweep, fresh_copy
+    from ba_tpu.runtime.serve import (
+        AgreementRequest,
+        ServeConfig,
+        cohort_key,
+        cohort_label,
+    )
+    from ba_tpu.runtime.supervisor import (
+        SupervisorConfig,
+        supervised_sweep,
+    )
+
+    clients = int(os.environ.get("BA_TPU_BENCH_FLEET_SERVE_CLIENTS", 6))
+    per_client = int(os.environ.get("BA_TPU_BENCH_FLEET_SERVE_REQS", 3))
+    rounds = int(os.environ.get("BA_TPU_BENCH_FLEET_SERVE_ROUNDS", 32))
+    camp_rounds = int(
+        os.environ.get("BA_TPU_BENCH_FLEET_CAMPAIGN_ROUNDS", 4000)
+    )
+    max_batch = 4
+    max_queue = 4 * max_batch
+    cap = 4
+
+    def request(c, j):
+        i = c * per_client + j
+        return AgreementRequest(
+            kind="run-rounds",
+            order=("attack", "retreat")[i % 2],
+            n=4,
+            faulty=((2,), (), (1, 3))[i % 3],
+            seed=9000 + i,
+            rounds=rounds,
+        )
+
+    requests = [
+        request(c, j) for c in range(clients) for j in range(per_client)
+    ]
+
+    def alone(req):
+        faulty = np.zeros((1, cap), np.bool_)
+        alive = np.zeros((1, cap), np.bool_)
+        alive[0, : req.n] = True
+        for i in req.faulty:
+            faulty[0, i] = True
+        state = fresh_copy(
+            SimState(
+                order=jnp.full(
+                    (1,), command_from_name(req.order), COMMAND_DTYPE
+                ),
+                leader=jnp.zeros((1,), jnp.int32),
+                faulty=jnp.asarray(faulty),
+                alive=jnp.asarray(alive),
+                ids=jnp.asarray(
+                    np.arange(1, cap + 1, dtype=np.int32)[None, :]
+                ),
+            )
+        )
+        return coalesced_sweep(
+            [jr.key(req.seed)], state, rounds, rounds_per_dispatch=8
+        )
+
+    alone(requests[0])  # B=1 specialization warms off the clock
+    refs = {}
+    for req in requests:
+        out = alone(req)
+        refs[req.seed] = [int(v) for v in out["decisions"][:, 0]]
+
+    def serve_config(aot_dir):
+        return ServeConfig(
+            max_batch=max_batch, max_queue=max_queue,
+            coalesce_window_s=0.02, rounds_per_dispatch=8,
+            warm=True, warm_rounds=rounds, aot_cache=aot_dir,
+            warm_scenarios=False,
+        )
+
+    def drive(router, on_started=None):
+        """The shared client fleet through the ROUTER: returns
+        (latencies, per-seed decisions, errors, wall)."""
+        latencies = [0.0] * len(requests)
+        results = {}
+        errors = []
+        lock = threading.Lock()
+        started = threading.Barrier(clients + 1)
+
+        def client(c):
+            started.wait(timeout=60)
+            for j in range(per_client):
+                req = request(c, j)
+                t0 = time.perf_counter()
+                try:
+                    out = router.submit(req, deadline_s=None).result(
+                        timeout=600
+                    )
+                except Exception as e:
+                    errors.append(f"{type(e).__name__}: {e}")
+                    return
+                wall = time.perf_counter() - t0
+                with lock:
+                    latencies[c * per_client + j] = wall
+                    results[req.seed] = [int(v) for v in out["decisions"]]
+
+        threads = [
+            threading.Thread(target=client, args=(c,))
+            for c in range(clients)
+        ]
+        for th in threads:
+            th.start()
+        started.wait(timeout=60)
+        t0 = time.perf_counter()
+        if on_started is not None:
+            on_started()
+        for th in threads:
+            th.join(timeout=900)
+        return latencies, results, errors, time.perf_counter() - t0
+
+    def pcts(latencies):
+        lat = sorted(latencies)
+        return (
+            lat[len(lat) // 2],
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+        )
+
+    fleet_root = tempfile.mkdtemp(prefix="ba_fleet_serve_")
+    with tempfile.TemporaryDirectory() as aot_dir:
+        # Leg 1: SINGLE — one replica behind the router, the baseline.
+        obs.reset_first_calls()
+        mgr1 = ReplicaManager(
+            FleetConfig(replicas=1), serve_config=serve_config(aot_dir)
+        )
+        t0 = time.perf_counter()
+        mgr1.start(warm_timeout_s=600)
+        t_warm_single = time.perf_counter() - t0
+        router1 = FleetRouter(mgr1)
+        one_lat, one_res, one_err, t_single = drive(router1)
+        assert not one_err, one_err
+        single_rpc = sum(
+            r.registry.counter(
+                "serve_compile_on_request_path_total"
+            ).value
+            for r in mgr1.all()
+        )
+        mgr1.stop()
+        one_mismatch = [
+            seed for seed, dec in one_res.items() if dec != refs[seed]
+        ]
+        assert not one_mismatch, (
+            f"single-replica fleet diverged: {one_mismatch}"
+        )
+        single_p50, single_p99 = pcts(one_lat)
+
+        # Leg 2: FLEET — 3 replicas, a live campaign on the cohort's
+        # hash home, and that home killed mid-run.
+        obs.reset_first_calls()
+        mgr = ReplicaManager(
+            FleetConfig(replicas=3, root=fleet_root),
+            serve_config=serve_config(aot_dir),
+        )
+        t0 = time.perf_counter()
+        mgr.start(warm_timeout_s=600)
+        t_warm_fleet = time.perf_counter() - t0
+        router = FleetRouter(mgr)
+        router._sync_ring()
+        label = cohort_label(cohort_key(requests[0]))
+        victim = router._ring.prefer(label)[0]
+
+        spec = CampaignSpec(
+            campaign="bench-fleet", seed=71, state_seed=72, batch=8,
+            rounds=camp_rounds, capacity=cap, checkpoint_every=8,
+        )
+        handle = mgr.get(victim).run_campaign(spec)
+        t0 = time.perf_counter()
+        while handle.fingerprint is None and not handle.done():
+            time.sleep(0.002)
+            assert time.perf_counter() - t0 < 120, (
+                "campaign produced no fingerprinted checkpoint"
+            )
+
+        # Lock-free health sampler: the queue-bound witness.
+        peak = {r.name: 0 for r in mgr.all()}
+        sampling = threading.Event()
+        sampling.set()
+
+        def sample():
+            while sampling.is_set():
+                for r in mgr.all():
+                    depth = r.health()["queue_depth"]
+                    if depth > peak[r.name]:
+                        peak[r.name] = depth
+                time.sleep(0.002)
+
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+
+        def kill_home():
+            time.sleep(0.05)  # let the first window queue on the home
+            mgr.kill(victim)
+
+        lat, res, err, t_fleet = drive(router, on_started=kill_home)
+        sampling.clear()
+        sampler.join(timeout=10)
+        assert not err, f"hung/failed clients through the kill: {err}"
+        mismatch = [
+            seed for seed, dec in res.items() if dec != refs[seed]
+        ]
+        assert not mismatch, f"fleet serving diverged: {mismatch}"
+        rstats = router.stats()
+
+        # The killed home abandoned its campaign (no handoff header) —
+        # adopt the orphan on a survivor and run it to completion.
+        assert handle.wait(60) and handle.outcome == "abandoned", (
+            f"campaign outcome {handle.outcome!r} — expected the kill "
+            f"to land mid-campaign (raise "
+            f"BA_TPU_BENCH_FLEET_CAMPAIGN_ROUNDS?)"
+        )
+        adopted = mgr.adopt_orphans(victim)
+        assert len(adopted) == 1, f"adopted {len(adopted)} campaigns"
+        assert adopted[0].wait(600) and adopted[0].outcome == "completed", (
+            f"adopted campaign ended {adopted[0].outcome!r}: "
+            f"{adopted[0].error}"
+        )
+        fleet_rpc = sum(
+            r.registry.counter(
+                "serve_compile_on_request_path_total"
+            ).value
+            for r in mgr.all()
+        )
+        mgr.stop()
+
+    want = supervised_sweep(
+        jr.key(spec.seed),
+        make_sweep_state(jr.key(spec.state_seed), spec.batch, cap),
+        camp_rounds,
+        rounds_per_dispatch=spec.rounds_per_dispatch,
+        collect_decisions=True,
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+    got = adopted[0].result
+    migrated_bit_exact = (
+        np.array_equal(want["decisions"], got["decisions"])
+        and np.array_equal(want["histograms"], got["histograms"])
+        and got["supervisor"]["history_start"] == 0
+    )
+    assert migrated_bit_exact, (
+        "adopted campaign diverged from the uninterrupted same-seed "
+        "run (or lost reassembled history)"
+    )
+    assert single_rpc == 0 and fleet_rpc == 0, (
+        f"request-path compiles: single={single_rpc} fleet={fleet_rpc} "
+        f"(ring entry must be warm-gated)"
+    )
+    over = {n: d for n, d in peak.items() if d > max_queue}
+    assert not over, f"queue bound {max_queue} exceeded: {over}"
+    shutil.rmtree(fleet_root)  # asserts passed — a failing run keeps it
+    fleet_p50, fleet_p99 = pcts(lat)
+
+    return {
+        "rounds_per_sec": round(len(requests) * rounds / t_fleet, 1),
+        "clients": clients,
+        "requests": len(requests),
+        "rounds": rounds,
+        "max_batch": max_batch,
+        "max_queue": max_queue,
+        "replicas": 3,
+        "victim": victim,
+        "campaign_rounds": camp_rounds,
+        "single_warmup_wall_s": round(t_warm_single, 4),
+        "single_elapsed_s": round(t_single, 4),
+        "single_p50_latency_s": round(single_p50, 4),
+        "single_p99_latency_s": round(single_p99, 4),
+        "fleet_warmup_wall_s": round(t_warm_fleet, 4),
+        "fleet_elapsed_s": round(t_fleet, 4),
+        "fleet_p50_latency_s": round(fleet_p50, 4),
+        "fleet_p99_latency_s": round(fleet_p99, 4),
+        "routes": rstats["routes"],
+        "reroutes": rstats["reroutes"],
+        "peak_queue_depths": peak,
+        "reroute_zero_hung_clients": not err and not mismatch,
+        "migrated_bit_exact": migrated_bit_exact,
+        "no_request_path_compiles_fleet": (
+            single_rpc == 0 and fleet_rpc == 0
+        ),
+        "queue_bounded_all_replicas": not over,
+        "bound": "one cohort, so the whole fleet's traffic hashes to "
+                 "ONE home replica — killing it mid-run is the "
+                 "worst-case membership change; every boolean is "
+                 "asserted, a regression fails the bench rather than "
+                 "flipping a committed boolean",
+        "note": "the kill fires 50ms into the client storm (queued "
+                "tickets fail and re-home via RoutedTicket inside the "
+                "caller's original timeout); the abandoned campaign "
+                "leaves only fsync'd ledger rows + periodic "
+                "checkpoints, and adopt_orphans resumes it "
+                "fingerprint-verified on a survivor, bit-exact vs the "
+                "uninterrupted same-seed run",
+    }
+
+
 _MULTICHIP_CHILD = r'''
 import dataclasses, hashlib, json, sys, time
 
@@ -3931,6 +4277,7 @@ CONFIGS = {
     "serving_warm": bench_serving_warm,
     "serving_slo": bench_serving_slo,
     "fleet_trace": bench_fleet_trace,
+    "serving_fleet": bench_serving_fleet,
     "multichip": bench_multichip,
     "sweep10k_signed": bench_sweep10k_signed,
     "sm1_n64_signed": bench_sm1_n64_signed,
@@ -3950,18 +4297,21 @@ CONFIGS = {
 # dozens of shrink trials, signed_throughput runs the signed sweep
 # five times over (pool spawns + a cache-populating pass per leg), and
 # serving_slo sleeps through real burn windows (quiet gap + recovery)
-# around a deadline-storm burst, and fleet_trace pays a warm AOT pass
-# plus a sign-pool respawn in sink-directory mode —
+# around a deadline-storm burst, fleet_trace pays a warm AOT pass
+# plus a sign-pool respawn in sink-directory mode, and serving_fleet
+# warm-boots FOUR replicas across its two legs plus a multi-thousand-
+# round kill-and-adopt campaign drill —
 # all opt in explicitly: `--configs scenario_long` / `resilience` /
 # `multichip` / `serving` / `serving_warm` / `serving_slo` /
-# `fleet_trace` / `megastep_ab` / `adversary_search` /
-# `signed_throughput`.
+# `fleet_trace` / `serving_fleet` / `megastep_ab` /
+# `adversary_search` / `signed_throughput`.
 DEFAULT_CONFIGS = [
     n for n in CONFIGS
     if n not in (
         "scenario_long", "resilience", "multichip", "serving",
-        "serving_warm", "serving_slo", "fleet_trace", "megastep_ab",
-        "signed_ab", "adversary_search", "signed_throughput",
+        "serving_warm", "serving_slo", "fleet_trace", "serving_fleet",
+        "megastep_ab", "signed_ab", "adversary_search",
+        "signed_throughput",
     )
 ]
 

@@ -59,7 +59,8 @@ _ACCEPT_KEY = re.compile(
     r"|speedup_ge"  # ISSUE 16: signed_throughput's speedup_ge_3x gate
     r"|fired_and_cleared"  # ISSUE 17: serving_slo burn-alert lifecycle
     r"|all_spans_parented"  # ISSUE 19: fleet_trace tree completeness
-    r"|merge_deterministic)"  # ISSUE 19: fleet_trace shard-merge pin
+    r"|merge_deterministic"  # ISSUE 19: fleet_trace shard-merge pin
+    r"|reroute_zero_hung)"  # ISSUE 20: serving_fleet kill-drill boolean
 )
 
 

@@ -1349,7 +1349,7 @@ def check_sink_dir() -> int:
             f"{len(merged)} records, {len(rids)} request traces, "
             f"{len(pool_tasks)} pool tasks)"
         )
-        return 0
+        return check_fleet_router()
     finally:
         metrics.configure(None)
         for k, v in saved_env.items():
@@ -1359,6 +1359,196 @@ def check_sink_dir() -> int:
                 os.environ[k] = v
         _sign_pool.shutdown_defaults()
         shutil.rmtree(sink_dir, ignore_errors=True)
+
+
+def check_fleet_router() -> int:
+    """Fleet-router stage (ISSUE 20): drive a 2-replica routed serve
+    session plus ONE live serve-drain migration — routed requests
+    through ``FleetRouter.submit``, a campaign drained mid-flight off
+    ``replica-1`` and resumed on the survivor — then validate the three
+    new record families end-to-end: every ``router_route`` is typed,
+    ``run_id``-stamped and carries a parseable ``traceparent`` (routed
+    admissions join the PR 19 causal trees), every ``replica_state``
+    transition is within the pinned state machine and ``replica-1``
+    walked ``ready → draining → stopped``, and the ``migration`` stream
+    shows the full ``drain_start → handoff → resume`` lifecycle.
+    Required keys come from ``analysis/contracts.RECORD_FAMILIES``,
+    the same registry BA601 checks the emit sites against."""
+    import shutil
+    import threading
+    import time
+
+    from ba_tpu.analysis import contracts
+    from ba_tpu.utils import metrics
+
+    fd, path = tempfile.mkstemp(suffix=".router.jsonl")
+    os.close(fd)
+    root = tempfile.mkdtemp(suffix=".fleetroot")
+    try:
+        metrics.configure(path)
+        from ba_tpu.fleet import (
+            REPLICA_STATES,
+            CampaignSpec,
+            FleetConfig,
+            FleetRouter,
+            ReplicaManager,
+        )
+        from ba_tpu.runtime.serve import AgreementRequest, ServeConfig
+
+        mgr = ReplicaManager(
+            FleetConfig(replicas=2, root=root),
+            serve_config=ServeConfig(
+                max_queue=8, coalesce_window_s=0.01, warm=False
+            ),
+        )
+        mgr.start()
+        router = FleetRouter(mgr)
+        errs = []
+
+        def _go(i):
+            try:
+                router.submit(
+                    AgreementRequest(
+                        kind="run-rounds", n=4, seed=70 + i, rounds=2
+                    ),
+                    deadline_s=None,
+                ).result(timeout=300)
+            except Exception as e:  # surfaced below, not swallowed
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=_go, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        handle = mgr.get("replica-1").run_campaign(CampaignSpec(
+            campaign="schema-mig", seed=31, state_seed=32, batch=4,
+            rounds=1200, capacity=4, checkpoint_every=8,
+        ))
+        deadline = time.perf_counter() + 120
+        while handle.fingerprint is None and not handle.done():
+            if time.perf_counter() > deadline:
+                print("router check: campaign never checkpointed",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.02)
+        adopted = mgr.drain("replica-1")
+        if errs:
+            print(f"router check: routed request failed: {errs[0]}",
+                  file=sys.stderr)
+            return 1
+        if handle.outcome != "handoff" or len(adopted) != 1:
+            print(
+                f"router check: expected one handoff migration, got "
+                f"outcome={handle.outcome} adopted={len(adopted)}",
+                file=sys.stderr,
+            )
+            return 1
+        if not adopted[0].wait(300) or adopted[0].outcome != "completed":
+            print(
+                f"router check: resumed campaign did not complete "
+                f"({adopted[0].outcome}: {adopted[0].error})",
+                file=sys.stderr,
+            )
+            return 1
+        mgr.stop()
+        metrics.configure(None)
+
+        with open(path, encoding="utf-8") as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        by_event: dict = {}
+        for r in recs:
+            by_event.setdefault(r.get("event"), []).append(r)
+        bad = 0
+        replica_names = {r.name for r in mgr.all()}
+
+        routes = by_event.get("router_route", [])
+        route_spec = contracts.RECORD_FAMILIES["router_route"]
+        if len(routes) < 3:
+            print(f"router check: expected >= 3 router_route records, "
+                  f"got {len(routes)}", file=sys.stderr)
+            bad += 1
+        for r in routes:
+            if not (
+                all(k in r for k in route_spec["required"])
+                and isinstance(r.get("request_id"), int)
+                and isinstance(r.get("cohort"), str)
+                and r.get("replica") in replica_names
+                and isinstance(r.get("hops"), int)
+                and r["hops"] >= 1
+                and isinstance(r.get("rerouted"), bool)
+                # run_id + traceparent presence (the ISSUE 20
+                # satellite): routed admissions are run-scoped AND
+                # join the causal trees.
+                and r.get("run_id") == mgr.run_id
+                and metrics.parse_traceparent(r.get("traceparent"))
+                is not None
+            ):
+                print(f"router check: malformed router_route: {r}",
+                      file=sys.stderr)
+                bad += 1
+        states = by_event.get("replica_state", [])
+        state_spec = contracts.RECORD_FAMILIES["replica_state"]
+        for r in states:
+            if not (
+                all(k in r for k in state_spec["required"])
+                and r.get("replica") in replica_names
+                and r.get("state") in REPLICA_STATES
+                and r.get("prev") in REPLICA_STATES
+                and r.get("run_id") == mgr.run_id
+            ):
+                print(f"router check: malformed replica_state: {r}",
+                      file=sys.stderr)
+                bad += 1
+        walked = [
+            (r["prev"], r["state"]) for r in states
+            if r.get("replica") == "replica-1"
+        ]
+        for edge in (
+            ("new", "booting"), ("booting", "ready"),
+            ("ready", "draining"), ("draining", "stopped"),
+        ):
+            if edge not in walked:
+                print(
+                    f"router check: replica-1 never walked {edge} "
+                    f"(saw {walked})",
+                    file=sys.stderr,
+                )
+                bad += 1
+        migrations = by_event.get("migration", [])
+        mig_spec = contracts.RECORD_FAMILIES["migration"]
+        for r in migrations:
+            if not (
+                all(k in r for k in mig_spec["required"])
+                and isinstance(r.get("phase"), str)
+                and isinstance(r.get("campaign"), str)
+                and r.get("from_replica") in replica_names
+            ):
+                print(f"router check: malformed migration: {r}",
+                      file=sys.stderr)
+                bad += 1
+        phases = {r.get("phase") for r in migrations}
+        if not {"drain_start", "handoff", "resume"} <= phases:
+            print(
+                f"router check: migration lifecycle incomplete "
+                f"(saw phases {sorted(phases)})",
+                file=sys.stderr,
+            )
+            bad += 1
+        if bad:
+            return 1
+        print(
+            f"fleet router schema OK ({len(routes)} routes, "
+            f"{len(states)} replica_state transitions, "
+            f"{len(migrations)} migration records)"
+        )
+        return 0
+    finally:
+        metrics.configure(None)
+        os.unlink(path)
+        shutil.rmtree(root, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -255,6 +255,33 @@ mutate_and_expect BA101 search/loop.py \
 # CLI / CI corpus stage depend on it) — prove that direction too.
 mutate_and_expect BA301 search/generate.py \
     'from ba_tpu.core import om as _mut_core' || exit 1
+# ISSUE 20: the fleet tier joined the module-level host-tier scope — a
+# router host needs no accelerator, so `import ba_tpu.fleet.router`
+# must never pull the jitted trees (the engine is reached only inside
+# a replica's campaign lane, function-locally).  Prove both directions:
+# a direct core import and the likelier indirect breach through the
+# engine (parallel.pipeline is NOT itself host-tier, so the closure
+# walk must still flag it).
+mutate_and_expect BA301 fleet/router.py \
+    'from ba_tpu.core import om as _mut_core' || exit 1
+mutate_and_expect BA301 fleet/router.py \
+    'from ba_tpu.parallel import pipeline as _mut_engine' || exit 1
+mutate_and_expect BA301 fleet/migrate.py \
+    'from ba_tpu.ops import sweep_step as _mut_ops' || exit 1
+# ...and BA501's thread-entry discovery covers the fleet's campaign
+# lanes (replica.py is thread-dense: boot threads, lane threads, drain
+# events) — prove a raced attribute between a lane entry and a public
+# method seeds red there too.
+mutate_and_expect BA501 fleet/replica.py \
+    'import threading as _mut_th
+class _Mut501Fleet:
+    def __init__(self):
+        self._t = _mut_th.Thread(target=self._lane, daemon=True)
+        self._t.start()
+    def _lane(self):
+        self.n = 1
+    def poke(self):
+        self.n = 2' || exit 1
 # ISSUE 18: one seed per NEW rule family.  BA501 — a thread entry and a
 # public method both write the same attribute with no common lock (the
 # exact shape of the serve-tier race this PR fixed with _tier_lock).

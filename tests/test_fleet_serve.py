@@ -1,0 +1,532 @@
+"""Fleet tier tests (ISSUE 20).
+
+Two layers, mirroring the serving tests' split:
+
+- the jax-free layer: hash-ring determinism and minimal-movement,
+  fleet config validation + env parsing, campaign-spec doc round-trip,
+  ledger folding, handoff header grammar, the serve-side ``handoff()``
+  drain hook (re-homed tickets are NOT failures), the warm ring-entry
+  gate, and the zero-in-flight drain no-op edge (no empty checkpoint
+  or handoff files);
+- the engine-backed layer: the kill-a-replica drill — a live campaign
+  drained mid-flight resumes BIT-EXACTLY on a survivor, a SIGKILLed
+  replica's orphans are adopted by ledgered fingerprint, forged
+  handoff headers are refused (cross-protocol), and concurrent routed
+  clients never hang through either event.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ba_tpu.fleet import (
+    CampaignSpec,
+    FleetConfig,
+    FleetRouter,
+    HandoffRefused,
+    HashRing,
+    ReplicaManager,
+    read_handoff,
+    read_ledger,
+    verify_handoff,
+    write_handoff,
+)
+from ba_tpu.fleet.router import _point
+from ba_tpu.obs.registry import MetricsRegistry
+from ba_tpu.runtime.serve import (
+    AgreementRequest,
+    AgreementService,
+    ServeConfig,
+    ServeError,
+)
+
+
+# -- jax-free layer -----------------------------------------------------------
+
+
+def test_fleet_import_is_jax_free():
+    # The BA301 host-tier contract, proven at runtime: a router host
+    # needs no accelerator — importing the fleet tier (router, replica
+    # state machine, migration verifier) must not pull jax.
+    code = (
+        "import sys; import ba_tpu.fleet; "
+        "assert 'jax' not in sys.modules, 'fleet import pulled jax'; "
+        "print('ok')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+def test_hash_ring_deterministic_and_minimal_movement():
+    # The vnode construction is content-addressed: pinned literally so
+    # an accidental hash-grammar change (which would re-home every
+    # cohort in a live fleet) fails a test, not a deployment.
+    assert _point("replica-0", 0) == 17044263878877797094
+    members = ["replica-0", "replica-1", "replica-2"]
+    a = HashRing(members, vnodes=64)
+    b = HashRing(reversed(members), vnodes=64)  # order-insensitive
+    keys = [f"plain.r{r}.c4.xla.m1" for r in (1, 2, 4, 8, 16, 32)]
+    for k in keys:
+        order = a.prefer(k)
+        assert order == b.prefer(k)
+        assert sorted(order) == sorted(members)  # every member once
+    # Minimal movement: removing one member only re-homes the cohorts
+    # whose hash home WAS that member; everyone else keeps theirs.
+    gone = "replica-1"
+    small = HashRing([m for m in members if m != gone], vnodes=64)
+    for k in keys:
+        before = a.prefer(k)[0]
+        after = small.prefer(k)[0]
+        if before != gone:
+            assert after == before
+    assert HashRing((), vnodes=64).prefer("anything") == []
+    with pytest.raises(ValueError):
+        HashRing(members, vnodes=0)
+
+
+def test_fleet_config_validate_and_env(monkeypatch):
+    with pytest.raises(ValueError):
+        FleetConfig(replicas=0)
+    with pytest.raises(ValueError):
+        FleetConfig(max_hops=0)
+    with pytest.raises(ValueError):
+        FleetConfig(vnodes=0)
+    with pytest.raises(ValueError):
+        FleetConfig(replicas=4, max_replicas=2)
+    monkeypatch.setenv("BA_TPU_FLEET_REPLICAS", "3")
+    monkeypatch.setenv("BA_TPU_FLEET_HOPS", "2")
+    monkeypatch.setenv("BA_TPU_FLEET_VNODES", "16")
+    monkeypatch.setenv("BA_TPU_FLEET_ROOT", "/tmp/fleet-env-test")
+    cfg = FleetConfig.from_env()
+    assert (cfg.replicas, cfg.max_hops, cfg.vnodes) == (3, 2, 16)
+    assert cfg.root == "/tmp/fleet-env-test"
+    # Explicit overrides beat the environment.
+    assert FleetConfig.from_env(replicas=5).replicas == 5
+    monkeypatch.setenv("BA_TPU_FLEET_REPLICAS", "lots")
+    with pytest.raises(ValueError):
+        FleetConfig.from_env()
+
+
+def test_campaign_spec_doc_roundtrip_and_validation():
+    spec = CampaignSpec(
+        campaign="c1", seed=11, state_seed=12, batch=8, rounds=64
+    )
+    doc = spec.to_doc()
+    assert "scenario" not in doc  # None scenario drops from the doc
+    assert CampaignSpec.from_doc(doc) == spec
+    assert json.loads(json.dumps(doc)) == doc  # JSON-clean
+    with pytest.raises(ValueError):
+        CampaignSpec.from_doc({**doc, "surprise": 1})
+    with pytest.raises(ValueError):
+        CampaignSpec.from_doc("not a dict")
+    # The id becomes a directory under the fleet root: path-unsafe
+    # names are refused eagerly.
+    for bad in ("", "a/b", "..", "x\x00y"):
+        with pytest.raises(ValueError):
+            CampaignSpec(
+                campaign=bad, seed=1, state_seed=2, batch=4, rounds=8
+            )
+    with pytest.raises(ValueError):
+        CampaignSpec(
+            campaign="c", seed=1, state_seed=2, batch=0, rounds=8
+        )
+
+
+def test_read_ledger_folds_statuses(tmp_path):
+    root = str(tmp_path)
+    path = os.path.join(root, "replicas", "replica-0", "ledger.jsonl")
+    os.makedirs(os.path.dirname(path))
+    rows = [
+        {"ev": "admit", "campaign": "done1", "doc": {"d": 1},
+         "template": "t1"},
+        {"ev": "checkpoint", "campaign": "done1", "fingerprint": "fp1"},
+        {"ev": "done", "campaign": "done1"},
+        {"ev": "admit", "campaign": "handed", "doc": {"d": 2},
+         "template": "t2"},
+        {"ev": "handoff", "campaign": "handed"},
+        {"ev": "admit", "campaign": "orphan", "doc": {"d": 3},
+         "template": "t3"},
+        {"ev": "checkpoint", "campaign": "orphan", "fingerprint": "fp3"},
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+        f.write('{"ev": "checkpoint", "campaign": "orp')  # torn tail
+    by_id = {e["campaign"]: e for e in read_ledger(root, "replica-0")}
+    assert by_id["done1"]["status"] == "done"
+    assert by_id["handed"]["status"] == "handoff"
+    assert by_id["orphan"]["status"] == "orphaned"
+    assert by_id["orphan"]["fingerprint"] == "fp3"
+    assert by_id["orphan"]["template"] == "t3"
+    assert read_ledger(root, "never-wrote") == []
+
+
+def test_handoff_header_grammar(tmp_path):
+    path = str(tmp_path / "handoff.json")
+    header = write_handoff(
+        path,
+        campaign="c1",
+        doc={"campaign": "c1"},
+        template=str(tmp_path / "ck_{round}.npz"),
+        round_cursor=32,
+        rounds=64,
+        checkpoint=str(tmp_path / "ck_32.npz"),
+        fingerprint="fp",
+        signed=False,
+        from_replica="replica-0",
+    )
+    assert read_handoff(path) == header
+    # Malformed headers are refused loudly, never half-parsed.
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w", encoding="utf-8") as f:
+        f.write("not json")
+    with pytest.raises(HandoffRefused):
+        read_handoff(bad)
+    with pytest.raises(HandoffRefused):
+        read_handoff(str(tmp_path / "missing.json"))
+    for mutate in (
+        {"format": "other"},
+        {"v": 99},
+    ):
+        with open(bad, "w", encoding="utf-8") as f:
+            json.dump({**header, **mutate}, f)
+        with pytest.raises(HandoffRefused):
+            read_handoff(bad)
+    incomplete = dict(header)
+    del incomplete["fingerprint"]
+    with open(bad, "w", encoding="utf-8") as f:
+        json.dump(incomplete, f)
+    with pytest.raises(HandoffRefused, match="fingerprint"):
+        read_handoff(bad)
+    # verify_handoff: a header pointing at a checkpoint that does not
+    # validate is refused before any engine work.
+    with pytest.raises(HandoffRefused, match="failed validation"):
+        verify_handoff(header)
+
+
+def test_serve_handoff_rehomes_without_counting_failures():
+    # The drain hook's contract: queued-but-never-dispatched tickets
+    # fail with a re-homable ServeError (so no caller hangs) but are
+    # NOT counted as failures and emit NO terminal request record — a
+    # drain is a move, not an outcome.
+    reg = MetricsRegistry()
+    svc = AgreementService(
+        ServeConfig(max_queue=8, warm=False), registry=reg
+    )
+    svc.open()  # admission only: no dispatcher, the queue just fills
+    tickets = [
+        svc.submit(
+            AgreementRequest(kind="run-rounds", n=4, seed=i, rounds=2),
+            deadline_s=None,
+        )
+        for i in range(3)
+    ]
+    rehomed = svc.handoff()
+    assert [t.id for t in rehomed] == [t.id for t in tickets]
+    for t in tickets:
+        with pytest.raises(ServeError, match="re-homed"):
+            t.result(timeout=1.0)
+    assert svc.stats()["failed"] == 0
+    assert reg.counter("serve_failed_total").value == 0
+    with pytest.raises(ServeError):
+        svc.submit(AgreementRequest(kind="run-rounds", rounds=2))
+
+
+def test_warm_ok_is_the_ring_entry_gate():
+    from ba_tpu.runtime.warmup import WarmupRunner
+
+    runner = WarmupRunner(None, [], registry=MetricsRegistry())
+    assert not runner.ok()  # never ran: not warm
+    runner._done.set()
+    assert runner.ok()
+    runner.errors = 1  # a failed signature → never enters the ring
+    assert not runner.ok()
+
+
+def _admission_only_fleet(serve_config, replicas=2, **cfg):
+    """A manager whose replicas accept but never dispatch (no
+    dispatcher thread, no jax): admission-layer routing tests."""
+    mgr = ReplicaManager(
+        FleetConfig(replicas=replicas, **cfg), serve_config=serve_config
+    )
+    for _ in range(replicas):
+        rep = mgr._new_replica()
+        rep.service.open()
+        rep.set_state("ready")
+    return mgr
+
+
+def test_router_routes_by_cohort_and_bounds_hops():
+    mgr = _admission_only_fleet(ServeConfig(max_queue=4, warm=False))
+    router = FleetRouter(mgr)
+    req = AgreementRequest(kind="run-rounds", n=4, seed=1, rounds=2)
+    t = router.submit(req, deadline_s=None)
+    assert t.admit_hops == 1 and t.reroutes == 0
+    # Same cohort → same replica, every time (coalescing locality).
+    names = {router.submit(req, deadline_s=None).replica
+             for _ in range(3)}
+    assert names == {t.replica}
+    # Empty fleet: a plain ServeError, not a hang.
+    empty = ReplicaManager(FleetConfig(replicas=1))
+    with pytest.raises(ServeError, match="no ready replica"):
+        FleetRouter(empty).submit(req, deadline_s=None)
+    stats = router.stats()
+    assert stats["routes"] == 4 and stats["ready"] == 2
+
+
+def test_router_hops_off_overloaded_home_replica():
+    # The hash home sheds → the request lands on the next ring member
+    # instead of bouncing back to the client.
+    mgr = _admission_only_fleet(ServeConfig(max_queue=8, warm=False))
+    router = FleetRouter(mgr)
+    req = AgreementRequest(kind="run-rounds", n=4, seed=1, rounds=2)
+    home = router.submit(req, deadline_s=None).replica
+    mgr.get(home).service._tier = 3  # shed_all on the home replica
+    routed = router.submit(req, deadline_s=None)
+    assert routed.replica != home
+    assert routed.admit_hops == 2
+
+
+def test_routed_ticket_rehomes_off_a_draining_replica():
+    # "Never a hung client", deterministically: a ticket queued on the
+    # home replica when its serve-side handoff fires is transparently
+    # re-submitted on the survivor inside the caller's result() budget
+    # (no dispatcher anywhere, so the re-homed ticket then times out —
+    # proving the reroute happened and the budget still bounds it).
+    mgr = _admission_only_fleet(ServeConfig(max_queue=8, warm=False))
+    router = FleetRouter(mgr)
+    req = AgreementRequest(kind="run-rounds", n=4, seed=1, rounds=2)
+    routed = router.submit(req, deadline_s=None)
+    home = routed.replica
+    mgr.get(home).service.handoff()
+    mgr.get(home).set_state("stopped")
+    with pytest.raises(TimeoutError):
+        routed.result(timeout=0.5)
+    assert routed.reroutes == 1
+    assert routed.replica != home
+    assert routed.tried == [home, routed.replica]
+    assert router.stats()["reroutes"] == 1
+    # And when the LAST replica dies too: a loud ServeError, no hang.
+    survivor = routed.replica
+    mgr.get(survivor).service.handoff()
+    mgr.get(survivor).set_state("stopped")
+    with pytest.raises(ServeError, match="no surviving replica"):
+        routed.result(timeout=5.0)
+
+
+def test_drain_zero_campaigns_is_strict_noop(tmp_path):
+    # The no-op edge the issue pins: draining a replica with zero
+    # in-flight campaigns must not litter the fleet root with empty
+    # handoff or checkpoint state someone later mistakes for a
+    # campaign.
+    root = str(tmp_path / "fleet")
+    mgr = ReplicaManager(
+        FleetConfig(replicas=2, root=root),
+        serve_config=ServeConfig(warm=False),
+    )
+    mgr.start()
+    assert [r.state for r in mgr.all()] == ["ready", "ready"]
+    adopted = mgr.drain("replica-0")
+    assert adopted == []
+    assert mgr.get("replica-0").state == "stopped"
+    assert not os.path.exists(os.path.join(root, "campaigns"))
+    leftover = [
+        os.path.join(dirpath, f)
+        for dirpath, _, files in os.walk(root)
+        for f in files
+        if f != "ledger.jsonl"
+    ]
+    assert leftover == []
+    # The survivor still serves; the drained replica left the ring.
+    router = FleetRouter(mgr)
+    assert router.stats()["ready"] == 1
+    mgr.stop()
+
+
+def test_repl_fleet_command(tmp_path):
+    # The REPL surface (jax-free on the PyBackend roster): start /
+    # stat / drain / stop plus the one-line error grammar.
+    from ba_tpu.runtime.backends import PyBackend
+    from ba_tpu.runtime.cluster import Cluster
+    from ba_tpu.runtime.repl import handle_command
+
+    cluster = Cluster(4, PyBackend(), seed=0)
+    lines = []
+    assert handle_command(cluster, "fleet", lines.append)
+    assert lines[-1].startswith("fleet error: usage:")
+    handle_command(cluster, "fleet stat", lines.append)
+    assert lines[-1] == "fleet error: not running (fleet start first)"
+    handle_command(cluster, "fleet start replicas=two", lines.append)
+    assert lines[-1] == "fleet error: replicas= wants a int, got 'two'"
+    handle_command(cluster, "fleet start replicas=0", lines.append)
+    assert lines[-1].startswith("fleet error: replicas=0")
+    root = str(tmp_path / "fleet")
+    handle_command(
+        cluster, f"fleet start replicas=2 root={root} queue=4",
+        lines.append,
+    )
+    assert lines[-1].startswith("fleet: started 2 replica(s)")
+    handle_command(cluster, "fleet start replicas=1", lines.append)
+    assert lines[-1] == "fleet error: already running (fleet stop first)"
+    lines.clear()
+    handle_command(cluster, "fleet stat", lines.append)
+    assert lines[0] == "fleet_routes 0"
+    assert sum(1 for ln in lines if ln.startswith("fleet_replica ")) == 2
+    handle_command(cluster, "fleet drain nope", lines.append)
+    assert lines[-1].startswith("fleet error:")
+    handle_command(cluster, "fleet drain replica-0", lines.append)
+    assert lines[-1] == (
+        "fleet: drained replica-0 — 0 campaign(s) migrated, "
+        "1 replica(s) still serving"
+    )
+    handle_command(cluster, "fleet stop", lines.append)
+    assert lines[-1] == "fleet: stopped — routes=0, reroutes=0"
+    assert cluster._fleet_manager is None
+
+
+# -- engine-backed fleet drill ------------------------------------------------
+
+
+def _spawn_clients(router, n, seed0):
+    """n concurrent routed clients; returns (threads, results dict)."""
+    results = {}
+
+    def client(i):
+        t = router.submit(
+            AgreementRequest(
+                kind="run-rounds", n=4, seed=seed0 + i, rounds=2
+            ),
+            deadline_s=None,
+        )
+        results[i] = t.result(timeout=120)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    return threads, results
+
+
+def _join_all(threads):
+    for t in threads:
+        t.join(120)
+    return sum(t.is_alive() for t in threads)
+
+
+def test_fleet_drill_drain_resume_and_kill_adopt(tmp_path):
+    # THE acceptance drill (ISSUE 20), both failure modes in one fleet:
+    # (1) serve-drain a replica mid-campaign under concurrent routed
+    #     load → zero hung clients, the campaign resumes BIT-EXACTLY on
+    #     the survivor, and a forged handoff header is refused;
+    # (2) SIGKILL a replica mid-campaign → zero hung clients, its
+    #     orphan is adopted by ledgered fingerprint, bit-exactly.
+    import jax.random as jr
+
+    from ba_tpu.parallel import make_sweep_state
+    from ba_tpu.runtime.supervisor import (
+        SupervisorConfig,
+        supervised_sweep,
+    )
+
+    rounds = 4000
+    want = supervised_sweep(
+        jr.key(11),
+        make_sweep_state(jr.key(12), 8, 4),
+        rounds,
+        rounds_per_dispatch=1,
+        collect_decisions=True,
+        config=SupervisorConfig(timeout_s=60.0),
+    )
+
+    root = str(tmp_path / "fleet")
+    mgr = ReplicaManager(
+        FleetConfig(replicas=2, root=root),
+        serve_config=ServeConfig(
+            max_queue=16, coalesce_window_s=0.01, warm=False
+        ),
+    )
+    mgr.start()
+    router = FleetRouter(mgr)
+
+    def start_campaign(replica, cid):
+        # Same seeds both phases: one reference covers both (the
+        # fingerprint is seed-derived, not campaign-id-derived).
+        handle = mgr.get(replica).run_campaign(CampaignSpec(
+            campaign=cid, seed=11, state_seed=12, batch=8,
+            rounds=rounds, capacity=4, checkpoint_every=8,
+        ))
+        deadline = time.perf_counter() + 60
+        while handle.fingerprint is None and not handle.done():
+            assert time.perf_counter() < deadline, "no first checkpoint"
+            time.sleep(0.02)
+        return handle
+
+    # -- phase 1: serve-drain under load --------------------------------------
+    h1 = start_campaign("replica-1", "c1")
+    threads, results = _spawn_clients(router, 8, seed0=0)
+    adopted = mgr.drain("replica-1")
+    assert h1.outcome == "handoff", (h1.outcome, h1.error)
+    header = read_handoff(h1.handoff_path)
+    verify_handoff(header)
+    forged = {**header, "signed": not header["signed"]}
+    with pytest.raises(HandoffRefused, match="cross-protocol"):
+        verify_handoff(forged)
+    with pytest.raises(HandoffRefused, match="fingerprint"):
+        verify_handoff({**header, "fingerprint": "0" * 64})
+    assert _join_all(threads) == 0, "hung client through drain"
+    assert len(results) == 8
+    assert all(isinstance(r, dict) for r in results.values())
+    (h2,) = adopted
+    assert h2.wait(240) and h2.outcome == "completed", (
+        h2.outcome, h2.error,
+    )
+    np.testing.assert_array_equal(
+        h2.result["decisions"], want["decisions"]
+    )
+    np.testing.assert_array_equal(
+        h2.result["histograms"], want["histograms"]
+    )
+    # history_start == 0: resume reassembled the FULL history (carry +
+    # rows sidecar), not a truncated suffix.
+    assert h2.result["supervisor"]["history_start"] == 0
+
+    # -- phase 2: kill + orphan adoption --------------------------------------
+    mgr.start_replica()  # the survivor ("replica-2")
+    h3 = start_campaign("replica-0", "c2")
+    threads2, results2 = _spawn_clients(router, 8, seed0=100)
+    mgr.kill("replica-0")
+    assert h3.wait(120) and h3.outcome == "abandoned", h3.outcome
+    # A SIGKILLed lane writes nothing terminal: no handoff file, and
+    # its ledger entry folds to "orphaned".
+    assert h3.handoff_path is None
+    statuses = {
+        e["campaign"]: e["status"]
+        for e in read_ledger(root, "replica-0")
+    }
+    assert statuses["c2"] == "orphaned"
+    assert _join_all(threads2) == 0, "hung client through kill"
+    assert all(isinstance(r, dict) for r in results2.values())
+    (h4,) = mgr.adopt_orphans("replica-0")
+    assert h4.wait(240) and h4.outcome == "completed", (
+        h4.outcome, h4.error,
+    )
+    np.testing.assert_array_equal(
+        h4.result["decisions"], want["decisions"]
+    )
+    assert h4.result["supervisor"]["history_start"] == 0
+
+    assert router.stats()["routes"] == 16
+    mgr.stop()

@@ -32,6 +32,7 @@ from ba_tpu.runtime.serve import (
     COLD_RETRY_AFTER_S,
     AgreementRequest,
     AgreementService,
+    Overloaded,
     ServeConfig,
     cohort_key,
     cohort_label,
@@ -333,6 +334,47 @@ def test_cold_retry_after_and_cohort_label_and_tenant_validation():
         validate_request(
             AgreementRequest(kind="run-rounds", rounds=2, tenant=7)
         )
+
+
+def test_router_reject_propagates_origin_retry_after():
+    # The fleet-router half of the retry-after contract (ISSUE 20
+    # satellite, pinned next to the COLD_RETRY_AFTER_S pin above): when
+    # EVERY hop sheds, the router re-raises with the ORIGIN replica's
+    # retry_after_s — the hash home's queue depth is the real
+    # backpressure signal — never a recomputed cold default and never a
+    # later hop's smaller hint.
+    from ba_tpu.fleet import FleetConfig, FleetRouter, ReplicaManager
+
+    mgr = ReplicaManager(
+        FleetConfig(replicas=2),
+        serve_config=ServeConfig(max_queue=8, max_batch=2, warm=False),
+    )
+    for _ in range(2):
+        rep = mgr._new_replica()
+        rep.service.open()  # admission only: queues fill, nothing runs
+        rep.set_state("ready")
+    router = FleetRouter(mgr)
+    router._sync_ring()
+    req = AgreementRequest(kind="run-rounds", n=4, seed=1, rounds=2)
+    home = router._ring.prefer(cohort_label(cohort_key(req)))[0]
+    other = next(r.name for r in mgr.all() if r.name != home)
+    for i in range(8):  # fill the home's queue to the brim
+        mgr.get(home).submit(
+            AgreementRequest(kind="run-rounds", n=4, seed=i, rounds=2),
+            deadline_s=None,
+        )
+    mgr.get(other).service._tier = 3  # the hop sheds with a COLD hint
+    with pytest.raises(Overloaded) as origin_info:
+        mgr.get(home).submit(req, deadline_s=None)
+    origin = origin_info.value
+    # Cold queue-full hint: ceil(8 deep / max_batch 2) cold batches.
+    assert origin.retry_after_s == 4 * COLD_RETRY_AFTER_S
+    with pytest.raises(Overloaded) as routed_info:
+        router.submit(req, deadline_s=None)
+    routed = routed_info.value
+    assert routed.retry_after_s == origin.retry_after_s
+    assert routed.retry_after_s != COLD_RETRY_AFTER_S
+    assert (routed.reason, routed.tier) == (origin.reason, origin.tier)
 
 
 # -- engine-backed serving layer ---------------------------------------------
